@@ -1,0 +1,59 @@
+"""deepspeed_tpu: a TPU-native training framework with the capabilities of DeepSpeed v0.3.0.
+
+Public API mirrors the reference's ``deepspeed/__init__.py``: ``initialize()`` returns
+``(engine, optimizer, dataloader, lr_scheduler)``; ``add_config_arguments()`` wires argparse.
+The implementation is idiomatic JAX/XLA/Pallas/pjit — see SURVEY.md for the mapping.
+"""
+
+__version__ = "0.1.0"
+__git_branch__ = "main"
+
+from .runtime.config import DeepSpeedConfig  # noqa: F401
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mpu=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config_params=None):
+    """Initialize the DeepSpeed-TPU engine (reference deepspeed/__init__.py:52-141).
+
+    Returns a tuple of ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+    """
+    from .runtime.engine import make_engine
+
+    engine = make_engine(args=args,
+                         model=model,
+                         optimizer=optimizer,
+                         model_parameters=model_parameters,
+                         training_data=training_data,
+                         lr_scheduler=lr_scheduler,
+                         mpu=mpu,
+                         dist_init_required=dist_init_required,
+                         collate_fn=collate_fn,
+                         config_params=config_params)
+    return_items = [engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler]
+    return tuple(return_items)
+
+
+def _add_core_arguments(parser):
+    """Core DeepSpeed arguments (reference deepspeed/__init__.py:144-192)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag for user code, no impact on engine)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to DeepSpeed json configuration.")
+    group.add_argument("--deepspeed_mpi", default=False, action="store_true",
+                       help="Run via MPI; this flag will force multi-host distributed init.")
+    return parser
+
+
+def add_config_arguments(parser):
+    """Update an argument parser to enable the DeepSpeed config block."""
+    parser = _add_core_arguments(parser)
+    return parser

@@ -1,0 +1,1 @@
+from .checkpointing import save_checkpoint, load_checkpoint

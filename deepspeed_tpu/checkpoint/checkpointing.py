@@ -1,0 +1,198 @@
+"""Checkpoint save/load with DeepSpeed's tag/dir layout semantics.
+
+Analog of ``deepspeed/runtime/engine.py:1149-1416``: a checkpoint directory contains a
+``<tag>/`` subdir with ``mp_rank_00_model_states`` (module params + counters + lr/scaler
+state) and, under ZeRO, per-DP-shard optimizer state files
+``zero_pp_rank_{dp}_mp_rank_{mp}_optim_states`` whose shards can be merged and
+re-partitioned when reloading under a different DP world size (elastic checkpoint,
+reference stage2.py:1713-1779 / stage1.py:836-947). Arrays are stored as .npz; metadata as
+JSON. ``latest`` file tracks the most recent tag (engine.py:1351-1353).
+
+In the single-controller JAX runtime one process owns every shard, so "per-rank files"
+are written by slicing the global arrays — the on-disk layout (one optim file per DP rank)
+is preserved so multi-host loaders and the elastic merge path work identically.
+"""
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import logger
+
+
+def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
+    flat = {}
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in leaves_with_paths:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p)))) for p in path)
+        arr = np.asarray(jax.device_get(leaf))
+        if arr.dtype not in (np.float32, np.float64, np.int32, np.int64, np.bool_,
+                             np.uint32, np.uint8, np.int8, np.float16):
+            # npz can't natively store ml_dtypes (bfloat16 et al.); widen losslessly.
+            arr = arr.astype(np.float32)
+        flat[key] = arr
+    return flat
+
+
+def _unflatten_like(template, flat: Dict[str, np.ndarray]):
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in paths:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p)))) for p in path)
+        if key not in flat:
+            raise KeyError(f"checkpoint missing array {key!r}")
+        arr = flat[key]
+        leaves.append(jnp.asarray(arr, dtype=leaf.dtype).reshape(leaf.shape))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _save_tree_npz(path: str, tree):
+    np.savez(path, **_flatten_with_paths(tree))
+
+
+def _load_tree_npz(path: str, template):
+    with np.load(path) as data:
+        flat = {k: data[k] for k in data.files}
+    return _unflatten_like(template, flat)
+
+
+def _ckpt_dir(save_dir: str, tag: str) -> str:
+    return os.path.join(save_dir, str(tag))
+
+
+def model_states_name(mp_rank: int = 0) -> str:
+    return f"mp_rank_{mp_rank:02d}_model_states"
+
+
+def optim_states_name(dp_rank: int, mp_rank: int = 0) -> str:
+    return f"zero_pp_rank_{dp_rank}_mp_rank_{mp_rank:02d}_optim_states"
+
+
+def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_state: Dict = {},
+                    save_latest: bool = True):
+    if tag is None:
+        tag = f"global_step{engine.global_steps}"
+    ckpt_dir = _ckpt_dir(save_dir, tag)
+    os.makedirs(ckpt_dir, exist_ok=True)
+
+    # --- model states (replicated compute params + host-side counters) ---
+    _save_tree_npz(os.path.join(ckpt_dir, model_states_name() + ".npz"), engine.params)
+    meta = {
+        "global_steps": engine.global_steps,
+        "micro_steps": engine.micro_steps,
+        "skipped_steps": engine.skipped_steps,
+        "dp_world_size": engine.dp_size,
+        "zero_stage": engine.zero_optimization_stage(),
+        "optimizer_name": engine.optimizer.name,
+        "param_groups": [
+            {k: (list(v) if isinstance(v, tuple) else v) for k, v in g.items()}
+            for g in engine.optimizer.param_groups
+        ],
+        "lr_scheduler": engine.lr_scheduler.state_dict() if engine.lr_scheduler is not None else None,
+        "client_state": client_state,
+    }
+    with open(os.path.join(ckpt_dir, model_states_name() + ".json"), "w") as f:
+        json.dump(meta, f)
+
+    # --- scaler state ---
+    _save_tree_npz(os.path.join(ckpt_dir, "loss_scaler.npz"), engine.scaler_state)
+
+    # --- optimizer + master-weight states, one file per DP rank (elastic layout) ---
+    dp = engine.dp_size
+    master_flat = _flatten_with_paths(engine.master_params)
+    opt_flat = _flatten_with_paths(engine.opt_state)
+    for dp_rank in range(dp):
+        shard = {}
+        for prefix, flat in (("master", master_flat), ("opt", opt_flat)):
+            for key, arr in flat.items():
+                parts = np.array_split(arr.reshape(-1), dp)
+                shard[f"{prefix}/{key}"] = parts[dp_rank]
+        np.savez(os.path.join(ckpt_dir, optim_states_name(dp_rank) + ".npz"), **shard)
+    # shape manifest for elastic restore
+    shapes = {f"master/{k}": list(v.shape) for k, v in master_flat.items()}
+    shapes.update({f"opt/{k}": list(v.shape) for k, v in opt_flat.items()})
+    with open(os.path.join(ckpt_dir, "optim_shapes.json"), "w") as f:
+        json.dump({"dp_world_size": dp, "shapes": shapes}, f)
+
+    if save_latest:
+        with open(os.path.join(save_dir, "latest"), "w") as f:
+            f.write(tag)
+    logger.info(f"[deepspeed_tpu] saved checkpoint {tag} to {save_dir}")
+    return True
+
+
+def _merge_elastic(ckpt_dir: str) -> Dict[str, np.ndarray]:
+    """Merge per-DP-rank optim shards back into full flat arrays (any saved dp size)."""
+    with open(os.path.join(ckpt_dir, "optim_shapes.json")) as f:
+        manifest = json.load(f)
+    saved_dp = manifest["dp_world_size"]
+    shapes = manifest["shapes"]
+    merged: Dict[str, List[np.ndarray]] = {k: [None] * saved_dp for k in shapes}
+    for dp_rank in range(saved_dp):
+        path = os.path.join(ckpt_dir, optim_states_name(dp_rank) + ".npz")
+        with np.load(path) as data:
+            for key in data.files:
+                merged[key][dp_rank] = data[key]
+    out = {}
+    for key, chunks in merged.items():
+        flat = np.concatenate(chunks)
+        out[key] = flat.reshape(shapes[key])
+    return out
+
+
+def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
+                    load_optimizer_states: bool = True, load_lr_scheduler_states: bool = True):
+    if tag is None:
+        latest_path = os.path.join(load_dir, "latest")
+        if os.path.isfile(latest_path):
+            with open(latest_path) as f:
+                tag = f.read().strip()
+        else:
+            logger.warning(f"Unable to find latest file at {latest_path}, "
+                           "if trying to load latest checkpoint please pass a valid tag.")
+            return None, {}
+    ckpt_dir = _ckpt_dir(load_dir, tag)
+    if not os.path.isdir(ckpt_dir):
+        logger.warning(f"Client provided checkpoint tag {tag} does not exist in {load_dir}")
+        return None, {}
+
+    with open(os.path.join(ckpt_dir, model_states_name() + ".json")) as f:
+        meta = json.load(f)
+
+    params = _load_tree_npz(os.path.join(ckpt_dir, model_states_name() + ".npz"), engine.params)
+    engine.params = jax.device_put(params, engine._param_shardings)
+
+    engine.global_steps = meta["global_steps"]
+    engine.micro_steps = meta["micro_steps"]
+    engine.skipped_steps = meta["skipped_steps"]
+    for g, src in zip(engine.optimizer.param_groups, meta.get("param_groups", [])):
+        src = dict(src)
+        if "betas" in src and isinstance(src["betas"], list):
+            src["betas"] = tuple(src["betas"])
+        g.update(src)
+    if load_lr_scheduler_states and engine.lr_scheduler is not None and meta.get("lr_scheduler"):
+        engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+
+    engine.scaler_state = _load_tree_npz(os.path.join(ckpt_dir, "loss_scaler.npz"), engine.scaler_state)
+
+    if load_optimizer_states:
+        merged = _merge_elastic(ckpt_dir)
+        master_flat = {k[len("master/"):]: v for k, v in merged.items() if k.startswith("master/")}
+        opt_flat = {k[len("opt/"):]: v for k, v in merged.items() if k.startswith("opt/")}
+        master = _unflatten_like(engine.master_params, master_flat)
+        opt = _unflatten_like(engine.opt_state, opt_flat)
+        engine.master_params = jax.device_put(master, engine._master_shardings)
+        engine.opt_state = jax.device_put(opt, engine._opt_shardings)
+    else:
+        # re-derive master from loaded params (fp16-derived restore, stage2.py:1781-1836)
+        engine.master_params = jax.device_put(
+            jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32), engine.params),
+            engine._master_shardings)
+
+    logger.info(f"[deepspeed_tpu] loaded checkpoint {tag} from {load_dir} "
+                f"(saved dp={meta['dp_world_size']}, current dp={engine.dp_size})")
+    return ckpt_dir, meta.get("client_state", {})

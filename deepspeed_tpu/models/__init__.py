@@ -1,0 +1,1 @@
+from .gpt2 import GPT2Config, GPT2Model

@@ -1,0 +1,166 @@
+"""GPT-2 family model, TPU-first.
+
+Flagship decoder LM for the framework benchmarks (BASELINE.json: GPT-2 1.5B ZeRO-2). The
+reference trains GPT-2 through external Megatron-LM (tests/model/Megatron_GPT2); here the
+model is in-tree, a pure-function pytree model:
+
+- bf16-friendly: all matmuls carry ``preferred_element_type=float32`` accumulation;
+- static shapes, layer loop unrolled (or remat-scanned) for XLA;
+- attention dispatches to the Pallas flash-attention kernel on TPU when enabled, with a
+  dense fallback (ops/pallas/flash_attention.py);
+- weights laid out [in, out] so the ``model``-axis TP sharding (attention heads / MLP
+  columns) is a pure PartitionSpec choice.
+"""
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.0          # dropout is applied via stateless PRNG when > 0
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    use_flash_attention: bool = False
+    remat: bool = False            # activation checkpointing over blocks
+    compute_dtype: Any = jnp.bfloat16
+
+    # named sizes for convenience
+    @property
+    def head_dim(self):
+        return self.n_embd // self.n_head
+
+
+def _dense_init(rng, shape, scale):
+    return jax.random.normal(rng, shape, jnp.float32) * scale
+
+
+class GPT2Model:
+    """Pure-function GPT-2: ``init(rng) -> params``, ``apply(params, tokens[, labels])``."""
+
+    def __init__(self, config: GPT2Config):
+        self.config = config
+
+    # ------------------------------------------------------------- init
+    def init(self, rng) -> Dict:
+        c = self.config
+        keys = jax.random.split(rng, 4 + c.n_layer)
+        params = {
+            "wte": _dense_init(keys[0], (c.vocab_size, c.n_embd), c.initializer_range),
+            "wpe": _dense_init(keys[1], (c.n_positions, c.n_embd), c.initializer_range),
+            "ln_f": {"scale": jnp.ones((c.n_embd,), jnp.float32),
+                     "bias": jnp.zeros((c.n_embd,), jnp.float32)},
+            "blocks": [],
+        }
+        # residual-scaled init for output projections (GPT-2 paper)
+        proj_scale = c.initializer_range / math.sqrt(2 * c.n_layer)
+        for i in range(c.n_layer):
+            k = jax.random.split(keys[4 + i], 4)
+            block = {
+                "ln_1": {"scale": jnp.ones((c.n_embd,), jnp.float32),
+                         "bias": jnp.zeros((c.n_embd,), jnp.float32)},
+                "attn": {
+                    "c_attn_w": _dense_init(k[0], (c.n_embd, 3 * c.n_embd), c.initializer_range),
+                    "c_attn_b": jnp.zeros((3 * c.n_embd,), jnp.float32),
+                    "c_proj_w": _dense_init(k[1], (c.n_embd, c.n_embd), proj_scale),
+                    "c_proj_b": jnp.zeros((c.n_embd,), jnp.float32),
+                },
+                "ln_2": {"scale": jnp.ones((c.n_embd,), jnp.float32),
+                         "bias": jnp.zeros((c.n_embd,), jnp.float32)},
+                "mlp": {
+                    "c_fc_w": _dense_init(k[2], (c.n_embd, 4 * c.n_embd), c.initializer_range),
+                    "c_fc_b": jnp.zeros((4 * c.n_embd,), jnp.float32),
+                    "c_proj_w": _dense_init(k[3], (4 * c.n_embd, c.n_embd), proj_scale),
+                    "c_proj_b": jnp.zeros((c.n_embd,), jnp.float32),
+                },
+            }
+            params["blocks"].append(block)
+        return params
+
+    # ------------------------------------------------------------- layers
+    def _layer_norm(self, x, p, eps):
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        out = (xf - mean) * jax.lax.rsqrt(var + eps)
+        return (out * p["scale"] + p["bias"]).astype(x.dtype)
+
+    def _attention(self, x, p, dropout_rng=None):
+        c = self.config
+        B, T, E = x.shape
+        qkv = jnp.dot(x, p["c_attn_w"].astype(x.dtype),
+                      preferred_element_type=jnp.float32).astype(x.dtype) + p["c_attn_b"].astype(x.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, c.n_head, c.head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(B, T, c.n_head, c.head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(B, T, c.n_head, c.head_dim).transpose(0, 2, 1, 3)
+
+        if c.use_flash_attention:
+            from ..ops.pallas.flash_attention import flash_attention
+            y = flash_attention(q, k, v, causal=True)
+        else:
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                                preferred_element_type=jnp.float32) / math.sqrt(c.head_dim)
+            mask = jnp.tril(jnp.ones((T, T), jnp.bool_))
+            scores = jnp.where(mask, scores, jnp.float32(-1e9))
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            y = jnp.einsum("bhqk,bhkd->bhqd", probs, v,
+                           preferred_element_type=jnp.float32).astype(x.dtype)
+        y = y.transpose(0, 2, 1, 3).reshape(B, T, E)
+        y = jnp.dot(y, p["c_proj_w"].astype(x.dtype),
+                    preferred_element_type=jnp.float32).astype(x.dtype) + p["c_proj_b"].astype(x.dtype)
+        return y
+
+    def _mlp(self, x, p):
+        h = jnp.dot(x, p["c_fc_w"].astype(x.dtype),
+                    preferred_element_type=jnp.float32).astype(x.dtype) + p["c_fc_b"].astype(x.dtype)
+        h = jax.nn.gelu(h, approximate=True)
+        out = jnp.dot(h, p["c_proj_w"].astype(x.dtype),
+                      preferred_element_type=jnp.float32).astype(x.dtype) + p["c_proj_b"].astype(x.dtype)
+        return out
+
+    def _block(self, x, bp):
+        c = self.config
+        x = x + self._attention(self._layer_norm(x, bp["ln_1"], c.layer_norm_epsilon), bp["attn"])
+        x = x + self._mlp(self._layer_norm(x, bp["ln_2"], c.layer_norm_epsilon), bp["mlp"])
+        return x
+
+    # ------------------------------------------------------------- apply
+    def logits(self, params, tokens):
+        c = self.config
+        B, T = tokens.shape
+        pos = jnp.arange(T)
+        x = params["wte"][tokens].astype(c.compute_dtype) + params["wpe"][pos].astype(c.compute_dtype)
+
+        block_fn = self._block
+        if c.remat:
+            block_fn = jax.checkpoint(block_fn)
+        for bp in params["blocks"]:
+            x = block_fn(x, bp)
+        x = self._layer_norm(x, params["ln_f"], c.layer_norm_epsilon)
+        # tied LM head: logits = x @ wte.T
+        logits = jnp.dot(x, params["wte"].T.astype(x.dtype), preferred_element_type=jnp.float32)
+        return logits
+
+    def apply(self, params, tokens, labels=None):
+        """With labels: mean token cross-entropy loss (the training objective).
+        Without: fp32 logits."""
+        logits = self.logits(params, tokens)
+        if labels is None:
+            return logits
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+    def param_count(self, params) -> int:
+        return sum(int(l.size) for l in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,57 @@
+"""Fused LAMB for TPU.
+
+Replaces ``csrc/lamb/fused_lamb_cuda_kernel.cu`` (N3) + ``deepspeed/ops/lamb/fused_lamb.py``:
+Adam-style update with a per-tensor trust ratio ||p|| / ||update||, clamped to
+[min_coeff, max_coeff] (reference fused_lamb.py:48-49). The two-pass norm reduction the CUDA
+kernel hand-rolls is a pair of XLA reductions that fuse into the update.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LambState(NamedTuple):
+    exp_avg: object
+    exp_avg_sq: object
+
+
+def init(master_params) -> LambState:
+    z = lambda: jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), master_params)
+    return LambState(exp_avg=z(), exp_avg_sq=z())
+
+
+def apply(grads, state: LambState, master_params, step, hyper,
+          max_coeff: float = 10.0, min_coeff: float = 0.01):
+    lr = hyper["lr"]
+    b1 = hyper["beta1"]
+    b2 = hyper["beta2"]
+    eps = hyper["eps"]
+    wd = hyper["weight_decay"]
+
+    def leaf(g, m, v, p):
+        g = g.astype(jnp.float32)
+        m = b1 * m + (1.0 - b1) * g
+        v = b2 * v + (1.0 - b2) * jnp.square(g)
+        update = m / (jnp.sqrt(v) + eps) + wd * p
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
+        trust = jnp.where(u_norm > 0, jnp.where(w_norm > 0, w_norm / u_norm, 1.0), 1.0)
+        trust = jnp.clip(trust, min_coeff, max_coeff)
+        new_p = p - lr * trust * update
+        return new_p, m, v
+
+    flat_g, treedef = jax.tree_util.tree_flatten(grads)
+    flat_m = jax.tree_util.tree_leaves(state.exp_avg)
+    flat_v = jax.tree_util.tree_leaves(state.exp_avg_sq)
+    flat_p = jax.tree_util.tree_leaves(master_params)
+    new_p, new_m, new_v = [], [], []
+    for g, m, v, p in zip(flat_g, flat_m, flat_v, flat_p):
+        np_, nm, nv = leaf(g, m, v, p)
+        new_p.append(np_)
+        new_m.append(nm)
+        new_v.append(nv)
+    unflat = jax.tree_util.tree_unflatten
+    return unflat(treedef, new_p), LambState(exp_avg=unflat(treedef, new_m),
+                                             exp_avg_sq=unflat(treedef, new_v))

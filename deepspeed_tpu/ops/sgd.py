@@ -1,0 +1,37 @@
+"""SGD with momentum (torch.optim.SGD parity for the engine's basic-optimizer path)."""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SgdState(NamedTuple):
+    momentum_buf: object
+
+
+def init(master_params) -> SgdState:
+    return SgdState(momentum_buf=jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), master_params))
+
+
+def apply(grads, state: SgdState, master_params, step, hyper):
+    lr = hyper["lr"]
+    mom = hyper.get("beta1", 0.0)  # momentum rides the beta1 slot
+    wd = hyper["weight_decay"]
+
+    def leaf(g, b, p):
+        g = g.astype(jnp.float32) + wd * p
+        b = mom * b + g
+        return p - lr * b, b
+
+    flat_g, treedef = jax.tree_util.tree_flatten(grads)
+    flat_b = jax.tree_util.tree_leaves(state.momentum_buf)
+    flat_p = jax.tree_util.tree_leaves(master_params)
+    new_p, new_b = [], []
+    for g, b, p in zip(flat_g, flat_b, flat_p):
+        np_, nb = leaf(g, b, p)
+        new_p.append(np_)
+        new_b.append(nb)
+    unflat = jax.tree_util.tree_unflatten
+    return unflat(treedef, new_p), SgdState(momentum_buf=unflat(treedef, new_b))

@@ -1,0 +1,4 @@
+from .topology import (ProcessTopology, PipeDataParallelTopology, PipeModelDataParallelTopology,
+                       PipelineParallelGrid)
+from .mesh import build_mesh, single_device_mesh, data_sharding, replicated, mesh_from_mpu, \
+    DATA_AXIS, MODEL_AXIS, PIPE_AXIS

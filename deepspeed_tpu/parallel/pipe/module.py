@@ -1,0 +1,187 @@
+"""Declarative pipeline model description.
+
+TPU-native analog of ``deepspeed/runtime/pipe/module.py`` (LayerSpec l.23, TiedLayerSpec
+l.71, PipelineModule l.85). A PipelineModule is a declarative list of layer constructors;
+``partition_layers`` balances them across stages (partition_balanced, reference
+runtime/utils.py:361). Unlike the reference — which instantiates only stage-local torch
+modules on each rank — the single-controller JAX build instantiates pure layer functions
+and stores per-stage parameter pytrees; execution happens in the pipeline engine via
+shard_map over the ``pipe`` mesh axis.
+"""
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+
+from ...runtime.utils import partition_balanced, partition_uniform
+from ...utils import logger
+from ..topology import PipeDataParallelTopology, PipelineParallelGrid, ProcessTopology
+
+
+class LayerSpec:
+    """Delays construction of a layer: stores class + args, builds on demand."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not issubclass(type(typename), type):
+            raise RuntimeError("LayerSpec only supports classes (callables built at build())")
+
+    def build(self, log=False):
+        if log:
+            logger.info(f"Building layer {self.typename.__name__}")
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        from ...runtime.utils import call_to_str
+        return call_to_str(self.typename.__name__, *self.module_args, **self.module_kwargs)
+
+
+class TiedLayerSpec(LayerSpec):
+    """LayerSpec whose parameters are shared with every other TiedLayerSpec of the same key
+    (reference module.py:71-83: tied embeddings)."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None, tied_weight_attr="embedding",
+                 **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+class PipelineModule:
+    """Declarative layer list → stage partitioning.
+
+    Layers must be "pure-function modules": objects with ``init(rng, x) -> params`` and
+    ``apply(params, x) -> y`` (flax modules qualify), or bare callables (no params).
+    """
+
+    def __init__(self,
+                 layers: Sequence,
+                 num_stages: Optional[int] = None,
+                 topology: Optional[ProcessTopology] = None,
+                 loss_fn: Optional[Callable] = None,
+                 seed_layers: bool = False,
+                 base_seed: int = 1234,
+                 partition_method: str = "parameters",
+                 activation_checkpoint_interval: int = 0):
+        if num_stages is None and topology is None:
+            raise RuntimeError("must provide num_stages or topology")
+        self._layer_specs = list(layers)
+        self.loss_fn = loss_fn
+        self.seed_layers = seed_layers
+        self.base_seed = base_seed
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self._partition_method = partition_method
+
+        if topology is None:
+            topology = PipeDataParallelTopology(num_pp=num_stages, num_dp=1)
+        self._topo = topology
+        self.num_stages = self._topo.get_dim("pipe")
+        self._grid = PipelineParallelGrid(topology=self._topo, global_rank=0)
+
+        # build all layers (single-controller: we own every stage's params)
+        self.forward_funcs: List[Callable] = []
+        self.tied_modules: Dict[str, Any] = {}
+        self.tied_specs: Dict[str, TiedLayerSpec] = {}
+        self._built_layers: List[Any] = []
+        for idx, spec in enumerate(self._layer_specs):
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in self.tied_modules:
+                    self.tied_modules[spec.key] = spec.build()
+                    self.tied_specs[spec.key] = spec
+                self._built_layers.append(self.tied_modules[spec.key])
+            elif isinstance(spec, LayerSpec):
+                self._built_layers.append(spec.build())
+            elif callable(spec):
+                self._built_layers.append(spec)
+            else:
+                raise TypeError(f"Layer spec {spec} is not callable or a LayerSpec")
+
+        self.parts = self._partition_layers(method=self._partition_method)
+
+    # ---------------- partitioning ----------------
+    def _count_layer_params(self) -> List[int]:
+        """Approximate parameter counts per layer for 'parameters' balancing."""
+        counts = []
+        for layer in self._built_layers:
+            n = 0
+            shapes = getattr(layer, "param_shapes", None)
+            if callable(shapes):
+                try:
+                    import numpy as np
+                    n = int(sum(np.prod(s) for s in shapes()))
+                except Exception:
+                    n = 0
+            counts.append(n)
+        return counts
+
+    def _partition_layers(self, method="uniform") -> List[int]:
+        num_stages = self.num_stages
+        num_layers = len(self._built_layers)
+        method = method.lower()
+        if method == "uniform":
+            parts = partition_uniform(num_items=num_layers, num_parts=num_stages)
+        elif method == "parameters":
+            param_counts = self._count_layer_params()
+            if sum(param_counts) == 0:
+                parts = partition_uniform(num_items=num_layers, num_parts=num_stages)
+            else:
+                parts = partition_balanced(weights=param_counts, num_parts=num_stages)
+        elif method.startswith("type:"):
+            layertype = method.split(":", 1)[1]
+            binary_weights = [0] * num_layers
+            for idx, layer in enumerate(self._built_layers):
+                if re.search(layertype, type(layer).__name__, re.IGNORECASE):
+                    binary_weights[idx] = 1
+            parts = partition_balanced(weights=binary_weights, num_parts=num_stages)
+        elif method == "profile":
+            raise NotImplementedError("Partitioning method 'profile' not implemented")
+        else:
+            raise NotImplementedError(f"Partitioning method {method} not implemented")
+        return parts
+
+    def stage_layers(self, stage_id: int) -> List[Any]:
+        return self._built_layers[self.parts[stage_id]:self.parts[stage_id + 1]]
+
+    def stage_owner(self, layer_idx: int) -> int:
+        for stage in range(self.num_stages):
+            if self.parts[stage] <= layer_idx < self.parts[stage + 1]:
+                return stage
+        raise ValueError(f"layer {layer_idx} out of range")
+
+    def topology(self) -> ProcessTopology:
+        return self._topo
+
+    def mpu(self) -> PipelineParallelGrid:
+        return self._grid
+
+    def num_layers(self) -> int:
+        return len(self._built_layers)
+
+    # parameter init for all layers: returns list (per layer) of params pytrees
+    def init_params(self, rng, sample_input):
+        """Initialize every layer sequentially, threading activation shapes."""
+        params = []
+        x = sample_input
+        tied_params: Dict[str, Any] = {}
+        for idx, (spec, layer) in enumerate(zip(self._layer_specs, self._built_layers)):
+            if self.seed_layers:
+                rng_layer = jax.random.PRNGKey(self.base_seed + idx)
+            else:
+                rng, rng_layer = jax.random.split(rng)
+            if hasattr(layer, "init"):
+                if isinstance(spec, TiedLayerSpec) and spec.key in tied_params:
+                    p = tied_params[spec.key]
+                else:
+                    p = layer.init(rng_layer, x)
+                    if isinstance(spec, TiedLayerSpec):
+                        tied_params[spec.key] = p
+                x = layer.apply(p, x)
+            else:
+                p = None
+                x = layer(x)
+            params.append(p)
+        return params

@@ -1,0 +1,83 @@
+"""Rank-sharded data loading.
+
+Analog of ``deepspeed/runtime/dataloader.py`` (DeepSpeedDataLoader l.33, RepeatingLoader
+l.10). In the single-controller JAX model there is no per-rank DistributedSampler: the
+loader yields full global micro-batches as numpy/JAX arrays and the engine's
+``device_put`` with a data-axis sharding performs the split (each device receives its
+shard without a host-side copy per rank).
+"""
+
+import math
+from typing import Callable, Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """Wrap an iterator so it restarts from the beginning when exhausted."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            batch = next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            batch = next(self.data_iter)
+        return batch
+
+
+class DeepSpeedDataLoader:
+    """Batches an indexable dataset into global micro-batches.
+
+    ``dataset`` is any sequence of per-sample pytrees (tuples of arrays). Batches are
+    stacked with numpy; sharding onto the mesh happens in the engine.
+    """
+
+    def __init__(self,
+                 dataset: Sequence,
+                 batch_size: int,
+                 collate_fn: Optional[Callable] = None,
+                 shuffle: bool = False,
+                 seed: int = 0,
+                 drop_last: bool = True,
+                 data_parallel_world_size: int = 1):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or _default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.drop_last = drop_last
+        if drop_last:
+            self.len = len(dataset) // batch_size
+        else:
+            self.len = math.ceil(len(dataset) / batch_size)
+
+    def __len__(self):
+        return self.len
+
+    def __iter__(self) -> Iterator:
+        order = np.arange(len(self.dataset))
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        self.epoch += 1
+        for b in range(self.len):
+            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            samples = [self.dataset[int(i)] for i in idx]
+            yield self.collate_fn(samples)
+
+
+def _default_collate(samples):
+    first = samples[0]
+    if isinstance(first, (tuple, list)):
+        return tuple(np.stack([np.asarray(s[i]) for s in samples]) for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: np.stack([np.asarray(s[k]) for s in samples]) for k in first}
+    return np.stack([np.asarray(s) for s in samples])

@@ -1,0 +1,67 @@
+"""Static + dynamic loss scaling.
+
+Mirrors ``deepspeed/runtime/fp16/loss_scaler.py`` (LossScaler l.56, DynamicLossScaler l.79,
+hysteresis l.151-166) — but redesigned to live INSIDE a jitted train step: the scaler state
+is a pytree of device scalars and the skip-on-overflow decision is a ``jnp.where`` select,
+so overflow handling costs no host round-trip (reference hard part §7: "dynamic loss
+scaling with step-skip inside jit").
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    cur_scale: jnp.ndarray        # fp32 scalar
+    cur_hysteresis: jnp.ndarray   # int32 scalar
+    last_overflow_iter: jnp.ndarray  # int32 scalar
+    iter_count: jnp.ndarray       # int32 scalar
+
+
+def init_state(static_loss_scale: float = 0,
+               initial_scale_power: int = 32,
+               hysteresis: int = 2) -> LossScaleState:
+    """static_loss_scale > 0 → fixed scale; 0 → dynamic starting at 2**initial_scale_power."""
+    init_scale = float(static_loss_scale) if static_loss_scale and static_loss_scale > 0 \
+        else float(2**initial_scale_power)
+    return LossScaleState(cur_scale=jnp.asarray(init_scale, jnp.float32),
+                          cur_hysteresis=jnp.asarray(hysteresis, jnp.int32),
+                          last_overflow_iter=jnp.asarray(-1, jnp.int32),
+                          iter_count=jnp.asarray(0, jnp.int32))
+
+
+def update(state: LossScaleState,
+           overflow: jnp.ndarray,
+           dynamic: bool,
+           scale_window: int = 1000,
+           scale_factor: float = 2.0,
+           min_scale: float = 1.0,
+           hysteresis: int = 2) -> LossScaleState:
+    """Advance scaler state after a step whose grads overflowed (or not).
+
+    Semantics (reference loss_scaler.py:140-170): on overflow, consume hysteresis; only
+    when exhausted divide the scale by scale_factor (floored at min_scale). After
+    ``scale_window`` consecutive clean iters, multiply by scale_factor and reset hysteresis.
+    """
+    it = state.iter_count + 1
+    if not dynamic:
+        return state._replace(iter_count=it)
+
+    # overflow path
+    hys_after = jnp.maximum(state.cur_hysteresis - 1, 0)
+    drop_scale = jnp.maximum(state.cur_scale / scale_factor, min_scale)
+    of_scale = jnp.where(state.cur_hysteresis <= 1, drop_scale, state.cur_scale)
+    of_hys = jnp.where(state.cur_hysteresis <= 1, state.cur_hysteresis, hys_after)
+
+    # clean path
+    window_ok = (it - state.last_overflow_iter) % scale_window == 0
+    clean_scale = jnp.where(window_ok, state.cur_scale * scale_factor, state.cur_scale)
+    clean_hys = jnp.where(window_ok, jnp.asarray(hysteresis, jnp.int32), state.cur_hysteresis)
+
+    return LossScaleState(
+        cur_scale=jnp.where(overflow, of_scale, clean_scale),
+        cur_hysteresis=jnp.where(overflow, of_hys, clean_hys),
+        last_overflow_iter=jnp.where(overflow, it, state.last_overflow_iter),
+        iter_count=it,
+    )

@@ -1,0 +1,233 @@
+"""Shared numeric/partitioning/diagnostic helpers.
+
+TPU-native analog of ``deepspeed/runtime/utils.py`` (575 LoC): partitioning math
+(partition_uniform l.295 / partition_balanced l.361 via binary-search + linear probe),
+MP-aware norms (get_grad_norm l.154), PartitionedTensor (l.379), memory diagnostics
+(see_memory_usage l.489), set_random_seed (l.33), call_to_str (l.556).
+
+Norms operate on JAX pytrees; PartitionedTensor shards a flat array across a mesh axis
+and is the activation-sharding primitive for pipeline+TP.
+"""
+
+import math
+from bisect import bisect_left
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import logger
+
+
+def set_random_seed(seed: int):
+    """Seed python/numpy and return a JAX PRNG key (stateless JAX analog of l.33)."""
+    import random
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+def call_to_str(base, *args, **kwargs) -> str:
+    """Construct a string representation of a call: call_to_str('f', 1, b=2) == 'f(1, b=2)'."""
+    name = f"{base}("
+    if args:
+        name += ", ".join(str(arg) for arg in args)
+        if kwargs:
+            name += ", "
+    if kwargs:
+        name += ", ".join(f"{key}={arg}" for key, arg in kwargs.items())
+    name += ")"
+    return name
+
+
+# ---------------------------------------------------------------------------
+# Pytree norms / overflow checks
+# ---------------------------------------------------------------------------
+
+def global_norm(tree) -> jnp.ndarray:
+    """L2 norm over a full pytree (computed in fp32)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves))
+
+
+def get_grad_norm(grads, mp_axis: Optional[str] = None) -> jnp.ndarray:
+    """Gradient L2 norm; when called inside shard_map with a model axis, sums the
+    squared local norm over ``mp_axis`` first (MP-aware, reference utils.py:154-210)."""
+    sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in jax.tree_util.tree_leaves(grads))
+    if mp_axis is not None:
+        sq = jax.lax.psum(sq, mp_axis)
+    return jnp.sqrt(sq)
+
+
+def get_weight_norm(params, mp_axis: Optional[str] = None) -> jnp.ndarray:
+    return get_grad_norm(params, mp_axis)
+
+
+def clip_grads_by_global_norm(grads, max_norm: float, norm: Optional[jnp.ndarray] = None):
+    """Scale grads so the global norm is at most ``max_norm`` (no-op if already below)."""
+    if norm is None:
+        norm = global_norm(grads)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree_util.tree_map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+
+
+def has_inf_or_nan_tree(tree) -> jnp.ndarray:
+    """True if any leaf contains inf/nan (fp16 overflow check, reference CheckOverflow l.41)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.bool_)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(l.astype(jnp.float32)))) for l in leaves]
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_or(out, f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Partitioning math (pipeline layer balancing, ZeRO sub-partitions)
+# ---------------------------------------------------------------------------
+
+def prefix_sum_inc(weights: Sequence[float]) -> List[float]:
+    """Inclusive prefix sum: [3,4,5] -> [3,7,12]."""
+    out = list(weights)
+    for i in range(1, len(out)):
+        out[i] += out[i - 1]
+    return out
+
+
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Boundaries of a uniform split of ``num_items`` into ``num_parts`` (len = parts+1)."""
+    parts = [0] * (num_parts + 1)
+    if num_items <= num_parts:
+        for p in range(num_parts + 1):
+            parts[p] = min(p, num_items)
+        return parts
+    chunksize = num_items // num_parts
+    for p in range(num_parts):
+        parts[p] = min(chunksize * p, num_items)
+    parts[num_parts] = num_items
+    return parts
+
+
+def _linear_probe(csum: List[float], num_parts: int, bottleneck: float):
+    """Greedily place boundaries so no partition's weight exceeds ``bottleneck``.
+
+    ``csum`` is the inclusive prefix sum. Returns (parts, feasible).
+    """
+    num_items = len(csum)
+    total = csum[-1]
+    parts = [0] * (num_parts + 1)
+    for p in range(1, num_parts + 1):
+        parts[p] = num_items
+
+    target = bottleneck
+    for p in range(1, num_parts):
+        # boundary = first index whose prefix sum reaches the target
+        parts[p] = bisect_left(csum, target, lo=parts[p - 1], hi=num_items)
+        if parts[p] == num_items:
+            # everything placed; feasible iff the last nonempty partition fits
+            part_weight = total - (csum[parts[p - 1] - 1] if parts[p - 1] > 0 else 0.0)
+            return parts, part_weight < bottleneck
+        target = csum[parts[p] - 1] + bottleneck if parts[p] > 0 else bottleneck
+    return parts, target >= total
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int, eps: float = 1e-3) -> List[int]:
+    """Split items into parts minimizing the heaviest partition (binary search on the
+    bottleneck + linear probe; same contract as reference utils.py:361)."""
+    num_items = len(weights)
+    if num_items <= num_parts:
+        return partition_uniform(num_items, num_parts)
+
+    csum = prefix_sum_inc(list(map(float, weights)))
+    total = csum[-1]
+    lower = total / num_parts
+    upper = total
+    while upper > lower + eps:
+        mid = lower + (upper - lower) / 2
+        _, feasible = _linear_probe(csum, num_parts, mid)
+        if feasible:
+            upper = mid
+        else:
+            lower = mid + eps
+    parts, feasible = _linear_probe(csum, num_parts, upper)
+    assert feasible
+    return parts
+
+
+# ---------------------------------------------------------------------------
+# PartitionedTensor — flat sharded view of an array over a mesh-axis group
+# ---------------------------------------------------------------------------
+
+class PartitionedTensor:
+    """Flatten → pad → split an array into ``world`` equal chunks; hold one chunk.
+
+    Host-level analog of reference utils.py:379-473. Inside jitted/shard_map code the
+    same role is played by sharding constraints; this class exists for the pipeline
+    engine's activation-partitioning between stages and for checkpoint layouts, where an
+    explicit (meta, local_data) pair must cross process boundaries.
+    """
+
+    def __init__(self, tensor: Optional[jnp.ndarray], world: int, rank: int, partition_meta=None,
+                 local_data: Optional[jnp.ndarray] = None):
+        self.world = world
+        self.rank = rank
+        if partition_meta is not None:
+            # from_meta path
+            self.orig_shape = tuple(partition_meta["orig_shape"])
+            self.orig_size = int(np.prod(self.orig_shape))
+            self.padded = int(partition_meta["padded"])
+            self.local_data = local_data
+            self.orig_dtype = partition_meta["dtype"]
+            return
+        assert tensor is not None
+        self.orig_shape = tuple(tensor.shape)
+        self.orig_dtype = tensor.dtype
+        self.orig_size = tensor.size
+        flat = tensor.reshape(-1)
+        chunk = -(-flat.size // world)  # ceil
+        self.padded = chunk * world
+        if self.padded != flat.size:
+            flat = jnp.pad(flat, (0, self.padded - flat.size))
+        self.local_data = flat[rank * chunk:(rank + 1) * chunk]
+
+    @classmethod
+    def from_meta(cls, meta, local_part, world: int, rank: int):
+        return cls(None, world, rank, partition_meta=meta, local_data=local_part)
+
+    def to_meta(self):
+        return {"orig_shape": list(self.orig_shape), "padded": self.padded, "dtype": self.orig_dtype}
+
+    def local_size(self):
+        return self.local_data.shape
+
+    def full(self, gathered_parts: Optional[List[jnp.ndarray]] = None) -> jnp.ndarray:
+        """Reassemble the full tensor. Single-process: the caller passes all parts (or we
+        only have ours and world==1); multi-process callers gather parts over the mesh."""
+        if gathered_parts is None:
+            assert self.world == 1, "multi-chunk full() needs gathered_parts (use all_gather over the axis)"
+            gathered_parts = [self.local_data]
+        flat = jnp.concatenate(gathered_parts)[:self.orig_size]
+        return flat.reshape(self.orig_shape).astype(self.orig_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Memory diagnostics
+# ---------------------------------------------------------------------------
+
+def see_memory_usage(message: str, force: bool = False):
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        ib = stats.get("bytes_in_use", 0) / (1024**3)
+        pk = stats.get("peak_bytes_in_use", 0) / (1024**3)
+        lim = stats.get("bytes_limit", 0) / (1024**3)
+        logger.info(f"{message} | device mem in-use {ib:.2f} GB | peak {pk:.2f} GB | limit {lim:.2f} GB")
+    except Exception:
+        logger.info(f"{message} | device memory stats unavailable")
+
+
+def memory_status(msg: str, print_rank: int = 0):
+    see_memory_usage(f"MEMSTATS {msg}")

@@ -1,0 +1,59 @@
+"""ZeRO sharding policies as GSPMD layouts.
+
+This is the TPU-native core of what ``runtime/zero/stage1.py`` (983 LoC) and ``stage2.py``
+(1850 LoC) implement with hand-rolled flatten/partition/reduce-scatter/all-gather over NCCL:
+
+- stage 0: optimizer state + master weights replicated; gradients all-reduced over ``data``.
+- stage 1 (optimizer-state sharding, stage1.py:302-442): master fp32 weights and Adam
+  moments carry a data-axis-sharded layout; XLA turns the backward's gradient all-reduce
+  + local update + param broadcast into reduce-scatter → sharded update → all-gather.
+- stage 2 (+gradient sharding, stage2.py:590-745): additionally the gradient accumulation
+  buffer carries the sharded layout, so accumulated grads are stored reduce-scattered —
+  the IPG-bucket machinery becomes a sharding annotation.
+
+``zero_spec`` picks, per parameter, the largest axis divisible by the DP degree to shard;
+parameters too small to split stay replicated (the reference pads flat buffers instead —
+on TPU padding tiny tensors wastes ICI latency for nothing).
+"""
+
+from typing import Optional
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...parallel.mesh import DATA_AXIS
+
+
+def zero_spec(shape, dp_size: int, min_size: int = 1024) -> P:
+    """PartitionSpec sharding the largest dp-divisible axis over 'data' (or replicated)."""
+    if dp_size <= 1 or int(np.prod(shape)) < min_size:
+        return P()
+    best_axis = -1
+    best_dim = 0
+    for i, d in enumerate(shape):
+        if d % dp_size == 0 and d > best_dim:
+            best_axis = i
+            best_dim = d
+    if best_axis < 0:
+        return P()
+    spec = [None] * len(shape)
+    spec[best_axis] = DATA_AXIS
+    return P(*spec)
+
+
+def zero_sharding(mesh: Mesh, tree, stage: int, min_size: int = 1024):
+    """Tree of NamedShardings for optimizer state / master params under the given stage."""
+    import jax
+    dp = mesh.shape[DATA_AXIS]
+
+    def leaf(p):
+        if stage >= 1:
+            return NamedSharding(mesh, zero_spec(p.shape, dp, min_size))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+def replicated_sharding(mesh: Mesh, tree):
+    import jax
+    return jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), tree)

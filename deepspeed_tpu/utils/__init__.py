@@ -1,0 +1,2 @@
+from .logging import logger, log_dist, LoggerFactory
+from .timer import SynchronizedWallClockTimer, ThroughputTimer

@@ -1,0 +1,51 @@
+"""Rank-aware logging.
+
+TPU-native analog of the reference's ``deepspeed/utils/logging.py`` (LoggerFactory l.7,
+``log_dist`` l.40): a single named logger plus a rank-filtered helper. Ranks here are JAX
+process indices (``jax.process_index``) instead of torch.distributed ranks.
+"""
+
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"
+
+
+class LoggerFactory:
+
+    @staticmethod
+    def create_logger(name: str = "DeepSpeedTPU", level: int = logging.INFO) -> logging.Logger:
+        if name is None:
+            raise ValueError("name for logger cannot be None")
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            handler = logging.StreamHandler(stream=sys.stdout)
+            handler.setFormatter(logging.Formatter(_FORMAT))
+            handler.setLevel(level)
+            logger_.addHandler(handler)
+        return logger_
+
+
+logger = LoggerFactory.create_logger(
+    level=getattr(logging, os.environ.get("DSTPU_LOG_LEVEL", "INFO").upper(), logging.INFO))
+
+
+def _process_index() -> int:
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process ranks (None or [-1] = all ranks)."""
+    my_rank = _process_index()
+    ranks = list(ranks) if ranks is not None else []
+    should_log = not ranks or (-1 in ranks) or (my_rank in ranks)
+    if should_log:
+        logger.log(level, f"[Rank {my_rank}] {message}")

@@ -1,0 +1,31 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax backends initialize.
+
+This mirrors the reference's multi-process-on-one-host distributed testing strategy
+(tests/unit/common.py:14-100's @distributed_test decorator): instead of forking N NCCL
+processes, we give JAX 8 virtual CPU devices and run real mesh collectives over them.
+
+Note: this environment's sitecustomize pins ``jax_platforms=axon`` (real TPU tunnel) at
+interpreter startup, so the JAX_PLATFORMS env var alone is not enough — we must override
+via ``jax.config`` before any backend is touched.
+"""
+
+import os
+
+# XLA_FLAGS is read when the CPU backend initializes (lazily) — set it first.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,46 @@
+"""Tiny model fixtures (analog of reference tests/unit/simple_model.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel:
+    """Two-layer MLP returning MSE loss: model(params, x, y) -> loss."""
+
+    def __init__(self, hidden_dim=16):
+        self.hidden_dim = hidden_dim
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        h = self.hidden_dim
+        return {
+            "w1": jax.random.normal(k1, (h, h), jnp.float32) * 0.1,
+            "b1": jnp.zeros((h,), jnp.float32),
+            "w2": jax.random.normal(k2, (h, h), jnp.float32) * 0.1,
+            "b2": jnp.zeros((h,), jnp.float32),
+        }
+
+    def apply(self, params, x, y):
+        h = jnp.tanh(x @ params["w1"].astype(x.dtype) + params["b1"].astype(x.dtype))
+        out = h @ params["w2"].astype(x.dtype) + params["b2"].astype(x.dtype)
+        return jnp.mean(jnp.square(out - y).astype(jnp.float32))
+
+
+def random_dataset(total_samples, hidden_dim, seed=0, dtype=np.float32):
+    """Inputs are gaussian; targets are a fixed linear map of the inputs (learnable)."""
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(total_samples, hidden_dim)).astype(dtype)
+    w_true = np.random.default_rng(1234).normal(size=(hidden_dim, hidden_dim)).astype(dtype) * 0.3
+    ys = np.tanh(xs @ w_true)
+    return [(xs[i], ys[i]) for i in range(total_samples)]
+
+
+def simple_config(batch=8, **overrides):
+    cfg = {
+        "train_batch_size": batch,
+        "steps_per_print": 100,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+    }
+    cfg.update(overrides)
+    return cfg

@@ -1,0 +1,172 @@
+"""End-to-end engine tests on the 8-device virtual CPU mesh (reference test_fp16.py style)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from simple_model import SimpleModel, random_dataset, simple_config
+
+HIDDEN = 16
+
+
+def run_training(config, steps=10, hidden=HIDDEN, seed=0):
+    model = SimpleModel(hidden)
+    params = model.init(jax.random.PRNGKey(seed))
+    data = random_dataset(256, hidden, seed=seed)
+    engine, optimizer, loader, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, training_data=data, config_params=config)
+    losses = []
+    it = iter(loader)
+    for _ in range(steps * engine.gradient_accumulation_steps()):
+        x, y = next(it)
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    return engine, losses
+
+
+@pytest.mark.parametrize("zero_stage", [0, 1, 2])
+def test_zero_stage_training_loss_decreases(zero_stage):
+    cfg = simple_config(zero_optimization={"stage": zero_stage})
+    engine, losses = run_training(cfg, steps=20)
+    assert losses[-1] < losses[0] * 0.9, f"loss did not decrease: {losses[0]} -> {losses[-1]}"
+    assert engine.global_steps == 20
+
+
+def test_zero_stages_agree():
+    """Stages 0/1/2 are different layouts of the same math: losses must match closely."""
+    results = {}
+    for stage in [0, 1, 2]:
+        cfg = simple_config(zero_optimization={"stage": stage})
+        _, losses = run_training(cfg, steps=5, seed=3)
+        results[stage] = losses
+    for stage in [1, 2]:
+        np.testing.assert_allclose(results[0], results[stage], rtol=2e-2)
+
+
+def test_gradient_accumulation():
+    cfg = simple_config(batch=16, gradient_accumulation_steps=2)
+    engine, losses = run_training(cfg, steps=5)
+    assert engine.gradient_accumulation_steps() == 2
+    assert engine.global_steps == 5
+    assert engine.micro_steps == 10
+
+
+def test_grad_accum_equivalence():
+    """grad_acc=2 at micro-batch 8 must match grad_acc=1 at batch 16 (same total batch)."""
+    model = SimpleModel(HIDDEN)
+    params = model.init(jax.random.PRNGKey(0))
+    data = random_dataset(64, HIDDEN, seed=1)
+
+    def run(cfg):
+        p = jax.tree_util.tree_map(jnp.array, params)
+        engine, _, loader, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=p, training_data=data, config_params=cfg)
+        xs = np.stack([data[i][0] for i in range(16)])
+        ys = np.stack([data[i][1] for i in range(16)])
+        if engine.gradient_accumulation_steps() == 1:
+            loss = engine(xs, ys)
+            engine.backward(loss)
+            engine.step()
+        else:
+            for half in range(2):
+                x = xs[half * 8:(half + 1) * 8]
+                y = ys[half * 8:(half + 1) * 8]
+                loss = engine(x, y)
+                engine.backward(loss)
+                engine.step()
+        return jax.device_get(engine.master_params)
+
+    p_full = run(simple_config(batch=16))
+    p_acc = run(simple_config(batch=16, gradient_accumulation_steps=2))
+    jax.tree_util.tree_map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+                           p_full, p_acc)
+
+
+def test_fp16_dynamic_loss_scale_init():
+    cfg = simple_config(fp16={"enabled": True, "initial_scale_power": 8})
+    engine, losses = run_training(cfg, steps=25)
+    assert engine.fp16_enabled()
+    assert engine.dynamic_loss_scale()
+    assert losses[-1] < losses[0]
+
+
+def test_fp16_static_loss_scale():
+    cfg = simple_config(fp16={"enabled": True, "loss_scale": 128.0})
+    engine, losses = run_training(cfg, steps=25)
+    assert engine.loss_scale() == 128.0
+    assert losses[-1] < losses[0]
+
+
+def test_lamb_optimizer():
+    """LAMB's trust ratio shrinks small-model updates; like the reference's lamb tests we
+    check stable execution + that parameters actually move, not convergence speed."""
+    cfg = simple_config(optimizer={"type": "Lamb", "params": {"lr": 2e-3}})
+    model = SimpleModel(HIDDEN)
+    params = model.init(jax.random.PRNGKey(0))
+    data = random_dataset(256, HIDDEN)
+    engine, _, loader, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                                    training_data=data, config_params=cfg)
+    before = jax.device_get(engine.master_params)
+    it = iter(loader)
+    losses = []
+    for _ in range(10):
+        x, y = next(it)
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    after = jax.device_get(engine.master_params)
+    assert all(np.isfinite(l) for l in losses)
+    assert engine.optimizer.name == "lamb"
+    moved = any(not np.allclose(a, b) for a, b in zip(jax.tree_util.tree_leaves(before),
+                                                      jax.tree_util.tree_leaves(after)))
+    assert moved, "LAMB step did not change parameters"
+
+
+def test_scheduler_integration():
+    cfg = simple_config(scheduler={"type": "WarmupLR",
+                                   "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 0.01,
+                                              "warmup_num_steps": 10}})
+    engine, _ = run_training(cfg, steps=5)
+    lr_now = engine.get_lr()[0]
+    assert 0 < lr_now <= 0.01
+
+
+def test_gradient_clipping_runs():
+    cfg = simple_config(gradient_clipping=0.1)
+    engine, losses = run_training(cfg, steps=5)
+    assert losses[-1] <= losses[0] * 1.5  # just needs to run stably
+
+
+def test_eval_mode_no_grads():
+    model = SimpleModel(HIDDEN)
+    params = model.init(jax.random.PRNGKey(0))
+    cfg = simple_config()
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                               training_data=random_dataset(16, HIDDEN),
+                                               config_params=cfg)
+    engine.eval()
+    x, y = np.zeros((8, HIDDEN), np.float32), np.zeros((8, HIDDEN), np.float32)
+    loss = engine(x, y)
+    assert np.isfinite(float(jax.device_get(loss)))
+    with pytest.raises(AssertionError):
+        engine.backward(loss)
+
+
+def test_zero_sharded_state_layout(eight_devices):
+    """Stage >=1 must actually shard the optimizer state over the data axis."""
+    hidden = 64  # 64x64 weights are above the min-shard size and divisible by dp=8
+    cfg = simple_config(zero_optimization={"stage": 2})
+    model = SimpleModel(hidden)
+    params = model.init(jax.random.PRNGKey(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                               training_data=random_dataset(16, hidden),
+                                               config_params=cfg)
+    sharded = engine.master_params["w1"].sharding
+    assert not sharded.is_fully_replicated, "ZeRO>=1 master weights should be dp-sharded"
+    opt_sharded = engine.opt_state.exp_avg["w1"].sharding
+    assert not opt_sharded.is_fully_replicated, "ZeRO>=1 optimizer state should be dp-sharded"

@@ -1,0 +1,109 @@
+"""LR schedule semantics tests."""
+
+import math
+
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (LRRangeTest, OneCycle, WarmupLR, WarmupDecayLR,
+                                                get_scheduler, VALID_LR_SCHEDULES)
+
+
+class FakeOpt:
+    def __init__(self, n_groups=1, lr=0.1):
+        self.param_groups = [{"lr": lr, "betas": (0.9, 0.999)} for _ in range(n_groups)]
+
+
+def test_warmup_lr():
+    opt = FakeOpt()
+    sched = WarmupLR(opt, warmup_min_lr=0.0, warmup_max_lr=0.01, warmup_num_steps=10)
+    lrs = []
+    for _ in range(15):
+        sched.step()
+        lrs.append(opt.param_groups[0]["lr"])
+    assert lrs[0] < lrs[5] < lrs[9]
+    assert lrs[9] == pytest.approx(0.01, rel=1e-6)
+    assert lrs[14] == pytest.approx(0.01, rel=1e-6)  # constant after warmup
+
+
+def test_warmup_decay_lr():
+    opt = FakeOpt()
+    sched = WarmupDecayLR(opt, total_num_steps=20, warmup_min_lr=0.0, warmup_max_lr=0.01,
+                          warmup_num_steps=10)
+    lrs = []
+    for _ in range(20):
+        sched.step()
+        lrs.append(opt.param_groups[0]["lr"])
+    assert lrs[9] == pytest.approx(0.01, rel=1e-6)
+    assert lrs[19] < lrs[9]
+    # at iteration 19: gamma = (total - iter) / (total - warmup) = (20-19)/10
+    assert lrs[19] == pytest.approx(0.01 * (20 - 19) / 10, abs=1e-6)
+
+
+def test_lr_range_test():
+    opt = FakeOpt()
+    sched = LRRangeTest(opt, lr_range_test_min_lr=0.001, lr_range_test_step_size=5,
+                        lr_range_test_step_rate=1.0)
+    assert opt.param_groups[0]["lr"] == pytest.approx(0.001)
+    for _ in range(10):
+        sched.step()
+    # 10 step() calls from -1 land on iteration 9: lr = min_lr * (1 + 9/step_size)
+    assert opt.param_groups[0]["lr"] == pytest.approx(0.001 * (1 + 9 / 5), rel=1e-6)
+
+
+def test_lr_range_test_staircase():
+    opt = FakeOpt()
+    sched = LRRangeTest(opt, lr_range_test_min_lr=0.001, lr_range_test_step_size=5,
+                        lr_range_test_step_rate=1.0, lr_range_test_staircase=True)
+    for _ in range(4):
+        sched.step()
+    assert opt.param_groups[0]["lr"] == pytest.approx(0.001)  # still first stair
+    for _ in range(5):
+        sched.step()
+    assert opt.param_groups[0]["lr"] == pytest.approx(0.002)
+
+
+def test_one_cycle():
+    opt = FakeOpt()
+    sched = OneCycle(opt, cycle_min_lr=0.001, cycle_max_lr=0.01, cycle_first_step_size=10)
+    lrs = []
+    for _ in range(20):
+        sched.step()
+        lrs.append(opt.param_groups[0]["lr"])
+    peak = max(lrs)
+    assert peak == pytest.approx(0.01, rel=0.05)
+    assert lrs[0] < peak
+    assert lrs[-1] < peak
+
+
+def test_one_cycle_momentum():
+    opt = FakeOpt()
+    sched = OneCycle(opt, cycle_min_lr=0.001, cycle_max_lr=0.01, cycle_first_step_size=10,
+                     cycle_momentum=True, cycle_min_mom=0.8, cycle_max_mom=0.9)
+    sched.step()
+    beta0 = opt.param_groups[0]["betas"][0]
+    assert 0.8 <= beta0 <= 0.9
+
+
+def test_scheduler_state_roundtrip():
+    opt = FakeOpt()
+    sched = WarmupLR(opt, warmup_num_steps=10)
+    for _ in range(7):
+        sched.step()
+    sd = sched.state_dict()
+    sched2 = WarmupLR(FakeOpt(), warmup_num_steps=10)
+    sched2.load_state_dict(sd)
+    assert sched2.last_batch_iteration == sched.last_batch_iteration
+
+
+def test_get_scheduler_by_name():
+    for name in VALID_LR_SCHEDULES:
+        opt = FakeOpt()
+        kwargs = {}
+        if name == "OneCycle":
+            kwargs = {"cycle_min_lr": 0.001, "cycle_max_lr": 0.01}
+        elif name == "WarmupDecayLR":
+            kwargs = {"total_num_steps": 100}
+        sched = get_scheduler(name, opt, kwargs)
+        sched.step()
+    with pytest.raises(ValueError):
+        get_scheduler("NotASchedule", FakeOpt(), {})

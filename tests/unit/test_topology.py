@@ -1,0 +1,137 @@
+"""Topology/grid rank-math tests (parity with reference tests/unit/test_topology.py)."""
+
+import pytest
+
+from deepspeed_tpu.parallel.topology import (ProcessTopology as Topo, PipelineParallelGrid as Grid,
+                                             PipeDataParallelTopology, PipeModelDataParallelTopology,
+                                             _prime_factors)
+
+
+def test_topology_2d():
+    topo = Topo(axes=["row", "col"], dims=[2, 2])
+    assert topo.world_size() == 4
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+    assert topo.get_axis_list(axis="row", idx=0) == [0, 1]
+    assert topo.get_axis_list(axis="row", idx=1) == [2, 3]
+    assert topo.get_axis_list(axis="col", idx=0) == [0, 2]
+    assert topo.get_axis_list(axis="col", idx=1) == [1, 3]
+
+
+def test_topology_dims():
+    topo = Topo(axes=["a", "b", "c"], dims=[2, 3, 4])
+    assert topo.world_size() == 24
+    assert topo.get_dim("a") == 2
+    assert topo.get_dim("b") == 3
+    assert topo.get_dim("c") == 4
+
+
+def test_topology_match():
+    topo = Topo(axes=["pipe", "data", "model"], dims=[2, 2, 2])
+    assert topo.filter_match(pipe=0, data=1) == [2, 3]
+
+
+def test_topology_rank_repr():
+    topo = Topo(axes=["a", "b"], dims=[2, 2])
+    assert topo.get_rank_repr(rank=0) == "a_00-b_00"
+    assert topo.get_rank_repr(rank=1) == "a_00-b_01"
+    assert topo.get_rank_repr(rank=2) == "a_01-b_00"
+    assert topo.get_rank_repr(rank=3) == "a_01-b_01"
+    assert topo.get_rank_repr(rank=3, inner_sep="+") == "a+01-b+01"
+
+    topo = Topo(axes=["pipe", "data"], dims=[2, 2])
+    for r in range(4):
+        assert topo.get_rank_repr(rank=r) == ""
+    assert topo.get_rank_repr(rank=0, omit_axes=["pipe"]) == "data_00"
+    assert topo.get_rank_repr(rank=0, omit_axes=[]) == "pipe_00-data_00"
+    assert topo.get_rank_repr(rank=3, omit_axes=[]) == "pipe_01-data_01"
+
+    topo = Topo(axes=["pipe", "data", "model"], dims=[2, 2, 2])
+    assert topo.get_rank_repr(rank=0) == "model_00"
+    assert topo.get_rank_repr(rank=1) == "model_01"
+    assert topo.get_rank_repr(rank=7) == "model_01"
+
+
+def test_topology_3d():
+    topo = Topo(axes=["a", "b", "c"], dims=[2, 2, 2])
+    assert topo.get_rank(a=0, b=0, c=0) == 0
+    assert topo.get_rank(a=0, b=1, c=1) == 3
+    assert topo.get_rank(a=1, b=1, c=1) == 7
+    assert topo.get_axis_list("a", 0) == [0, 1, 2, 3]
+    assert topo.get_axis_list("b", 1) == [2, 3, 6, 7]
+    assert topo.get_axis_list("c", 0) == [0, 2, 4, 6]
+    assert topo.get_coord(3) == topo.ProcessCoord(0, 1, 1)
+    assert topo.filter_match(a=0) == [0, 1, 2, 3]
+    assert topo.filter_match(b=1, c=1) == [3, 7]
+    assert topo.get_coord(0).a == 0
+
+
+def test_topology_comm_list():
+    topo = Topo(axes=["pipe", "data", "model"], dims=[2, 2, 2])
+    assert topo.get_axis_comm_lists("pipe") == [[0, 4], [1, 5], [2, 6], [3, 7]]
+    assert topo.get_axis_comm_lists("data") == [[0, 2], [1, 3], [4, 6], [5, 7]]
+    assert topo.get_axis_comm_lists("model") == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    assert topo.get_axis_comm_lists("jeff") == []
+
+
+def test_grid_pipe_data():
+    topo = Topo(axes=["pipe", "data"], dims=[2, 2])
+    for rank in range(4):
+        grid = Grid(topology=topo, global_rank=rank)
+        assert grid._is_grid_valid()
+        assert grid.is_first_stage == (grid.get_stage_id() == 0)
+        assert grid.is_last_stage == (grid.get_stage_id() == grid.get_pipe_parallel_world_size() - 1)
+        assert rank in grid.pp_group
+        assert rank in grid.dp_group
+
+
+def test_stage_to_global():
+    topo = Topo(axes=["pipe", "data"], dims=[2, 2])
+    grid = Grid(topology=topo, global_rank=0)
+    assert grid.stage_to_global(stage_id=0, data=0) == 0
+    assert grid.stage_to_global(stage_id=0, data=1) == 1
+    assert grid.stage_to_global(stage_id=1, data=0) == 2
+    assert grid.stage_to_global(stage_id=1, data=1) == 3
+    assert grid.stage_to_global(stage_id=0) == 0
+    assert grid.stage_to_global(stage_id=1) == 2
+    grid1 = Grid(topology=topo, global_rank=1)
+    assert grid1.stage_to_global(stage_id=0) == 1
+    assert grid1.stage_to_global(stage_id=1) == 3
+
+
+def test_grid_p2p():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=2)
+    grid = Grid(topology=topo, global_rank=0)
+    # p2p buddy of rank r is the next stage with same data coord
+    assert grid.p2p_groups[0] == [0, 2]
+    # wraparound for last stage
+    assert grid.p2p_groups[6] == [6, 0]
+
+
+def test_3d_grid_mpu_interface():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    grid = Grid(topology=topo, global_rank=5)
+    assert grid.get_pipe_parallel_world_size() == 2
+    assert grid.get_data_parallel_world_size() == 2
+    assert grid.get_slice_parallel_world_size() == 2
+    coord = topo.get_coord(5)
+    assert grid.get_pipe_parallel_rank() == coord.pipe
+    assert grid.get_data_parallel_rank() == coord.data
+    assert grid.get_slice_parallel_rank() == coord.model
+
+
+def test_primes():
+    def _product(ps):
+        p = 1
+        for x in ps:
+            p *= x
+        return p
+
+    for n in [2, 3, 4, 10, 12, 36, 97]:
+        ps = _prime_factors(n)
+        assert _product(ps) == n
+        assert ps == sorted(ps)
+    with pytest.raises(ValueError):
+        _prime_factors(0)
