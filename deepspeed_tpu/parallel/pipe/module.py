@@ -179,7 +179,10 @@ class PipelineModule:
                     p = layer.init(rng_layer, x)
                     if isinstance(spec, TiedLayerSpec):
                         tied_params[spec.key] = p
-                x = layer.apply(p, x)
+                if isinstance(spec, TiedLayerSpec) and spec.forward_fn is not None:
+                    x = spec.forward_fn(layer, p, x)
+                else:
+                    x = layer.apply(p, x)
             else:
                 p = None
                 x = layer(x)

@@ -1,0 +1,164 @@
+"""SPMD pipeline parallelism: the multi-chip pipe-axis executor.
+
+This is the TPU-native execution path for pipeline parallelism, replacing the reference's
+per-stage processes + blocking p2p broadcasts (``deepspeed/runtime/pipe/p2p.py``) with a
+single jitted program over the mesh:
+
+- stage weights are *stacked* along a leading axis sharded over ``pipe`` — each device
+  holds only its stage's parameters (true pipeline memory scaling, unlike replication);
+- micro-batches stream through ``jax.lax.scan``; stage→stage transfer is a single
+  ``lax.ppermute`` over the ``pipe`` axis riding ICI (reference p2p.send/recv);
+- the loop is **differentiable**: ``jax.grad`` of the scan yields the reverse pipeline
+  (ppermute transposes to the reverse ring), so the backward schedule needs no separate
+  instruction stream — XLA derives it. Combined with ``jax.checkpoint`` on the stage
+  body, activation memory matches GPipe (inputs-per-microbatch only);
+- the data axis composes orthogonally: micro-batches stay sharded over ``data``, so DP
+  gradient reduction is still emitted by XLA → this file + zero/sharding.py is the 3-D
+  (pipe x data x model) story (reference PipeModelDataParallelTopology, topology.py:246).
+
+Requires homogeneous stages (equal per-stage blocks) — the layout GPT/BERT stacks
+naturally have. Heterogeneous first/last work (embedding, LM head, loss) runs outside the
+pipelined scan, replicated over ``pipe``.
+"""
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, PIPE_AXIS
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of per-stage pytrees into leading-axis-S leaves (shard over pipe)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def stacked_param_sharding(mesh: Mesh, stacked_tree):
+    """NamedShardings placing each stage's slice on its pipe rank."""
+    def leaf(x):
+        spec = [PIPE_AXIS] + [None] * (x.ndim - 1)
+        return NamedSharding(mesh, P(*spec))
+    return jax.tree_util.tree_map(leaf, stacked_tree)
+
+
+def pipeline_apply(stage_fn: Callable,
+                   stacked_params,
+                   x_microbatches,
+                   *,
+                   mesh: Mesh,
+                   last_stage_fn: Callable = None,
+                   last_stage_args=(),
+                   first_stage_fn: Callable = None,
+                   first_stage_args=(),
+                   last_stage_args_specs=None):
+    """Run micro-batches through the pipe-axis pipeline inside shard_map.
+
+    Args:
+      stage_fn: homogeneous per-stage function ``(stage_params, x) -> y``; applied by
+        every pipe rank to its own parameter slice.
+      stacked_params: pytree with leading dim = n_stages on every leaf (see
+        ``stack_stage_params``), sharded over ``pipe``.
+      x_microbatches: [M, ...] micro-batched activations entering stage 0 (replicated
+        over pipe, sharded over data on the batch dim).
+      last_stage_fn: optional ``(y, *last_stage_args, mb_index) -> scalar`` applied to
+        each micro-batch's final activation at the last stage (e.g. head+loss). Returns
+        the mean over micro-batches, psum-broadcast over pipe. When None, returns the
+        [M, ...] outputs broadcast over pipe.
+      first_stage_fn: optional ``(x_mb, *first_stage_args) -> activation`` applied at
+        stage 0 before the first block (e.g. embedding lookup inside the pipeline).
+
+    Differentiable in stacked_params / x_microbatches / *args.
+    """
+    M = x_microbatches.shape[0]
+
+    def inner(stacked_local, x_mb, last_args, first_args):
+        S = jax.lax.axis_size(PIPE_AXIS)
+        s = jax.lax.axis_index(PIPE_AXIS)
+        is_first = s == 0
+        is_last = s == S - 1
+        # shard_map gives leading dim 1 for the pipe-sharded stack; take our slice
+        my_params = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+
+        total_steps = M + S - 1
+        act_shape = None
+
+        def ingest(t):
+            idx = jnp.clip(t, 0, M - 1)
+            x0 = x_mb[idx]
+            if first_stage_fn is not None:
+                x0 = first_stage_fn(x0, *first_args)
+            return x0
+
+        x0_example = ingest(jnp.int32(0))
+        carry_init = (jnp.zeros_like(x0_example),            # activation arriving at this stage
+                      jnp.zeros((), jnp.float32),            # loss accumulator (last stage)
+                      (jnp.zeros((M,) + x0_example.shape, x0_example.dtype)
+                       if last_stage_fn is None else jnp.zeros((), jnp.float32)))
+
+        def step(carry, t):
+            buf, loss_acc, out_acc = carry
+            # stage 0 ingests micro-batch t; others use the activation permuted to them
+            x_in = jnp.where(is_first, ingest(t), buf) if x0_example.ndim == 0 else \
+                jax.lax.select(jnp.broadcast_to(is_first, ()), ingest(t), buf)
+            y = stage_fn(my_params, x_in)
+            # last stage finishes micro-batch mb = t - (S - 1)
+            mb = t - (S - 1)
+            valid = jnp.logical_and(mb >= 0, mb < M)
+            take = jnp.logical_and(is_last, valid)
+            if last_stage_fn is None:
+                out_acc = jax.lax.cond(
+                    take,
+                    lambda o: o.at[jnp.clip(mb, 0, M - 1)].set(y),
+                    lambda o: o,
+                    out_acc)
+            else:
+                contrib = jax.lax.cond(
+                    take,
+                    lambda _: last_stage_fn(y, *last_args, jnp.clip(mb, 0, M - 1)),
+                    lambda _: jnp.zeros((), jnp.float32),
+                    operand=None)
+                loss_acc = loss_acc + contrib
+            # rotate activations one stage forward over ICI
+            perm = [(i, (i + 1) % S) for i in range(S)]
+            buf_next = jax.lax.ppermute(y, PIPE_AXIS, perm)
+            return (buf_next, loss_acc, out_acc), None
+
+        (buf, loss_acc, out_acc), _ = jax.lax.scan(step, carry_init, jnp.arange(total_steps))
+
+        if last_stage_fn is None:
+            # broadcast last stage's outputs to every pipe rank (differentiable psum)
+            mask = jnp.where(is_last, 1.0, 0.0)
+            out = jax.lax.psum(out_acc * mask.astype(out_acc.dtype), PIPE_AXIS)
+            return out
+        loss = jax.lax.psum(jnp.where(is_last, loss_acc, 0.0), PIPE_AXIS) / M
+        # the user's last_stage_fn returns a mean over its LOCAL batch shard; average the
+        # equal-sized shards to the global mean (and replicate over data for out_spec P())
+        loss = jax.lax.pmean(loss, DATA_AXIS)
+        return loss
+
+    # shardings: stacked params split over pipe; everything else replicated over pipe
+    # (data-dim sharding of the micro-batches is preserved by P(None, 'data', ...)).
+    x_spec = P(*([None, DATA_AXIS] + [None] * (x_microbatches.ndim - 2)))
+    stacked_spec = jax.tree_util.tree_map(lambda a: P(*([PIPE_AXIS] + [None] * (a.ndim - 1))),
+                                          stacked_params)
+
+    def _last_arg_spec(a):
+        # micro-batched leaves ([M, batch, ...], e.g. labels) keep their data sharding;
+        # everything else (head weights, scalars) is replicated
+        if hasattr(a, "ndim") and a.ndim >= 2 and a.shape[0] == M:
+            return P(*([None, DATA_AXIS] + [None] * (a.ndim - 2)))
+        return P()
+
+    last_spec = (last_stage_args_specs if last_stage_args_specs is not None
+                 else jax.tree_util.tree_map(_last_arg_spec, last_stage_args))
+    first_spec = jax.tree_util.tree_map(lambda _: P(), first_stage_args)
+    out_spec = P() if last_stage_fn is not None else x_spec
+
+    fn = jax.shard_map(inner, mesh=mesh,
+                       in_specs=(stacked_spec, x_spec, last_spec, first_spec),
+                       out_specs=out_spec,
+                       check_vma=False)
+    return fn(stacked_params, x_microbatches, last_stage_args, first_stage_args)

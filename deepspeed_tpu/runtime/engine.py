@@ -104,7 +104,7 @@ class DeepSpeedEngine:
 
     def __init__(self, args=None, model=None, optimizer=None, model_parameters=None,
                  training_data=None, lr_scheduler=None, mpu=None, dist_init_required=None,
-                 collate_fn=None, config_params=None, mesh=None):
+                 collate_fn=None, config_params=None, mesh=None, param_shardings=None):
         self.client_optimizer = optimizer
         self.client_lr_scheduler = lr_scheduler
         self.training_data = training_data
@@ -116,15 +116,7 @@ class DeepSpeedEngine:
         self.warn_unscaled_loss = True
         self._in_training = True
 
-        # ---- config ----
-        config_file = getattr(args, "deepspeed_config", None) if args is not None else None
-        if config_params is not None:
-            self.config = DeepSpeedConfig(config_params, mpu=mpu)
-        else:
-            assert config_file is not None, "DeepSpeed requires --deepspeed_config or config_params"
-            self.config = DeepSpeedConfig(config_file, mpu=mpu)
-
-        # ---- mesh ----
+        # ---- mesh (first: its data-axis size is the config's DP world size) ----
         if mesh is not None:
             self.mesh = mesh
         elif mpu is not None:
@@ -132,6 +124,14 @@ class DeepSpeedEngine:
         else:
             self.mesh = build_mesh(model=1, pipe=1)
         self.dp_size = self.mesh.shape[DATA_AXIS]
+
+        # ---- config ----
+        config_file = getattr(args, "deepspeed_config", None) if args is not None else None
+        if config_params is not None:
+            self.config = DeepSpeedConfig(config_params, world_size=self.dp_size)
+        else:
+            assert config_file is not None, "DeepSpeed requires --deepspeed_config or config_params"
+            self.config = DeepSpeedConfig(config_file, world_size=self.dp_size)
 
         # ---- model function + params ----
         assert model is not None, "deepspeed.initialize requires a model"
@@ -159,11 +159,21 @@ class DeepSpeedEngine:
         zero_stage = self.zero_optimization_stage()
         self._repl = lambda tree: replicated_sharding(self.mesh, tree)
         master_fp32 = jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32), model_parameters)
-        self._master_shardings = zero_sharding(self.mesh, master_fp32, zero_stage)
-        self._param_shardings = replicated_sharding(self.mesh, master_fp32)
-        # stage 2: accumulated grads live reduce-scattered; stage<=1: replicated
-        self._grad_shardings = (zero_sharding(self.mesh, master_fp32, zero_stage)
-                                if zero_stage >= 2 else replicated_sharding(self.mesh, master_fp32))
+        if param_shardings is not None:
+            # caller-provided layout (pipe-stacked stages, TP-sharded weights, ...);
+            # ZeRO composes on top by claiming a free data-divisible axis per leaf
+            from .zero.sharding import merge_zero_into
+            self._param_shardings = param_shardings
+            self._master_shardings = merge_zero_into(self.mesh, param_shardings, master_fp32,
+                                                     zero_stage)
+            self._grad_shardings = (self._master_shardings if zero_stage >= 2
+                                    else param_shardings)
+        else:
+            self._master_shardings = zero_sharding(self.mesh, master_fp32, zero_stage)
+            self._param_shardings = replicated_sharding(self.mesh, master_fp32)
+            # stage 2: accumulated grads live reduce-scattered; stage<=1: replicated
+            self._grad_shardings = (zero_sharding(self.mesh, master_fp32, zero_stage)
+                                    if zero_stage >= 2 else replicated_sharding(self.mesh, master_fp32))
 
         self.master_params = jax.device_put(master_fp32, self._master_shardings)
         self.params = jax.device_put(
@@ -281,7 +291,25 @@ class DeepSpeedEngine:
             self.optimizer = OptimizerHandle(name, self.config.optimizer_params or {})
         init = self._opt_init
         opt_state_zero = jax.eval_shape(init, self.master_params)
-        self._opt_shardings = zero_sharding(self.mesh, opt_state_zero, self.zero_optimization_stage())
+        # optimizer states mirror the master-param tree (Adam moments, momentum buffers):
+        # give each params-shaped field the master sharding so ZeRO/pipe layouts carry over
+        params_treedef = jax.tree_util.tree_structure(self.master_params)
+
+        def field_shardings(field):
+            if jax.tree_util.tree_structure(field) == params_treedef:
+                return self._master_shardings
+            return replicated_sharding(self.mesh, field)
+
+        if hasattr(opt_state_zero, "_fields"):
+            self._opt_shardings = type(opt_state_zero)(*[field_shardings(f) for f in opt_state_zero])
+        elif jax.tree_util.tree_structure(opt_state_zero) == params_treedef:
+            self._opt_shardings = self._master_shardings
+        else:
+            # Unknown client state shape: replicate rather than guess a wrong ZeRO axis
+            # (a caller layout like pipe-stacked stages would otherwise be violated).
+            logger.warning("client optimizer state does not mirror the param tree; "
+                           "optimizer state will be replicated")
+            self._opt_shardings = replicated_sharding(self.mesh, opt_state_zero)
         self.opt_state = jax.jit(init, out_shardings=self._opt_shardings)(self.master_params)
         log_dist(f"Using DeepSpeed Optimizer param name {self.optimizer.name}", ranks=[0])
 

@@ -1,0 +1,4 @@
+from .schedule import (PipeSchedule, InferenceSchedule, TrainSchedule, DataParallelSchedule,
+                       PipeInstruction, OptimizerStep, ReduceGrads, ReduceTiedGrads,
+                       LoadMicroBatch, ForwardPass, BackwardPass, SendActivation,
+                       RecvActivation, SendGrad, RecvGrad)

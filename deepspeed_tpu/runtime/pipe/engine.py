@@ -1,0 +1,313 @@
+"""Pipeline engine: executes PipeSchedule instruction streams.
+
+TPU-native re-design of ``deepspeed/runtime/pipe/engine.py`` (PipelineEngine l.45). The
+instruction vocabulary and 1F1B stream are identical (schedule.py); what changes is the
+execution model:
+
+- The reference runs one process per stage, eager autograd per micro-batch, and blocking
+  p2p broadcasts (pipe/p2p.py). Here a single controller executes every stage's stream
+  (merged by step index) with **jitted per-stage forward/backward functions**; the p2p
+  sends/recvs become buffer hand-offs whose device placement XLA manages, and each
+  micro-batch is sharded over the mesh ``data`` axis so DP gradient reduction is emitted
+  by XLA (no NCCL allreduce). Within one merged step all Sends execute before any Recv —
+  the scheduling invariant that lets the reference's blocking broadcasts rendezvous.
+- BackwardPass recomputes the stage forward inside the jitted VJP (activation
+  checkpointing per stage — the JAX analog of the reference's retained autograd graphs
+  per pipe buffer; SURVEY §7 "hard parts").
+- Tied layers (TiedLayerSpec) share one parameter entry; their gradient contributions sum
+  during the backward merge — ``ReduceTiedGrads`` (reference pipe/module.py:405-474)
+  needs no separate collective.
+- ``OptimizerStep`` reuses the base engine's jitted sharded update (ZeRO over ``data``).
+
+``forward``/``backward``/``step`` are blocked in pipeline mode exactly like the reference
+(pipe/engine.py:1034-1044): use ``train_batch``/``eval_batch``.
+
+For *multi-chip pipe-axis* execution with homogeneous transformer stages, see
+``parallel/pipeline_spmd.py`` (shard_map + ppermute inside one jit).
+"""
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...parallel.pipe.module import PipelineModule, TiedLayerSpec
+from ...utils import log_dist
+from ..engine import DeepSpeedEngine
+from . import schedule
+
+
+class PipelineError(Exception):
+    """Errors related to the use of deepspeed.PipelineEngine."""
+
+
+_SEND_CMDS = (schedule.SendActivation, schedule.SendGrad, schedule.LoadMicroBatch)
+
+
+class PipelineEngine(DeepSpeedEngine):
+
+    def __init__(self, args=None, model=None, optimizer=None, model_parameters=None,
+                 training_data=None, lr_scheduler=None, mpu=None, dist_init_required=None,
+                 collate_fn=None, config_params=None, mesh=None):
+        assert isinstance(model, PipelineModule), "model must be a PipelineModule"
+        self.pipe_module = model
+        self.num_stages = model.num_stages
+
+        canonical, layer_keys = self._canonicalize_params(model, model_parameters)
+        self._layer_keys = layer_keys
+
+        super().__init__(args=args, model=self._whole_model_fn, optimizer=optimizer,
+                         model_parameters=canonical, training_data=training_data,
+                         lr_scheduler=lr_scheduler, mpu=None, dist_init_required=dist_init_required,
+                         collate_fn=collate_fn, config_params=config_params, mesh=mesh)
+
+        self.micro_batches = self.gradient_accumulation_steps()
+        self._compile_stage_fns()
+        self.agg_train_loss = None
+        log_dist(f"PipelineEngine: {self.num_stages} stages, parts={model.parts}", ranks=[0])
+
+    # ------------------------------------------------------------- params
+    def _canonicalize_params(self, module: PipelineModule, model_parameters):
+        """Per-layer params list → dict keyed by layer id; tied layers collapse onto one
+        'tied::<key>' entry (shared storage, summed grads)."""
+        if model_parameters is None:
+            raise ValueError("PipelineEngine requires model_parameters: the list returned "
+                             "by PipelineModule.init_params(rng, sample_input)")
+        assert len(model_parameters) == module.num_layers(), \
+            f"expected {module.num_layers()} per-layer param entries"
+        canonical: Dict[str, Any] = {}
+        layer_keys: List[Optional[str]] = []
+        for idx, (spec, p) in enumerate(zip(module._layer_specs, model_parameters)):
+            if p is None:
+                layer_keys.append(None)
+                continue
+            key = f"tied::{spec.key}" if isinstance(spec, TiedLayerSpec) else f"layer_{idx}"
+            if key not in canonical:
+                canonical[key] = p
+            layer_keys.append(key)
+        return canonical, layer_keys
+
+    def _apply_layer(self, idx: int, params, x):
+        layer = self.pipe_module._built_layers[idx]
+        key = self._layer_keys[idx]
+        spec = self.pipe_module._layer_specs[idx]
+        if key is None:
+            return layer(x)
+        fwd = spec.forward_fn if isinstance(spec, TiedLayerSpec) and spec.forward_fn else None
+        if fwd is not None:
+            return fwd(layer, params[key], x)
+        return layer.apply(params[key], x)
+
+    def _whole_model_fn(self, params, *batch):
+        """Sequential full-model apply (eval path / reference semantics)."""
+        x = batch[0]
+        for idx in range(self.pipe_module.num_layers()):
+            x = self._apply_layer(idx, params, x)
+        if self.pipe_module.loss_fn is not None and len(batch) > 1:
+            return self.pipe_module.loss_fn(x, batch[1])
+        return x
+
+    # ------------------------------------------------------------- stage functions
+    def _stage_fn(self, stage_id: int) -> Callable:
+        lo, hi = self.pipe_module.parts[stage_id], self.pipe_module.parts[stage_id + 1]
+
+        def fn(stage_params, x):
+            for idx in range(lo, hi):
+                x = self._apply_layer(idx, stage_params, x)
+            return x
+
+        return fn
+
+    def _stage_param_keys(self, stage_id: int) -> List[str]:
+        lo, hi = self.pipe_module.parts[stage_id], self.pipe_module.parts[stage_id + 1]
+        keys = []
+        for idx in range(lo, hi):
+            k = self._layer_keys[idx]
+            if k is not None and k not in keys:
+                keys.append(k)
+        return keys
+
+    def _compile_stage_fns(self):
+        self._stage_fwd = []
+        self._stage_bwd = []
+        self._stage_last_bwd = None
+        loss_fn = self.pipe_module.loss_fn
+        for s in range(self.num_stages):
+            fn = self._stage_fn(s)
+            self._stage_fwd.append(jax.jit(fn))
+
+            def bwd(stage_params, x, g, _fn=fn):
+                _, vjp = jax.vjp(_fn, stage_params, x)
+                dparams, dx = vjp(g)
+                return dparams, dx
+
+            self._stage_bwd.append(jax.jit(bwd))
+
+            if s == self.num_stages - 1 and loss_fn is not None:
+                def last_bwd(stage_params, x, labels, scale, _fn=fn):
+                    def f(p, xx):
+                        return loss_fn(_fn(p, xx), labels) * scale
+                    loss, (dparams, dx) = jax.value_and_grad(f, argnums=(0, 1))(stage_params, x)
+                    return loss / scale, dparams, dx
+
+                self._stage_last_bwd = jax.jit(last_bwd)
+
+    # ------------------------------------------------------------- blocked base API
+    def forward(self, *args, **kwargs):
+        raise PipelineError("Only train_batch() is accessible in pipeline mode.")
+
+    def backward(self, *args, **kwargs):
+        raise PipelineError("Only train_batch() is accessible in pipeline mode.")
+
+    def step(self, *args, **kwargs):
+        raise PipelineError("Only train_batch() is accessible in pipeline mode.")
+
+    # ------------------------------------------------------------- train/eval
+    def _next_micro_batch(self, data_iter):
+        batch = next(data_iter)
+        if isinstance(batch, (tuple, list)):
+            return tuple(self.shard_batch(b) for b in batch)
+        return (self.shard_batch(batch),)
+
+    def train_batch(self, data_iter=None):
+        """Run one full 1F1B schedule over gradient_accumulation_steps micro-batches
+        (reference pipe/engine.py:229-303)."""
+        if data_iter is None:
+            if self.training_dataloader is None:
+                raise PipelineError("train_batch() requires a data iterator or training_data")
+            if not hasattr(self, "_repeating_iter"):
+                from ..dataloader import RepeatingLoader
+                self._repeating_iter = iter(RepeatingLoader(self.training_dataloader))
+            data_iter = self._repeating_iter
+
+        mb = self.micro_batches
+        S = self.num_stages
+        streams = [list(iter(schedule.TrainSchedule(micro_batches=mb, stages=S, stage_id=s)))
+                   for s in range(S)]
+
+        act_in = [dict() for _ in range(S)]    # stage -> buffer_id -> input activation
+        act_out = [dict() for _ in range(S)]   # stage -> buffer_id -> output activation
+        dx_buf = [dict() for _ in range(S)]    # stage -> buffer_id -> input-grad to send back
+        grad_in = [dict() for _ in range(S)]   # stage -> buffer_id -> received output-grad
+        # Channels are keyed by (sending stage, micro-batch id): adjacent stages size their
+        # buffer rings differently (num_pipe_buffers is per-stage), so receiver-local buffer
+        # ids do NOT line up across stages. Micro-batch ids are globally consistent; each
+        # stage forwards/retires/receives micro-batches strictly in order.
+        chan_act = {}
+        chan_grad = {}
+        in_mb = [dict() for _ in range(S)]     # stage -> buffer_id -> micro-batch id
+        labels_by_mb = {}
+        fwd_count = [0] * S
+        bwd_count = [0] * S
+        recv_act_count = [0] * S
+        recv_grad_count = [0] * S
+        micro_losses = []
+        grads_total: Optional[Dict[str, Any]] = None
+        scale = jnp.asarray(1.0 / mb, jnp.float32)
+
+        def merge_grads(total, delta):
+            if total is None:
+                return dict(delta)
+            merged = dict(total)
+            for k, v in delta.items():
+                merged[k] = (jax.tree_util.tree_map(lambda a, b: a + b, merged[k], v)
+                             if k in merged else v)
+            return merged
+
+        def exec_cmd(s, cmd):
+            nonlocal grads_total
+            if isinstance(cmd, schedule.LoadMicroBatch):
+                if s == 0:
+                    batch = self._next_micro_batch(data_iter)
+                    act_in[0][cmd.buffer_id] = batch[0]
+                    in_mb[0][cmd.buffer_id] = fwd_count[0]
+                    labels_by_mb[fwd_count[0]] = batch[1] if len(batch) > 1 else None
+                # last stage: labels were stashed when stage 0 loaded this micro-batch
+            elif isinstance(cmd, schedule.ForwardPass):
+                x = act_in[s].pop(cmd.buffer_id)
+                mb_id = in_mb[s][cmd.buffer_id]
+                act_in[s][("saved", cmd.buffer_id)] = x
+                if s < S - 1 or self.pipe_module.loss_fn is None:
+                    act_out[s][cmd.buffer_id] = (mb_id, self._stage_fwd[s](self._select_params(s), x))
+                fwd_count[s] += 1
+            elif isinstance(cmd, schedule.SendActivation):
+                mb_id, payload = act_out[s].pop(cmd.buffer_id)
+                chan_act[(s, mb_id)] = payload
+            elif isinstance(cmd, schedule.RecvActivation):
+                mb_id = recv_act_count[s]
+                recv_act_count[s] += 1
+                act_in[s][cmd.buffer_id] = chan_act.pop((s - 1, mb_id))
+                in_mb[s][cmd.buffer_id] = mb_id
+            elif isinstance(cmd, schedule.BackwardPass):
+                x = act_in[s].pop(("saved", cmd.buffer_id))
+                mb_id = in_mb[s].pop(cmd.buffer_id)
+                if s == S - 1 and self.pipe_module.loss_fn is not None:
+                    labels = labels_by_mb[mb_id]
+                    loss, dparams, dx = self._stage_last_bwd(self._select_params(s), x, labels, scale)
+                    micro_losses.append(loss)
+                else:
+                    g = grad_in[s].pop(cmd.buffer_id)
+                    dparams, dx = self._stage_bwd[s](self._select_params(s), x, g)
+                grads_total = merge_grads(grads_total, dparams)
+                if s > 0:
+                    dx_buf[s][cmd.buffer_id] = (mb_id, dx)
+                bwd_count[s] += 1
+            elif isinstance(cmd, schedule.SendGrad):
+                mb_id, payload = dx_buf[s].pop(cmd.buffer_id)
+                chan_grad[(s, mb_id)] = payload
+            elif isinstance(cmd, schedule.RecvGrad):
+                mb_id = recv_grad_count[s]
+                recv_grad_count[s] += 1
+                grad_in[s][cmd.buffer_id] = chan_grad.pop((s + 1, mb_id))
+            elif isinstance(cmd, (schedule.ReduceTiedGrads, schedule.ReduceGrads)):
+                pass  # tied grads summed in merge_grads; DP reduce emitted by XLA
+            elif isinstance(cmd, schedule.OptimizerStep):
+                if s == 0:
+                    self._pipeline_optimizer_step(grads_total)
+
+        total_steps = len(streams[0])
+        for step_id in range(total_steps):
+            # Phase 1: all sends/loads (their payloads were computed in earlier steps).
+            for s in range(S):
+                for cmd in streams[s][step_id]:
+                    if isinstance(cmd, _SEND_CMDS):
+                        exec_cmd(s, cmd)
+            # Phase 2: recvs + compute + reductions/step.
+            for s in range(S):
+                for cmd in streams[s][step_id]:
+                    if not isinstance(cmd, _SEND_CMDS):
+                        exec_cmd(s, cmd)
+
+        self.agg_train_loss = jnp.mean(jnp.stack(micro_losses)) if micro_losses else None
+        self.global_steps += 1
+        self.micro_steps += mb
+        if self.global_steps == 1 or self.global_steps % self.steps_per_print() == 0:
+            self._report_progress(self.global_steps)
+        return self.agg_train_loss
+
+    def _select_params(self, stage_id):
+        return {k: self.params[k] for k in self._stage_param_keys(stage_id)}
+
+    def _pipeline_optimizer_step(self, grads_total):
+        full_grads = {}
+        for k, p in self.master_params.items():
+            if grads_total is not None and k in grads_total:
+                full_grads[k] = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32),
+                                                       grads_total[k])
+            else:
+                full_grads[k] = jax.tree_util.tree_map(lambda a: jnp.zeros(a.shape, jnp.float32), p)
+        hyper = self.optimizer.current_hyper()
+        step = jnp.asarray(self.global_steps + 1 - self.skipped_steps, jnp.int32)
+        (self.master_params, self.opt_state, self.scaler_state, self.params,
+         _overflow, self._last_grad_norm) = self._jit_apply_update(
+            self.master_params, self.opt_state, self.scaler_state, full_grads, step, hyper)
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+
+    def eval_batch(self, data_iter):
+        """Forward-only evaluation over micro-batches (reference pipe/engine.py:305-372)."""
+        losses = []
+        for _ in range(self.micro_batches):
+            batch = self._next_micro_batch(data_iter)
+            losses.append(self._whole_model_fn(self.params, *batch))
+        return jnp.mean(jnp.stack(losses))

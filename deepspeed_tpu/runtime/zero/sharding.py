@@ -24,20 +24,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ...parallel.mesh import DATA_AXIS
 
 
-def zero_spec(shape, dp_size: int, min_size: int = 1024) -> P:
-    """PartitionSpec sharding the largest dp-divisible axis over 'data' (or replicated)."""
+def zero_spec(shape, dp_size: int, min_size: int = 1024, existing_spec: P = P()) -> P:
+    """PartitionSpec sharding the largest *unclaimed* dp-divisible axis over 'data'.
+
+    ``existing_spec`` lets ZeRO compose with a layout that already shards some axes
+    (pipe-stacked stages, TP weights): only axes the existing spec leaves None are
+    candidates, and the existing placements are preserved.
+    """
+    spec = list(existing_spec) + [None] * (len(shape) - len(existing_spec))
     if dp_size <= 1 or int(np.prod(shape)) < min_size:
-        return P()
+        return P(*spec)
     best_axis = -1
     best_dim = 0
     for i, d in enumerate(shape):
-        if d % dp_size == 0 and d > best_dim:
+        if spec[i] is None and d % dp_size == 0 and d > best_dim:
             best_axis = i
             best_dim = d
-    if best_axis < 0:
-        return P()
-    spec = [None] * len(shape)
-    spec[best_axis] = DATA_AXIS
+    if best_axis >= 0:
+        spec[best_axis] = DATA_AXIS
     return P(*spec)
 
 
@@ -57,3 +61,21 @@ def zero_sharding(mesh: Mesh, tree, stage: int, min_size: int = 1024):
 def replicated_sharding(mesh: Mesh, tree):
     import jax
     return jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), tree)
+
+
+def merge_zero_into(mesh: Mesh, sharding_tree, tree, stage: int, min_size: int = 1024):
+    """Compose ZeRO data-axis sharding into an existing layout (e.g. pipe-stacked stages).
+
+    For each leaf, if stage >= 1, shard the largest *unsharded* dp-divisible axis over
+    'data' on top of the leaf's existing PartitionSpec. This is how ZeRO composes with
+    pipeline/tensor layouts into true 3-D parallelism.
+    """
+    import jax
+    dp = mesh.shape[DATA_AXIS]
+
+    def leaf(sh: NamedSharding, a):
+        if stage < 1:
+            return NamedSharding(mesh, sh.spec)
+        return NamedSharding(mesh, zero_spec(a.shape, dp, min_size, existing_spec=sh.spec))
+
+    return jax.tree_util.tree_map(leaf, sharding_tree, tree)

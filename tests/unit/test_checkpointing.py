@@ -129,3 +129,29 @@ def test_checkpoint_missing_dir():
     path, client_state = engine.load_checkpoint("/tmp/definitely_missing_dir_xyz")
     assert path is None
     assert client_state == {}
+
+
+def test_checkpoint_elastic_world_size_change(tmp_path, eight_devices):
+    """Save under dp=8, reload under dp=4 (elastic resharding; reference stage2.py:1713-1779)."""
+    import jax
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    cfg = simple_config(zero_optimization={"stage": 2})
+    engine, loader = make_engine(cfg)
+    assert engine.dp_size == 8
+    train_steps(engine, loader, 3)
+    engine.save_checkpoint(str(tmp_path))
+
+    model = SimpleModel(HIDDEN)
+    params = model.init(jax.random.PRNGKey(42))
+    mesh4 = build_mesh(data=4, model=1, pipe=1, devices=eight_devices[:4])
+    engine2 = DeepSpeedEngine(model=model, model_parameters=params,
+                              config_params=simple_config(batch=4, zero_optimization={"stage": 2}),
+                              mesh=mesh4)
+    assert engine2.dp_size == 4
+    path, _ = engine2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    trees_equal(engine.master_params, engine2.master_params)
+    trees_equal(engine.opt_state, engine2.opt_state)
+    assert engine2.global_steps == engine.global_steps

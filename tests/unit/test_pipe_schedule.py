@@ -1,0 +1,147 @@
+"""Schedule instruction-stream parity tests (reference tests/unit/test_pipe_schedule.py)."""
+
+import pytest
+
+import deepspeed_tpu.runtime.pipe.schedule as schedule
+
+
+def _count_type(cmds, classtype):
+    return len([c for c in cmds if type(c) is classtype])
+
+
+def test_pipe_inference_schedule_singlestage():
+    sched = schedule.InferenceSchedule(micro_batches=4, stages=1, stage_id=0)
+    assert sched.num_micro_batches == 4
+    full = list(iter(sched))
+    for idx, cmds in enumerate(full):
+        assert len(cmds) == 2
+        assert type(cmds[0]) is schedule.LoadMicroBatch
+        assert type(cmds[1]) is schedule.ForwardPass
+        assert cmds[0].buffer_id == cmds[1].buffer_id
+    assert len(full) == sched.num_micro_batches
+
+
+@pytest.mark.parametrize("micro_batches", [1, 3, 8, 10])
+def test_pipe_inference_schedule_firststage(micro_batches, stages=3):
+    sched = schedule.InferenceSchedule(micro_batches=micro_batches, stages=stages, stage_id=0)
+    full = list(iter(sched))
+    for idx, cmds in enumerate(full):
+        if idx == 0:
+            assert len(cmds) == 2
+            assert type(cmds[0]) is schedule.LoadMicroBatch
+            assert type(cmds[1]) is schedule.ForwardPass
+            assert cmds[0].buffer_id == cmds[1].buffer_id
+            continue
+        if idx == sched.num_micro_batches:
+            assert len(cmds) == 1
+            assert type(cmds[0]) is schedule.SendActivation
+            continue
+        if idx > sched.num_micro_batches:
+            assert len(cmds) == 0
+            continue
+        assert len(cmds) == 3
+        assert _count_type(cmds, schedule.LoadMicroBatch) == 1
+        assert _count_type(cmds, schedule.ForwardPass) == 1
+        assert _count_type(cmds, schedule.SendActivation) == 1
+    assert len(full) == micro_batches + stages - 1
+
+
+@pytest.mark.parametrize("micro_batches", [1, 3, 8, 10])
+def test_pipe_inference_schedule_midstage(micro_batches, stages=3):
+    sched = schedule.InferenceSchedule(micro_batches=micro_batches, stages=stages, stage_id=1)
+    full = list(iter(sched))
+    for idx, cmds in enumerate(full):
+        if idx < sched.stage:
+            assert len(cmds) == 0
+            continue
+        if idx == sched.stage + sched.num_micro_batches:
+            assert len(cmds) == 1
+            assert type(cmds[0]) is schedule.SendActivation
+            continue
+        if idx > sched.stage + sched.num_micro_batches:
+            assert len(cmds) == 0
+            continue
+        assert _count_type(cmds, schedule.LoadMicroBatch) == 0
+        assert _count_type(cmds, schedule.ForwardPass) == 1
+        assert _count_type(cmds, schedule.RecvActivation) == 1
+        if idx > sched.stage:
+            assert _count_type(cmds, schedule.SendActivation) == 1
+    assert len(full) == micro_batches + stages - 1
+
+
+@pytest.mark.parametrize("micro_batches", [1, 3, 8, 10])
+def test_pipe_inference_schedule_laststage(micro_batches, stages=3):
+    sched = schedule.InferenceSchedule(micro_batches=micro_batches, stages=stages, stage_id=2)
+    full = list(iter(sched))
+    for idx, cmds in enumerate(full):
+        if idx < sched.stage or idx > sched.stage + sched.num_micro_batches:
+            assert len(cmds) == 0
+            continue
+        assert _count_type(cmds, schedule.LoadMicroBatch) == 1
+        assert _count_type(cmds, schedule.ForwardPass) == 1
+        assert _count_type(cmds, schedule.RecvActivation) == 1
+        assert _count_type(cmds, schedule.SendActivation) == 0
+    assert len(full) == micro_batches + stages - 1
+
+
+def test_pipe_train_schedule_firststage():
+    sched = schedule.TrainSchedule(micro_batches=8, stages=3, stage_id=0)
+    for cmds in sched:
+        assert all(type(instr) is not schedule.SendGrad for instr in cmds)
+        assert all(type(instr) is not schedule.RecvActivation for instr in cmds)
+        for instr in cmds:
+            if isinstance(instr, schedule.BufferOpInstruction):
+                assert 0 <= instr.buffer_id < sched.num_pipe_buffers()
+
+
+def test_pipe_train_schedule_laststage():
+    sched = schedule.TrainSchedule(stages=3, micro_batches=4, stage_id=2)
+    for cmds in sched:
+        assert all(type(instr) is not schedule.SendActivation for instr in cmds)
+        assert all(type(instr) is not schedule.RecvGrad for instr in cmds)
+
+
+def test_pipe_train_schedule_singlestage():
+    """With one stage, TrainSchedule degenerates to fwd/bwd per micro-batch + final step."""
+    sched = schedule.TrainSchedule(micro_batches=4, stages=1, stage_id=0)
+    full = list(iter(sched))
+    assert len(full) == 2 * (4 + 1 - 1)
+    n_fwd = sum(_count_type(c, schedule.ForwardPass) for c in full)
+    n_bwd = sum(_count_type(c, schedule.BackwardPass) for c in full)
+    assert n_fwd == 4 and n_bwd == 4
+    assert _count_type(full[-1], schedule.OptimizerStep) == 1
+    assert _count_type(full[-1], schedule.ReduceGrads) == 1
+    assert _count_type(full[-1], schedule.ReduceTiedGrads) == 1
+
+
+def test_pipe_train_counts_balance():
+    """Every stage must execute exactly micro_batches forwards and backwards, and
+    sends/recvs across adjacent stages must pair up."""
+    stages = 4
+    mb = 6
+    streams = [list(iter(schedule.TrainSchedule(micro_batches=mb, stages=stages, stage_id=s)))
+               for s in range(stages)]
+    for s, full in enumerate(streams):
+        flat = [i for cmds in full for i in cmds]
+        assert _count_type(flat, schedule.ForwardPass) == mb
+        assert _count_type(flat, schedule.BackwardPass) == mb
+        sends_fwd = _count_type(flat, schedule.SendActivation)
+        recvs_bwd = _count_type(flat, schedule.RecvGrad)
+        if s == stages - 1:
+            assert sends_fwd == 0 and recvs_bwd == 0
+        else:
+            assert sends_fwd == mb and recvs_bwd == mb
+    # pairing: stage s sends mb activations; stage s+1 receives mb activations
+    for s in range(stages - 1):
+        flat_next = [i for cmds in streams[s + 1] for i in cmds]
+        assert _count_type(flat_next, schedule.RecvActivation) == mb
+        assert _count_type(flat_next, schedule.SendGrad) == mb
+
+
+def test_pipe_stagequery():
+    sched = schedule.TrainSchedule(stages=3, micro_batches=2, stage_id=0)
+    assert sched.is_first_stage and not sched.is_last_stage
+    sched = schedule.TrainSchedule(stages=3, micro_batches=2, stage_id=1)
+    assert not sched.is_first_stage and not sched.is_last_stage
+    sched = schedule.TrainSchedule(stages=3, micro_batches=2, stage_id=2)
+    assert not sched.is_first_stage and sched.is_last_stage

@@ -1,0 +1,127 @@
+"""SPMD pipe-axis pipeline tests: numerics vs sequential, grads, GPT2Pipe end-to-end."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.parallel.pipeline_spmd import (pipeline_apply, stack_stage_params,
+                                                  stacked_param_sharding)
+
+S, M, B, H = 2, 4, 8, 16
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh(data=4, model=1, pipe=2)
+
+
+@pytest.fixture(scope="module")
+def toy(mesh):
+    key = jax.random.PRNGKey(0)
+    per_stage = []
+    for _ in range(S):
+        k1, key = jax.random.split(key)
+        per_stage.append({"w": jax.random.normal(k1, (H, H)) * 0.3, "b": jnp.zeros((H,))})
+    stacked = stack_stage_params(per_stage)
+    stacked = jax.device_put(stacked, stacked_param_sharding(mesh, stacked))
+    x_mb = jax.random.normal(key, (M, B, H))
+    labels_mb = jnp.tanh(x_mb @ (jax.random.normal(jax.random.PRNGKey(9), (H, H)) * 0.5))
+    return stacked, x_mb, labels_mb
+
+
+def stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def seq_loss(stacked, x_mb, labels_mb):
+    losses = []
+    for m in range(M):
+        x = x_mb[m]
+        for s in range(S):
+            x = stage_fn(jax.tree_util.tree_map(lambda a: a[s], stacked), x)
+        losses.append(jnp.mean((x - labels_mb[m])**2))
+    return jnp.mean(jnp.stack(losses))
+
+
+def test_pipeline_forward_matches_sequential(mesh, toy):
+    stacked, x_mb, _ = toy
+    outs = jax.jit(lambda s, x: pipeline_apply(stage_fn, s, x, mesh=mesh))(stacked, x_mb)
+    ref = jnp.stack([
+        stage_fn(jax.tree_util.tree_map(lambda a: a[1], stacked),
+                 stage_fn(jax.tree_util.tree_map(lambda a: a[0], stacked), x_mb[m]))
+        for m in range(M)
+    ])
+    np.testing.assert_allclose(np.asarray(outs), np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_loss_and_grads_match_sequential(mesh, toy):
+    stacked, x_mb, labels_mb = toy
+
+    def last_fn(y, labels_all, mb):
+        return jnp.mean((y - labels_all[mb])**2)
+
+    def pipe_loss(stacked, x_mb):
+        return pipeline_apply(stage_fn, stacked, x_mb, mesh=mesh,
+                              last_stage_fn=last_fn, last_stage_args=(labels_mb,))
+
+    l_seq = jax.jit(lambda s, x: seq_loss(s, x, labels_mb))(stacked, x_mb)
+    l_pipe = jax.jit(pipe_loss)(stacked, x_mb)
+    np.testing.assert_allclose(float(l_seq), float(l_pipe), rtol=1e-6)
+
+    g_seq = jax.jit(jax.grad(lambda s, x: seq_loss(s, x, labels_mb)))(stacked, x_mb)
+    g_pipe = jax.jit(jax.grad(pipe_loss))(stacked, x_mb)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(g_seq[k]), np.asarray(g_pipe[k]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_stacked_params_actually_pipe_sharded(mesh, toy):
+    stacked, _, _ = toy
+    sh = stacked["w"].sharding
+    assert not sh.is_fully_replicated
+
+
+def test_gpt2_pipe_trains(mesh):
+    """Full 3D slice: GPT2Pipe (pipe=2 stages x data=4 DP x ZeRO-2) through the engine."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+    from deepspeed_tpu.models.gpt2_pipe import GPT2Pipe
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg = GPT2Config(vocab_size=128, n_positions=32, n_embd=32, n_layer=4, n_head=2,
+                     compute_dtype=jnp.float32)
+    pipe = GPT2Pipe(cfg, num_stages=2)
+    params = pipe.init(jax.random.PRNGKey(0))
+    shardings = pipe.param_shardings(mesh, params)
+
+    def model_fn(p, tokens_mb, labels_mb):
+        return pipe.loss(p, tokens_mb, labels_mb, mesh=mesh)
+
+    # all M micro-batches run inside one engine call (the pipeline IS the accumulation)
+    ds_cfg = {"train_batch_size": 8 * M, "train_micro_batch_size_per_gpu": 2 * M,
+              "gradient_accumulation_steps": 1,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+              "zero_optimization": {"stage": 2}, "steps_per_print": 100}
+    engine = DeepSpeedEngine(model=model_fn, model_parameters=params, config_params=ds_cfg,
+                             mesh=mesh, param_shardings=shardings)
+
+    rng = np.random.default_rng(0)
+    data_spec = NamedSharding(mesh, P(None, "data"))
+    # overfit one fixed batch: loss must drop (random fresh tokens would be irreducible)
+    toks = rng.integers(0, cfg.vocab_size, size=(M, 8, 16)).astype(np.int32)
+    labels = np.roll(toks, -1, axis=2)
+    toks = jax.device_put(jnp.asarray(toks), data_spec)
+    labels = jax.device_put(jnp.asarray(labels), data_spec)
+    losses = []
+    for step in range(8):
+        loss = engine(toks, labels)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert engine.global_steps == 8, "every call must fire an optimizer update"
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], f"{losses}"
+    # stacked block weights keep pipe sharding through the update
+    assert not engine.master_params["stages"]["attn"]["c_attn_w"].sharding.is_fully_replicated
