@@ -26,7 +26,7 @@ def main():
     # GPT-2 medium-ish config sized for a single v5e chip (16 GB HBM) with Adam fp32 state.
     if on_tpu:
         cfg = GPT2Config(vocab_size=50304, n_positions=1024, n_embd=1024, n_layer=24,
-                         n_head=16, remat=True)
+                         n_head=16, remat=True, use_flash_attention=True)
         batch, seq, steps = 8, 1024, 10
     else:  # CPU smoke mode
         cfg = GPT2Config(vocab_size=512, n_positions=128, n_embd=128, n_layer=2, n_head=4)

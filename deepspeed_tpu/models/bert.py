@@ -1,0 +1,130 @@
+"""BERT model family built on the fused transformer layer.
+
+The reference accelerates BERT pretraining by swapping HF/NVIDIA BertLayer for its fused
+kernel layer (``docs/_tutorials/bert-pretraining.md``); here the model is in-tree: BERT
+embeddings + N ``DeepSpeedTransformerLayer``s + MLM head, pure-function style.
+"""
+
+import math
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.transformer import DeepSpeedTransformerConfig, DeepSpeedTransformerLayer
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    pre_layer_norm: bool = False     # classic BERT is post-LN
+    compute_dtype: Any = jnp.bfloat16
+    use_flash_attention: bool = True
+
+
+class BertModel:
+    """``init(rng) -> params``; ``apply(params, input_ids, token_type_ids=None,
+    attention_mask=None, rng=None, deterministic=True) -> [B, T, H]``."""
+
+    def __init__(self, config: BertConfig):
+        self.config = config
+        self._layer = DeepSpeedTransformerLayer(DeepSpeedTransformerConfig(
+            hidden_size=config.hidden_size,
+            intermediate_size=config.intermediate_size,
+            heads=config.num_attention_heads,
+            attn_dropout_ratio=config.attention_probs_dropout_prob,
+            hidden_dropout_ratio=config.hidden_dropout_prob,
+            num_hidden_layers=config.num_hidden_layers,
+            initializer_range=config.initializer_range,
+            pre_layer_norm=config.pre_layer_norm,
+            bf16=config.compute_dtype == jnp.bfloat16,
+            fp16=config.compute_dtype == jnp.float16,
+            use_flash_attention=config.use_flash_attention,
+        ))
+
+    def init(self, rng):
+        c = self.config
+        ks = jax.random.split(rng, 3 + c.num_hidden_layers)
+        std = c.initializer_range
+        params = {
+            "embeddings": {
+                "word": jax.random.normal(ks[0], (c.vocab_size, c.hidden_size), jnp.float32) * std,
+                "position": jax.random.normal(ks[1], (c.max_position_embeddings, c.hidden_size),
+                                              jnp.float32) * std,
+                "token_type": jax.random.normal(ks[2], (c.type_vocab_size, c.hidden_size),
+                                                jnp.float32) * std,
+                "ln_scale": jnp.ones((c.hidden_size,), jnp.float32),
+                "ln_bias": jnp.zeros((c.hidden_size,), jnp.float32),
+            },
+            "layers": [self._layer.init(ks[3 + i]) for i in range(c.num_hidden_layers)],
+        }
+        return params
+
+    def _embed(self, params, input_ids, token_type_ids):
+        c = self.config
+        e = params["embeddings"]
+        T = input_ids.shape[1]
+        x = e["word"][input_ids] + e["position"][jnp.arange(T)][None]
+        if token_type_ids is not None:
+            x = x + e["token_type"][token_type_ids]
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        x = ((xf - mean) * jax.lax.rsqrt(var + 1e-12)) * e["ln_scale"] + e["ln_bias"]
+        return x.astype(c.compute_dtype)
+
+    def apply(self, params, input_ids, token_type_ids=None, attention_mask=None, rng=None,
+              deterministic=True):
+        x = self._embed(params, input_ids, token_type_ids)
+        ext_mask = None
+        if attention_mask is not None:
+            # [B, T] 1/0 mask -> additive [B, 1, 1, T]
+            ext_mask = (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)) * -1e9
+        for lp in params["layers"]:
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            x = self._layer.apply(lp, x, attention_mask=ext_mask, rng=sub,
+                                  deterministic=deterministic)
+        return x
+
+
+class BertForMaskedLM:
+    """BERT + tied-embedding MLM head; apply returns the masked-LM loss."""
+
+    def __init__(self, config: BertConfig):
+        self.config = config
+        self.bert = BertModel(config)
+
+    def init(self, rng):
+        return self.bert.init(rng)
+
+    def logits(self, params, input_ids, token_type_ids=None, attention_mask=None,
+               rng=None, deterministic=True):
+        x = self.bert.apply(params, input_ids, token_type_ids, attention_mask, rng, deterministic)
+        wte = params["embeddings"]["word"]
+        return jnp.dot(x, wte.T.astype(x.dtype), preferred_element_type=jnp.float32)
+
+    def apply(self, params, input_ids, labels, token_type_ids=None, attention_mask=None,
+              rng=None, deterministic=True):
+        """labels: [B, T] with -100 for unmasked positions (ignored)."""
+        logits = self.logits(params, input_ids, token_type_ids, attention_mask, rng, deterministic)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ids = jnp.maximum(labels, 0)
+        ll = jnp.take_along_axis(logp, ids[..., None], axis=-1)[..., 0]
+        mask = (labels >= 0).astype(jnp.float32)
+        return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    def param_count(self, params) -> int:
+        return sum(int(l.size) for l in jax.tree_util.tree_leaves(params))

@@ -107,7 +107,7 @@ class GPT2Model:
 
         if c.use_flash_attention:
             from ..ops.pallas.flash_attention import flash_attention
-            y = flash_attention(q, k, v, causal=True)
+            y = flash_attention(q, k, v, True)
         else:
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                                 preferred_element_type=jnp.float32) / math.sqrt(c.head_dim)
