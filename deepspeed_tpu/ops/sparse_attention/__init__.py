@@ -1,0 +1,5 @@
+from .sparsity_config import (SparsityConfig, DenseSparsityConfig, FixedSparsityConfig,
+                              VariableSparsityConfig, BigBirdSparsityConfig,
+                              BSLongformerSparsityConfig)
+from .sparse_self_attention import SparseSelfAttention, BertSparseSelfAttention
+from .sparse_attention_utils import SparseAttentionUtils

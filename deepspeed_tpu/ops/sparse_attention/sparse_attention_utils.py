@@ -1,0 +1,78 @@
+"""Helpers for using sparse attention with transformer models.
+
+Mirrors ``deepspeed/ops/sparse_attention/sparse_attention_utils.py`` (SparseAttentionUtils
+l.13-225): pad inputs to the block size, unpad outputs, extend position embeddings. The
+reference's HF-torch model-surgery helpers (replace_model_self_attention_...) translate
+here to swapping the attention callable on our in-tree BERT/GPT models.
+"""
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class SparseAttentionUtils:
+
+    @staticmethod
+    def extend_position_embedding(position_embedding, max_position: int):
+        """Tile an existing [P, H] position embedding out to max_position rows
+        (reference l.36-84 extends HF model embeddings the same way)."""
+        P, H = position_embedding.shape
+        if max_position <= P:
+            return position_embedding[:max_position]
+        reps = -(-max_position // P)
+        extended = jnp.concatenate([position_embedding] * reps, axis=0)[:max_position]
+        return extended
+
+    @staticmethod
+    def update_tokenizer_model_max_length(tokenizer, max_position: int):
+        tokenizer.model_max_length = max_position
+        if hasattr(tokenizer, "init_kwargs"):
+            tokenizer.init_kwargs["model_max_length"] = max_position
+        return tokenizer
+
+    @staticmethod
+    def pad_to_block_size(block_size: int,
+                          input_ids,
+                          attention_mask=None,
+                          token_type_ids=None,
+                          position_ids=None,
+                          inputs_embeds=None,
+                          pad_token_id: int = 0,
+                          model_embeddings=None) -> Tuple:
+        """Pad sequence dim up to a multiple of block_size (reference l.85-174).
+
+        Returns (pad_len, input_ids, attention_mask, token_type_ids, position_ids,
+        inputs_embeds).
+        """
+        ref = input_ids if input_ids is not None else inputs_embeds
+        seq_len = ref.shape[1]
+        pad_len = (block_size - seq_len % block_size) % block_size
+        if pad_len == 0:
+            return (0, input_ids, attention_mask, token_type_ids, position_ids, inputs_embeds)
+
+        def pad2d(x, value=0):
+            if x is None:
+                return None
+            return jnp.pad(jnp.asarray(x), ((0, 0), (0, pad_len)), constant_values=value)
+
+        input_ids = pad2d(input_ids, pad_token_id)
+        attention_mask = pad2d(attention_mask, 0)
+        token_type_ids = pad2d(token_type_ids, 0)
+        position_ids = pad2d(position_ids, 0)
+        if inputs_embeds is not None:
+            pad_block = jnp.zeros((inputs_embeds.shape[0], pad_len, inputs_embeds.shape[2]),
+                                  inputs_embeds.dtype)
+            if model_embeddings is not None and input_ids is None:
+                pad_ids = jnp.full((inputs_embeds.shape[0], pad_len), pad_token_id, jnp.int32)
+                pad_block = jnp.asarray(model_embeddings)[pad_ids].astype(inputs_embeds.dtype)
+            inputs_embeds = jnp.concatenate([inputs_embeds, pad_block], axis=1)
+        return (pad_len, input_ids, attention_mask, token_type_ids, position_ids, inputs_embeds)
+
+    @staticmethod
+    def unpad_sequence_output(pad_len: int, sequence_output):
+        """Drop padded positions from the model output (reference l.176-193)."""
+        if pad_len > 0:
+            return sequence_output[:, :-pad_len]
+        return sequence_output

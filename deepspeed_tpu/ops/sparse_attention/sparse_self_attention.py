@@ -1,0 +1,130 @@
+"""Sparse self-attention layer over SparsityConfig layouts.
+
+Mirrors ``deepspeed/ops/sparse_attention/sparse_self_attention.py`` (SparseSelfAttention
+l.18, forward l.83-142): computes softmax(QK^T * scale + masks) V under a block-sparse
+layout. The Triton sdd→softmax→dsd pipeline is replaced by the single Pallas
+block-sparse flash kernel; rpe / key-padding / attention masks take the dense-masked
+path (they densify the score matrix anyway).
+"""
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..pallas.block_sparse_attention import (DEFAULT_MASK_VALUE, block_sparse_attention,
+                                             dense_blocksparse_attention)
+from .sparsity_config import FixedSparsityConfig, SparsityConfig
+
+
+class SparseSelfAttention:
+    """q/k/v: [B, H, T, D] (already projected + split into heads)."""
+
+    def __init__(self,
+                 sparsity_config: SparsityConfig = None,
+                 key_padding_mask_mode: str = "add",
+                 attn_mask_mode: str = "mul",
+                 max_seq_length: int = 2048):
+        self.sparsity_config = sparsity_config or FixedSparsityConfig(num_heads=4)
+        if key_padding_mask_mode not in ("add", "mul"):
+            raise ValueError(f'only "add" or "mul" key_padding_mask_modes are supported, '
+                             f'got {key_padding_mask_mode!r}')
+        if attn_mask_mode not in ("add", "mul"):
+            raise ValueError(f'only "add" or "mul" attn_mask_modes are supported, '
+                             f'got {attn_mask_mode!r}')
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self._layout_cache = {}
+
+    def get_layout(self, L: int) -> np.ndarray:
+        if L not in self._layout_cache:
+            self._layout_cache[L] = self.sparsity_config.make_layout(L)
+        return self._layout_cache[L]
+
+    def __call__(self, query, key, value, rpe=None, key_padding_mask=None, attn_mask=None):
+        return self.forward(query, key, value, rpe, key_padding_mask, attn_mask)
+
+    def forward(self, query, key, value, rpe=None, key_padding_mask=None, attn_mask=None):
+        assert query.dtype == key.dtype == value.dtype, "only same-dtype q/k/v are supported"
+        B, H, T, D = query.shape
+        assert T % self.sparsity_config.block == 0, (
+            f"sequence length {T} must be divisible by block size {self.sparsity_config.block}")
+        layout = self.get_layout(T)
+        causal = getattr(self.sparsity_config, "attention", "bidirectional") == "unidirectional"
+
+        if rpe is None and key_padding_mask is None and attn_mask is None:
+            return block_sparse_attention(query, key, value, layout,
+                                          self.sparsity_config.block, causal=causal)
+        return self._masked_dense(query, key, value, layout, causal, rpe, key_padding_mask,
+                                  attn_mask)
+
+    def _masked_dense(self, q, k, v, layout, causal, rpe, key_padding_mask, attn_mask):
+        B, H, T, D = q.shape
+        block = self.sparsity_config.block
+        sm_scale = 1.0 / math.sqrt(D)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * sm_scale
+        if rpe is not None:
+            scores = scores + rpe.astype(jnp.float32)
+        if key_padding_mask is not None:
+            m = key_padding_mask.astype(jnp.float32)[:, None, None, :]
+            if self.key_padding_mask_mode == "add":
+                scores = scores + m
+            else:
+                scores = jnp.where(m != 0, scores, DEFAULT_MASK_VALUE)
+        if attn_mask is not None:
+            m = attn_mask.astype(jnp.float32)
+            while m.ndim < 4:
+                m = m[None]
+            if self.attn_mask_mode == "add":
+                scores = scores + m
+            else:
+                scores = jnp.where(m != 0, scores, DEFAULT_MASK_VALUE)
+        mask = np.kron(np.asarray(layout) != 0, np.ones((block, block), bool))
+        if causal:
+            mask = mask & np.tril(np.ones((T, T), bool))[None]
+        scores = jnp.where(jnp.asarray(mask)[None], scores, DEFAULT_MASK_VALUE)
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v,
+                          preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+class BertSparseSelfAttention:
+    """BERT-style projected sparse attention (reference bert_sparse_self_attention.py):
+    owns q/k/v projections; ``apply(params, hidden, attention_mask)`` -> context."""
+
+    def __init__(self, hidden_size: int, num_attention_heads: int,
+                 sparsity_config: SparsityConfig = None):
+        if hidden_size % num_attention_heads != 0:
+            raise ValueError(f"The hidden size ({hidden_size}) is not a multiple of "
+                             f"the number of attention heads ({num_attention_heads})")
+        self.hidden_size = hidden_size
+        self.num_attention_heads = num_attention_heads
+        self.head_dim = hidden_size // num_attention_heads
+        self.sparse_self_attention = SparseSelfAttention(
+            sparsity_config or FixedSparsityConfig(num_heads=num_attention_heads))
+
+    def init(self, rng):
+        H = self.hidden_size
+        ks = jax.random.split(rng, 3)
+        return {name: {"w": jax.random.normal(k, (H, H), jnp.float32) * 0.02,
+                       "b": jnp.zeros((H,), jnp.float32)}
+                for name, k in zip(("query", "key", "value"), ks)}
+
+    def _split_heads(self, x):
+        B, T, _ = x.shape
+        return x.reshape(B, T, self.num_attention_heads, self.head_dim).transpose(0, 2, 1, 3)
+
+    def apply(self, params, hidden_states, attention_mask=None):
+        dt = hidden_states.dtype
+        proj = {}
+        for name in ("query", "key", "value"):
+            p = params[name]
+            proj[name] = self._split_heads(
+                jnp.dot(hidden_states, p["w"].astype(dt),
+                        preferred_element_type=jnp.float32).astype(dt) + p["b"].astype(dt))
+        ctx = self.sparse_self_attention(proj["query"], proj["key"], proj["value"],
+                                         key_padding_mask=attention_mask)
+        B, H, T, D = ctx.shape
+        return ctx.transpose(0, 2, 1, 3).reshape(B, T, H * D)
