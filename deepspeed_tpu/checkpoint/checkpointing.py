@@ -183,6 +183,10 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         merged = _merge_elastic(ckpt_dir)
         master_flat = {k[len("master/"):]: v for k, v in merged.items() if k.startswith("master/")}
         opt_flat = {k[len("opt/"):]: v for k, v in merged.items() if k.startswith("opt/")}
+        if hasattr(engine, "_onebit") and meta["dp_world_size"] != engine.dp_size:
+            # OneBitAdam state sizes are dp-dependent (padded moments, per-worker error
+            # buffers); adapt them instead of failing the reshape below.
+            opt_flat = engine._onebit.elastic_adapt(opt_flat, _flatten_with_paths(engine.opt_state))
         master = _unflatten_like(engine.master_params, master_flat)
         opt = _unflatten_like(engine.opt_state, opt_flat)
         engine.master_params = jax.device_put(master, engine._master_shardings)

@@ -1,0 +1,99 @@
+"""Error-feedback sign-compressed allreduce over the mesh ``data`` axis.
+
+TPU-native re-design of the reference's MPI+cupy compressed allreduce
+(``deepspeed/runtime/custom_collectives.py:10-154`` and the two-phase algorithm in
+``deepspeed/runtime/fp16/onebit_adam.py:104-228``):
+
+- Phase 1 (reference ``gather_cuda/gather_host``): every worker sign-compresses its buffer
+  (1 bit/element + one fp32 RMS scale) and sends chunk *j* to server *j*. Here that is one
+  ``lax.all_to_all`` of **int8** signs inside ``shard_map`` — int8 stays on the ICI wire,
+  the fp32 upcast happens after receipt — plus an ``all_gather`` of the dp scalar scales.
+- Server reduction: each device averages the dp received sign·scale chunks, applies its
+  server error feedback, and re-compresses (reference onebit_adam.py:168-189).
+- Phase 2 (reference ``allgather_cuda/allgather_host``): ``all_gather`` of the int8 server
+  signs + scalar server scales reconstructs the full averaged buffer on every device.
+
+Wire volume per device: n/8·(wire bits)=n bytes int8 out + n bytes in + O(dp) scalars,
+vs 4n·2 for a ring fp32 allreduce — the reference's "5x less communication" claim scales
+the same way (we ship int8 rather than packed bits: XLA has no sub-byte wire type, so the
+compression factor is 4x rather than 32x, traded for zero pack/unpack kernels).
+
+The caller keeps persistent ``worker_error`` (dp, n) and ``server_error`` (dp, n/dp)
+buffers sharded ``P('data', None)`` so each device's row is resident exactly where the
+shard_map body needs it.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS
+
+
+def _sign_compress(buf):
+    """RMS-scaled sign compression: returns (int8 signs, fp32 scale, residual error).
+
+    Matches the reference's ``worker_scale = norm(buf)/sqrt(numel)`` and sign(0) -> +1
+    convention (onebit_adam.py:124-130: sign().add_(1).bool() maps {0,+1} to +1).
+    """
+    scale = jnp.sqrt(jnp.mean(jnp.square(buf)))
+    signs = jnp.where(buf >= 0, 1, -1).astype(jnp.int8)
+    error = buf - scale * signs.astype(jnp.float32)
+    return signs, scale, error
+
+
+def compressed_allreduce(mesh: Mesh, x, worker_error, server_error, axis_name: str = DATA_AXIS):
+    """Average per-worker buffers ``x`` across the ``data`` axis with 1-bit compression.
+
+    Args:
+      mesh: the device mesh (collectives run over its ``axis_name`` axis).
+      x: (dp, n) fp32 — row *i* is worker *i*'s buffer; sharded ``P(data, None)``.
+      worker_error: (dp, n) fp32 persistent worker error feedback, sharded ``P(data, None)``.
+      server_error: (dp, n // dp) fp32 persistent server error feedback, same sharding.
+        ``n`` must be divisible by dp.
+
+    Returns:
+      (out, new_worker_error, new_server_error): ``out`` is the (n,) compressed average,
+      replicated; the error buffers keep their (dp, ...) sharded layout.
+    """
+    dp = mesh.shape[axis_name]
+    n = x.shape[-1]
+    assert n % dp == 0, f"buffer size {n} must be divisible by dp={dp} (pad first)"
+    chunk = n // dp
+
+    def body(x_row, we_row, se_row):
+        # Per-device shapes: x_row/we_row (1, n); se_row (1, chunk).
+        corrected = x_row[0] + we_row[0]
+        signs, wscale, new_we = _sign_compress(corrected)
+
+        # Phase 1: chunk j of my signs -> server j (int8 on the wire).
+        send = signs.reshape(dp, chunk)
+        recv = jax.lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0, tiled=False)
+        recv = recv.reshape(dp, chunk)
+        wscales = jax.lax.all_gather(wscale, axis_name)  # (dp,)
+
+        server_m = jnp.mean(recv.astype(jnp.float32) * wscales[:, None], axis=0)  # (chunk,)
+        corrected_s = server_m + se_row[0]
+        s_signs, sscale, new_se = _sign_compress(corrected_s)
+
+        # Phase 2: allgather the compressed server chunks.
+        all_signs = jax.lax.all_gather(s_signs, axis_name)  # (dp, chunk) int8
+        sscales = jax.lax.all_gather(sscale, axis_name)     # (dp,)
+        out = (all_signs.astype(jnp.float32) * sscales[:, None]).reshape(n)
+        return out, new_we[None], new_se[None]
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(P(axis_name, None), P(axis_name, None), P(axis_name, None)),
+                   out_specs=(P(), P(axis_name, None), P(axis_name, None)),
+                   check_vma=False)
+    return fn(x, worker_error, server_error)
+
+
+def padded_size(n: int, dp: int, lanes: int = 128) -> int:
+    """Round ``n`` up so each of the dp server chunks is a whole multiple of the TPU
+    lane width (reference pads to ``size * divider``, onebit_adam.py:294-299)."""
+    quantum = dp * lanes
+    return ((n + quantum - 1) // quantum) * quantum

@@ -159,6 +159,14 @@ class DeepSpeedEngine:
         zero_stage = self.zero_optimization_stage()
         self._repl = lambda tree: replicated_sharding(self.mesh, tree)
         master_fp32 = jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32), model_parameters)
+        # 1-bit Adam needs per-worker (unreduced) gradients: grads are kept stacked with a
+        # leading dp axis sharded over 'data' (reference onebit_adam.py:335-336 relies on
+        # engine.enable_backward_allreduce=False for the same effect).
+        self._use_stacked_grads = (self.config.optimizer_name == ONEBIT_ADAM_OPTIMIZER
+                                   and (optimizer is None or isinstance(optimizer, str)))
+        if self._use_stacked_grads:
+            assert zero_stage == 0, "1-bit Adam does not compose with ZeRO (reference parity)"
+            assert param_shardings is None, "1-bit Adam requires replicated parameters"
         if param_shardings is not None:
             # caller-provided layout (pipe-stacked stages, TP-sharded weights, ...);
             # ZeRO composes on top by claiming a free data-divisible axis per leaf
@@ -171,9 +179,13 @@ class DeepSpeedEngine:
         else:
             self._master_shardings = zero_sharding(self.mesh, master_fp32, zero_stage)
             self._param_shardings = replicated_sharding(self.mesh, master_fp32)
-            # stage 2: accumulated grads live reduce-scattered; stage<=1: replicated
-            self._grad_shardings = (zero_sharding(self.mesh, master_fp32, zero_stage)
-                                    if zero_stage >= 2 else replicated_sharding(self.mesh, master_fp32))
+            if self._use_stacked_grads:
+                self._grad_shardings = jax.tree_util.tree_map(
+                    lambda _: NamedSharding(self.mesh, P(DATA_AXIS)), master_fp32)
+            else:
+                # stage 2: accumulated grads live reduce-scattered; stage<=1: replicated
+                self._grad_shardings = (zero_sharding(self.mesh, master_fp32, zero_stage)
+                                        if zero_stage >= 2 else replicated_sharding(self.mesh, master_fp32))
 
         self.master_params = jax.device_put(master_fp32, self._master_shardings)
         self.params = jax.device_put(
@@ -282,7 +294,8 @@ class DeepSpeedEngine:
             if name == ONEBIT_ADAM_OPTIMIZER:
                 from ..ops import onebit_adam as onebit
                 freeze_step = (self.config.optimizer_params or {}).get("freeze_step", 100000)
-                self._onebit = onebit.OneBitAdam(freeze_step=freeze_step, dp_size=self.dp_size)
+                self._onebit = onebit.OneBitAdam(freeze_step=freeze_step, dp_size=self.dp_size,
+                                                 mesh=self.mesh)
                 self._opt_init, self._opt_apply = self._onebit.init, self._onebit.apply
             elif name in _OPTIMIZER_APPLY:
                 self._opt_init, self._opt_apply = _OPTIMIZER_APPLY[name]
@@ -300,7 +313,9 @@ class DeepSpeedEngine:
                 return self._master_shardings
             return replicated_sharding(self.mesh, field)
 
-        if hasattr(opt_state_zero, "_fields"):
+        if hasattr(self, "_onebit"):
+            self._opt_shardings = self._onebit.state_shardings(self.mesh)
+        elif hasattr(opt_state_zero, "_fields"):
             self._opt_shardings = type(opt_state_zero)(*[field_shardings(f) for f in opt_state_zero])
         elif jax.tree_util.tree_structure(opt_state_zero) == params_treedef:
             self._opt_shardings = self._master_shardings
@@ -345,8 +360,9 @@ class DeepSpeedEngine:
         hysteresis = self.config.hysteresis
         predivide = float(self.config.gradient_predivide_factor or 1.0)
         prescale = self.config.prescale_gradients
+        use_stacked = self._use_stacked_grads
 
-        def loss_and_grad(params, scale, *batch):
+        def local_loss_and_grad(params, scale, *batch):
             def scaled_loss_fn(p):
                 out = model_fn(p, *batch)
                 loss = out[0] if isinstance(out, (tuple, list)) else out
@@ -357,6 +373,31 @@ class DeepSpeedEngine:
             (_, loss), grads = jax.value_and_grad(scaled_loss_fn, has_aux=True)(params)
             grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
             return loss, grads
+
+        if self._use_stacked_grads:
+            # 1-bit Adam path: keep per-worker grads stacked over a leading dp axis
+            # instead of letting XLA psum them — the compressed allreduce in the optimizer
+            # replaces the gradient averaging (reference disables engine allreduce when
+            # frozen, onebit_adam.py:372).
+            from jax import shard_map
+            param_specs = jax.tree_util.tree_map(lambda _: P(), self.params)
+
+            def loss_and_grad(params, scale, *batch):
+                def local(params, scale, *local_batch):
+                    loss, grads = local_loss_and_grad(params, scale, *local_batch)
+                    loss = jax.lax.pmean(loss, DATA_AXIS)
+                    grads = jax.tree_util.tree_map(lambda g: g[None], grads)
+                    return loss, grads
+
+                batch_specs = tuple(P(DATA_AXIS) for _ in batch)
+                fn = shard_map(local, mesh=self.mesh,
+                               in_specs=(param_specs, P()) + batch_specs,
+                               out_specs=(P(), jax.tree_util.tree_map(lambda _: P(DATA_AXIS),
+                                                                      self.params)),
+                               check_vma=False)
+                return fn(params, scale, *batch)
+        else:
+            loss_and_grad = local_loss_and_grad
 
         # Inputs carry their shardings (params/batch were device_put with the right
         # layouts); out_shardings on the grads is what makes stage-2 store them
@@ -381,7 +422,12 @@ class DeepSpeedEngine:
             grads = jax.tree_util.tree_map(lambda g: g * inv, acc_grads)
             if prescale and predivide != 1.0:
                 grads = jax.tree_util.tree_map(lambda g: g * predivide, grads)
-            norm = global_norm(grads)
+            if use_stacked:
+                # stacked per-worker grads: the logical gradient is the worker mean —
+                # clip/report on that, not on the sqrt(dp)-inflated stacked norm
+                norm = global_norm(jax.tree_util.tree_map(lambda g: jnp.mean(g, axis=0), grads))
+            else:
+                norm = global_norm(grads)
             if clip > 0:
                 grads = clip_grads_by_global_norm(grads, clip, norm=norm)
 
