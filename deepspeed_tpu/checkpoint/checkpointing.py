@@ -189,13 +189,20 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             opt_flat = engine._onebit.elastic_adapt(opt_flat, _flatten_with_paths(engine.opt_state))
         master = _unflatten_like(engine.master_params, master_flat)
         opt = _unflatten_like(engine.opt_state, opt_flat)
-        engine.master_params = jax.device_put(master, engine._master_shardings)
-        engine.opt_state = jax.device_put(opt, engine._opt_shardings)
+        if getattr(engine, "_offload", None) is not None:
+            # host-tier state: copy into the flat offload buffers (views stay aliased)
+            engine._offload.load_trees(master, opt.exp_avg, opt.exp_avg_sq)
+        else:
+            engine.master_params = jax.device_put(master, engine._master_shardings)
+            engine.opt_state = jax.device_put(opt, engine._opt_shardings)
     else:
         # re-derive master from loaded params (fp16-derived restore, stage2.py:1781-1836)
-        engine.master_params = jax.device_put(
-            jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32), engine.params),
-            engine._master_shardings)
+        if getattr(engine, "_offload", None) is not None:
+            engine._offload.load_trees(master_tree=engine.params)
+        else:
+            engine.master_params = jax.device_put(
+                jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32), engine.params),
+                engine._master_shardings)
 
     logger.info(f"[deepspeed_tpu] loaded checkpoint {tag} from {load_dir} "
                 f"(saved dp={meta['dp_world_size']}, current dp={engine.dp_size})")

@@ -1,0 +1,119 @@
+// Host-side SIMD Adam for ZeRO-Offload.
+//
+// TPU-native analog of the reference's csrc/adam/cpu_adam.cpp (AVX512/AVX256 + OpenMP
+// Adam over fp32 host arrays, cpu_adam.cpp:21,151,336) and its fused
+// ds_adam_step_plus_copy (cpu_adam.cpp:592): on a TPU-VM the offloaded optimizer state
+// lives in host DRAM and the updated parameters are pushed back to HBM in bf16, so the
+// fused variant converts fp32 -> bf16 (round-to-nearest-even) in the same pass instead
+// of fp16.
+//
+// Vectorization strategy: instead of the reference's hand-written AVX intrinsic ladder,
+// the loops are written to be trivially auto-vectorizable (restrict pointers, no
+// branches in the hot path) and compiled with -O3 -march=native -fopenmp; gcc emits the
+// same fused AVX2/AVX512 code the intrinsics would, and the source stays portable to
+// any TPU-VM host ISA (x86 or ARM).
+
+#include <cmath>
+#include <cstdint>
+
+extern "C" {
+
+// One Adam/AdamW step over a flat fp32 buffer. All state updated in place.
+//   adamw != 0    -> decoupled weight decay: p -= lr * (m_hat/denom + wd * p)
+//   adamw == 0    -> L2-style decay matching ops/adam.py: p -= lr*update + lr*wd*p
+//   bias_correction != 0 -> m_hat = m/(1-b1^t), v_hat = v/(1-b2^t)
+void ds_adam_step(float* __restrict__ p,
+                  const float* __restrict__ g,
+                  float* __restrict__ m,
+                  float* __restrict__ v,
+                  int64_t n,
+                  int32_t step,
+                  float lr,
+                  float beta1,
+                  float beta2,
+                  float eps,
+                  float weight_decay,
+                  int32_t adamw,
+                  int32_t bias_correction) {
+  float bc1 = 1.0f, bc2 = 1.0f;
+  if (bias_correction) {
+    bc1 = 1.0f - powf(beta1, (float)step);
+    bc2 = 1.0f - powf(beta2, (float)step);
+  }
+  const float inv_bc1 = 1.0f / bc1;
+  const float inv_sqrt_bc2 = 1.0f / sqrtf(bc2);
+  const float omb1 = 1.0f - beta1;
+  const float omb2 = 1.0f - beta2;
+  const float wd_factor = lr * weight_decay;
+
+#pragma omp parallel for simd schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    const float grad = g[i];
+    const float mi = beta1 * m[i] + omb1 * grad;
+    const float vi = beta2 * v[i] + omb2 * grad * grad;
+    m[i] = mi;
+    v[i] = vi;
+    const float denom = sqrtf(vi) * inv_sqrt_bc2 + eps;
+    const float update = (mi * inv_bc1) / denom;
+    // both decay modes reduce to the same fused form: p -= lr*update + lr*wd*p
+    // (matches ops/adam.py:52-57, where the reference FusedAdam also decays p directly)
+    p[i] = p[i] - lr * update - wd_factor * p[i];
+  }
+  (void)adamw;  // both modes share the fused decay form above
+}
+
+static inline uint16_t fp32_to_bf16_rne(float x) {
+  union {
+    float f;
+    uint32_t u;
+  } bits;
+  bits.f = x;
+  const uint32_t rounding = 0x7FFFu + ((bits.u >> 16) & 1u);
+  return (uint16_t)((bits.u + rounding) >> 16);
+}
+
+// Fused step + bf16 cast of the updated parameters (analog of ds_adam_step_plus_copy,
+// cpu_adam.cpp:592: the reference overlaps an async H2D fp16 copy; here the bf16 staging
+// buffer is handed to jax.device_put which owns the H2D DMA).
+void ds_adam_step_copy(float* __restrict__ p,
+                       const float* __restrict__ g,
+                       float* __restrict__ m,
+                       float* __restrict__ v,
+                       uint16_t* __restrict__ out_bf16,
+                       int64_t n,
+                       int32_t step,
+                       float lr,
+                       float beta1,
+                       float beta2,
+                       float eps,
+                       float weight_decay,
+                       int32_t adamw,
+                       int32_t bias_correction) {
+  float bc1 = 1.0f, bc2 = 1.0f;
+  if (bias_correction) {
+    bc1 = 1.0f - powf(beta1, (float)step);
+    bc2 = 1.0f - powf(beta2, (float)step);
+  }
+  const float inv_bc1 = 1.0f / bc1;
+  const float inv_sqrt_bc2 = 1.0f / sqrtf(bc2);
+  const float omb1 = 1.0f - beta1;
+  const float omb2 = 1.0f - beta2;
+  const float wd_factor = lr * weight_decay;
+
+#pragma omp parallel for simd schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    const float grad = g[i];
+    const float mi = beta1 * m[i] + omb1 * grad;
+    const float vi = beta2 * v[i] + omb2 * grad * grad;
+    m[i] = mi;
+    v[i] = vi;
+    const float denom = sqrtf(vi) * inv_sqrt_bc2 + eps;
+    const float update = (mi * inv_bc1) / denom;
+    const float pi = p[i] - lr * update - wd_factor * p[i];
+    p[i] = pi;
+    out_bf16[i] = fp32_to_bf16_rne(pi);
+  }
+  (void)adamw;
+}
+
+}  // extern "C"

@@ -187,7 +187,16 @@ class DeepSpeedEngine:
                 self._grad_shardings = (zero_sharding(self.mesh, master_fp32, zero_stage)
                                         if zero_stage >= 2 else replicated_sharding(self.mesh, master_fp32))
 
-        self.master_params = jax.device_put(master_fp32, self._master_shardings)
+        # ---- ZeRO-Offload: master weights + optimizer state live in host DRAM ----
+        # (reference stage2.py:333-349 keeps fp32 master/grads pinned on host and steps
+        # DeepSpeedCPUAdam there; on a TPU-VM "host" is the VM's DRAM tier)
+        self._offload = None
+        if self.zero_optimization() and self.zero_cpu_offload():
+            from ..ops.cpu_adam import DeepSpeedCPUAdam
+            self._offload = DeepSpeedCPUAdam(master_fp32)
+            self.master_params = self._offload.params_tree()  # zero-copy host views
+        else:
+            self.master_params = jax.device_put(master_fp32, self._master_shardings)
         self.params = jax.device_put(
             jax.tree_util.tree_map(lambda p: p.astype(self.compute_dtype), master_fp32),
             self._param_shardings)
@@ -281,6 +290,22 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------------ setup
     def _configure_optimizer(self, client_optimizer):
+        if self._offload is not None:
+            # Host-tier optimizer: the engine steps DeepSpeedCPUAdam directly
+            # (reference engine.py:560-566 requires the cpu_adam op under ZeRO-Offload).
+            name = self.config.optimizer_name or ADAM_OPTIMIZER
+            assert name in (ADAM_OPTIMIZER, ADAMW_OPTIMIZER), \
+                f"ZeRO-Offload supports Adam/AdamW (got {name!r})"
+            assert client_optimizer is None or isinstance(client_optimizer, str), \
+                "ZeRO-Offload steps the host-side DeepSpeedCPUAdam; client optimizers unsupported"
+            self.optimizer = OptimizerHandle(name, self.config.optimizer_params or {})
+            from ..ops.adam import AdamState
+            self.opt_state = AdamState(exp_avg=self._offload.exp_avg_tree(),
+                                       exp_avg_sq=self._offload.exp_avg_sq_tree())
+            log_dist("Using ZeRO-Offload: host-tier DeepSpeedCPUAdam "
+                     f"({'native' if self._offload._lib is not None else 'numpy'} kernel, "
+                     f"{self._offload.numel} master elements)", ranks=[0])
+            return
         if client_optimizer is not None and not isinstance(client_optimizer, str):
             # client-provided (init, apply) pair or OptimizerHandle-compatible object
             if isinstance(client_optimizer, tuple) and len(client_optimizer) == 2:
@@ -353,7 +378,7 @@ class DeepSpeedEngine:
         clip = float(self.gradient_clipping() or 0.0)
         compute_dtype = self.compute_dtype
         model_fn = self.model_fn
-        opt_apply = self._opt_apply
+        opt_apply = getattr(self, "_opt_apply", None)  # None under ZeRO-Offload (host step)
         dynamic = self._dynamic_scale
         scale_window = self.config.loss_scale_window
         min_scale = self.config.min_loss_scale
@@ -443,6 +468,9 @@ class DeepSpeedEngine:
             new_params = jax.tree_util.tree_map(lambda p: p.astype(compute_dtype), new_master)
             return new_master, new_opt, new_scaler, new_params, overflow, norm
 
+        if self._offload is not None:
+            return  # step happens on host (_take_model_step_offload); no jitted update
+
         scalar_shard = NamedSharding(self.mesh, P())
         self._jit_apply_update = jax.jit(
             apply_update,
@@ -520,13 +548,57 @@ class DeepSpeedEngine:
     def _take_model_step(self):
         if self.wall_clock_breakdown():
             self.timers("step_microstep").start()
+        if self._offload is not None:
+            overflow_bool = self._offload_step()
+            self._finish_step(overflow_bool)
+            return
         hyper = self.optimizer.current_hyper()
         step = jnp.asarray(self.global_steps + 1 - self.skipped_steps, jnp.int32)
         (self.master_params, self.opt_state, self.scaler_state, self.params,
          overflow, self._last_grad_norm) = self._jit_apply_update(
             self.master_params, self.opt_state, self.scaler_state, self._grad_acc, step, hyper)
+        self._finish_step(self.fp16_enabled() and bool(jax.device_get(overflow)))
+
+    def _offload_step(self) -> bool:
+        """Host-tier optimizer step (ZeRO-Offload): D2H grads, native CPU Adam over the
+        flat fp32 master buffer, H2D push of compute-dtype params (reference
+        stage2.py:1417-1424 + cpu_adam.cpp ds_adam_step_plus_copy)."""
+        grads_flat = self._offload.flatten_grads(self._grad_acc)  # D2H, fp32
+        scale = float(jax.device_get(self.scaler_state.cur_scale))
+        overflow = bool(not np.all(np.isfinite(grads_flat))) if self.fp16_enabled() else False
+        if scale != 1.0 and scale > 0:
+            grads_flat *= 1.0 / scale
+        predivide = float(self.config.gradient_predivide_factor or 1.0)
+        if self.config.prescale_gradients and predivide != 1.0:
+            grads_flat *= predivide
+        norm = float(np.linalg.norm(grads_flat))
+        self._last_grad_norm = norm
+        clip = float(self.gradient_clipping() or 0.0)
+        if clip > 0 and norm > clip:
+            grads_flat *= clip / (norm + 1e-6)
+
+        if not overflow:
+            g = self.optimizer.param_groups[0]
+            step_count = self.global_steps + 1 - self.skipped_steps
+            kw = dict(lr=g["lr"], beta1=g["betas"][0], beta2=g["betas"][1], eps=g["eps"],
+                      weight_decay=g["weight_decay"])
+            if self.compute_dtype == jnp.bfloat16:
+                flat_out = self._offload.step_and_cast_bf16(grads_flat, step_count, **kw)
+            else:
+                self._offload.step(grads_flat, step_count, **kw)
+                flat_out = self._offload.fp32
+                if self.compute_dtype != jnp.float32:
+                    flat_out = flat_out.astype(np.float16)
+            self.params = jax.device_put(self._offload.tree_of(flat_out), self._param_shardings)
+        self.scaler_state = ls.update(
+            self.scaler_state, jnp.asarray(overflow), dynamic=self._dynamic_scale,
+            scale_window=self.config.loss_scale_window, min_scale=self.config.min_loss_scale,
+            hysteresis=self.config.hysteresis)
+        return overflow
+
+    def _finish_step(self, overflowed: bool):
         self._grad_acc = None
-        if self.fp16_enabled() and bool(jax.device_get(overflow)):
+        if overflowed:
             self.skipped_steps += 1
             logger.info("[deepspeed_tpu] OVERFLOW! Skipping step.")
         else:

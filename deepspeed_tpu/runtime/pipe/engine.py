@@ -60,6 +60,9 @@ class PipelineEngine(DeepSpeedEngine):
                          model_parameters=canonical, training_data=training_data,
                          lr_scheduler=lr_scheduler, mpu=None, dist_init_required=dist_init_required,
                          collate_fn=collate_fn, config_params=config_params, mesh=mesh)
+        assert self._offload is None, \
+            "cpu_offload is not supported with pipeline parallelism (the pipeline " \
+            "optimizer step runs on device; reference pairs offload with plain ZeRO-2 only)"
 
         self.micro_batches = self.gradient_accumulation_steps()
         self._compile_stage_fns()

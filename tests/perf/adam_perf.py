@@ -1,0 +1,42 @@
+"""CPU Adam microbenchmark (analog of reference tests/perf/adam_test.py: 1B-param
+timing). Run directly: python tests/perf/adam_perf.py [numel]."""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from deepspeed_tpu.ops.cpu_adam import DeepSpeedCPUAdam  # noqa: E402
+
+
+def main():
+    numel = int(sys.argv[1]) if len(sys.argv) > 1 else 64 * 1024 * 1024
+    params = {"w": np.zeros(numel, np.float32)}
+    rng = np.random.default_rng(0)
+    g = rng.normal(size=numel).astype(np.float32)
+
+    native = DeepSpeedCPUAdam(params)
+    fallback = DeepSpeedCPUAdam(params)
+    fallback._lib = None
+
+    def bench(opt, label, iters=5):
+        opt.step(g, step=1, lr=1e-3)  # warm
+        t0 = time.perf_counter()
+        for i in range(iters):
+            opt.step(g, step=i + 2, lr=1e-3)
+        dt = (time.perf_counter() - t0) / iters
+        print(f"{label:8s}: {dt * 1e3:8.2f} ms/step  "
+              f"({numel / dt / 1e9:6.2f} Gelem/s)")
+        return dt
+
+    t_np = bench(fallback, "numpy")
+    if native._lib is not None:
+        t_nat = bench(native, "native")
+        print(f"native speedup vs numpy: {t_np / t_nat:.1f}x")
+    else:
+        print("native kernel unavailable")
+
+
+if __name__ == "__main__":
+    main()
