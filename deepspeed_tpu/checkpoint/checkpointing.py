@@ -38,7 +38,9 @@ def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
     return flat
 
 
-def _unflatten_like(template, flat: Dict[str, np.ndarray]):
+def _unflatten_like(template, flat: Dict[str, np.ndarray], numpy: bool = False):
+    """``numpy=True`` keeps leaves as host arrays — required for the offload path,
+    whose fp32 master+moments may not fit on device at all."""
     paths, treedef = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
     for path, leaf in paths:
@@ -46,7 +48,10 @@ def _unflatten_like(template, flat: Dict[str, np.ndarray]):
         if key not in flat:
             raise KeyError(f"checkpoint missing array {key!r}")
         arr = flat[key]
-        leaves.append(jnp.asarray(arr, dtype=leaf.dtype).reshape(leaf.shape))
+        if numpy:
+            leaves.append(np.asarray(arr, dtype=np.dtype(leaf.dtype)).reshape(leaf.shape))
+        else:
+            leaves.append(jnp.asarray(arr, dtype=leaf.dtype).reshape(leaf.shape))
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
@@ -187,12 +192,15 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             # OneBitAdam state sizes are dp-dependent (padded moments, per-worker error
             # buffers); adapt them instead of failing the reshape below.
             opt_flat = engine._onebit.elastic_adapt(opt_flat, _flatten_with_paths(engine.opt_state))
-        master = _unflatten_like(engine.master_params, master_flat)
-        opt = _unflatten_like(engine.opt_state, opt_flat)
         if getattr(engine, "_offload", None) is not None:
-            # host-tier state: copy into the flat offload buffers (views stay aliased)
+            # host-tier state: unflatten on the host and copy into the flat offload
+            # buffers (views stay aliased) — never materialize master/moments on device
+            master = _unflatten_like(engine.master_params, master_flat, numpy=True)
+            opt = _unflatten_like(engine.opt_state, opt_flat, numpy=True)
             engine._offload.load_trees(master, opt.exp_avg, opt.exp_avg_sq)
         else:
+            master = _unflatten_like(engine.master_params, master_flat)
+            opt = _unflatten_like(engine.opt_state, opt_flat)
             engine.master_params = jax.device_put(master, engine._master_shardings)
             engine.opt_state = jax.device_put(opt, engine._opt_shardings)
     else:

@@ -1,0 +1,7 @@
+"""Cluster launch front-end (reference deepspeed/launcher/).
+
+``runner`` is the user-facing CLI (hostfile → fan-out), ``launch`` the per-node
+process spawner, ``multinode_runner`` the pdsh/mpirun backends.
+"""
+
+from . import constants  # noqa: F401

@@ -1,0 +1,28 @@
+"""Launcher constants (reference deepspeed/launcher/constants.py).
+
+The default port doubles as the JAX distributed coordinator port: the runner's
+``--master_addr/--master_port`` become ``coordinator_address`` for
+``jax.distributed.initialize`` instead of torch.distributed's MASTER_* rendezvous.
+"""
+
+# Coordinator (rank-0) port used for jax.distributed service rendezvous.
+DEFAULT_COORDINATOR_PORT = 29500
+# Kept as an alias for scripts written against the reference name.
+TORCH_DISTRIBUTED_DEFAULT_PORT = DEFAULT_COORDINATOR_PORT
+
+PDSH_LAUNCHER = "pdsh"
+PDSH_MAX_FAN_OUT = 1024
+
+OPENMPI_LAUNCHER = "openmpi"
+
+MVAPICH_LAUNCHER = "mvapich"
+MVAPICH_TMP_HOSTFILE = "/tmp/deepspeed_tpu_mvapich_hostfile"
+
+# Hostfile default location (reference launcher/runner.py:26).
+DLTS_HOSTFILE = "/job/hostfile"
+
+# Env prefixes forwarded to remote nodes (reference EXPORT_ENVS had NCCL/PYTHON/MV2/UCX;
+# the TPU-relevant set is the libtpu/JAX/XLA family).
+EXPORT_ENVS = ["TPU", "JAX", "XLA", "LIBTPU", "PYTHON", "TF_CPP", "MV2", "UCX"]
+
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"

@@ -1,0 +1,124 @@
+"""Per-node process spawner.
+
+TPU-native analog of ``deepspeed/launcher/launch.py:65-128``. The reference spawned
+one process per GPU, pinning ``CUDA_VISIBLE_DEVICES`` and torch.distributed MASTER_*
+env. Here each slot becomes one JAX process: we pin the libtpu chip-visibility env
+(``TPU_VISIBLE_DEVICES`` plus process bounds) and export the jax.distributed
+coordinator triple (address, process count, process id) that
+``deepspeed_tpu.runtime.dist.init_distributed`` consumes. RANK/WORLD_SIZE/LOCAL_RANK
+and MASTER_ADDR/PORT are exported too so scripts written against the reference's env
+contract keep working.
+
+The common TPU-pod deployment is ONE slot per host (a single process owning every
+local chip) — the hostfile then says ``slots=1`` and no chip pinning is emitted.
+"""
+
+import base64
+import json
+import os
+import subprocess
+import sys
+from argparse import REMAINDER, ArgumentParser
+from collections import defaultdict
+
+from ..utils import logger
+from .constants import DEFAULT_COORDINATOR_PORT
+
+
+def parse_args(args=None):
+    parser = ArgumentParser(description="deepspeed_tpu per-node launcher: spawns one JAX "
+                                        "process per local slot.")
+    parser.add_argument("--node_rank", type=int, default=0,
+                        help="Rank of this node in the world-info host list.")
+    parser.add_argument("--master_addr", default="127.0.0.1", type=str,
+                        help="Coordinator (node 0) address for jax.distributed.")
+    parser.add_argument("--master_port", default=DEFAULT_COORDINATOR_PORT, type=int,
+                        help="Coordinator port.")
+    parser.add_argument("--world_info", default="None", type=str,
+                        help="base64-encoded {host: [slot ids]} dictionary.")
+    parser.add_argument("training_script", type=str,
+                        help="User training script (launched once per local slot).")
+    parser.add_argument("training_script_args", nargs=REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def build_rank_mapping(world_info: dict):
+    """Global rank assignment: hosts in world-info order, slots in-order within a host
+    (reference launch.py:90-101). Returns ({host: [global ranks]}, world_size)."""
+    global_rank_mapping = defaultdict(list)
+    rank = 0
+    for node_id, gids in world_info.items():
+        for _ in gids:
+            global_rank_mapping[node_id].append(rank)
+            rank += 1
+    return dict(global_rank_mapping), rank
+
+
+def child_env(base_env: dict, world_info: dict, node_rank: int, local_rank: int,
+              master_addr: str, master_port: int) -> dict:
+    """Environment for one spawned process. Pure function for testability.
+
+    Exports both the jax.distributed triple (DS_COORDINATOR_ADDRESS /
+    DS_NUM_PROCESSES / DS_PROCESS_ID) and the reference-compatible
+    RANK/WORLD_SIZE/LOCAL_RANK/MASTER_* spellings.
+    """
+    node_list = list(world_info.keys())
+    local_node = node_list[node_rank]
+    local_slot_ids = world_info[local_node]
+    mapping, world_size = build_rank_mapping(world_info)
+    dist_rank = mapping[local_node][local_rank]
+
+    env = dict(base_env)
+    env["MASTER_ADDR"] = master_addr
+    env["MASTER_PORT"] = str(master_port)
+    env["WORLD_SIZE"] = str(world_size)
+    env["RANK"] = str(dist_rank)
+    env["LOCAL_RANK"] = str(local_rank)
+    env["DS_COORDINATOR_ADDRESS"] = f"{master_addr}:{master_port}"
+    env["DS_NUM_PROCESSES"] = str(world_size)
+    env["DS_PROCESS_ID"] = str(dist_rank)
+
+    num_local = len(local_slot_ids)
+    if num_local > 1:
+        # Multiple processes sharing one host's chips: pin this process to its chip.
+        chip = str(local_slot_ids[local_rank])
+        env["TPU_VISIBLE_DEVICES"] = chip
+        env["CUDA_VISIBLE_DEVICES"] = chip  # GPU/CPU-cluster parity
+        # libtpu multi-process-per-host topology hints: 1 chip per process.
+        env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = "1,1,1"
+        env.setdefault("TPU_PROCESS_PORT_BASE", "8476")
+    return env
+
+
+def main(args=None):
+    args = parse_args(args)
+    current_env = os.environ.copy()
+
+    assert args.world_info != "None", "must provide world info dict"
+    world_info = json.loads(base64.urlsafe_b64decode(args.world_info))
+    logger.info(f"WORLD INFO DICT: {world_info}")
+
+    node_list = list(world_info.keys())
+    local_node = node_list[args.node_rank]
+    num_local_procs = len(world_info[local_node])
+    mapping, world_size = build_rank_mapping(world_info)
+    logger.info(f"nnodes={len(node_list)}, num_local_procs={num_local_procs}, "
+                f"node_rank={args.node_rank}, world_size={world_size}")
+
+    processes = []
+    for local_rank in range(num_local_procs):
+        env = child_env(current_env, world_info, args.node_rank, local_rank,
+                        args.master_addr, args.master_port)
+        cmd = [sys.executable, "-u", args.training_script,
+               f"--local_rank={local_rank}"] + args.training_script_args
+        processes.append(subprocess.Popen(cmd, env=env))
+
+    exit_code = 0
+    for process in processes:
+        process.wait()
+        exit_code = exit_code or process.returncode
+    sys.exit(exit_code)
+
+
+if __name__ == "__main__":
+    main()

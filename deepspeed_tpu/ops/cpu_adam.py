@@ -55,6 +55,8 @@ class DeepSpeedCPUAdam:
         self.exp_avg = np.zeros(self.numel, np.float32)
         self.exp_avg_sq = np.zeros(self.numel, np.float32)
         self._bf16 = None  # staging buffer (2 B/param), allocated on first bf16 step
+        self._fp16 = None  # staging buffer for the fp16 compute-dtype path
+        self._grad_buf = np.empty(self.numel, np.float32)  # D2H landing buffer
         self.adamw = adamw
         self.bias_correction = bias_correction
         self._lib = load_cpu_adam()
@@ -76,9 +78,17 @@ class DeepSpeedCPUAdam:
         return self.tree_of(self.exp_avg_sq)
 
     def flatten_grads(self, grads_tree) -> np.ndarray:
-        leaves = jax.tree_util.tree_leaves(grads_tree)
-        return np.concatenate([np.asarray(jax.device_get(l), np.float32).reshape(-1)
-                               for l in leaves])
+        # One batched D2H transfer for all leaves, copied into a persistent flat
+        # buffer: avoids per-leaf blocking transfers and a fresh numel-sized
+        # allocation every step (this D2H is the hot cost of the offload path).
+        leaves = jax.device_get(jax.tree_util.tree_leaves(grads_tree))
+        offset = 0
+        for l in leaves:
+            flat = np.asarray(l, np.float32).reshape(-1)
+            self._grad_buf[offset:offset + flat.size] = flat
+            offset += flat.size
+        assert offset == self.numel
+        return self._grad_buf
 
     # ------------------------------------------------------------- update
     def step(self, grads_flat: np.ndarray, step: int, lr: float, beta1: float = 0.9,
@@ -134,10 +144,18 @@ class DeepSpeedCPUAdam:
             if src is not None:
                 np.copyto(dst, np.asarray(src, np.float32).reshape(-1))
 
+    def cast_fp16(self) -> np.ndarray:
+        """fp32 master → persistent fp16 staging buffer (no per-step allocation)."""
+        if self._fp16 is None:
+            self._fp16 = np.empty(self.numel, np.float16)
+        np.copyto(self._fp16, self.fp32, casting="unsafe")
+        return self._fp16
+
     def load_trees(self, master_tree=None, exp_avg_tree=None, exp_avg_sq_tree=None):
         def cat(tree):
             if tree is None:
                 return None
-            return np.concatenate([np.asarray(l, np.float32).reshape(-1)
-                                   for l in jax.tree_util.tree_leaves(tree)])
+            # one batched D2H for trees that still hold device arrays
+            leaves = jax.device_get(jax.tree_util.tree_leaves(tree))
+            return np.concatenate([np.asarray(l, np.float32).reshape(-1) for l in leaves])
         self.load_flat(cat(master_tree), cat(exp_avg_tree), cat(exp_avg_sq_tree))

@@ -47,16 +47,25 @@ def _build(source_path: str, tag: str):
         ["-O3", "-march=native"],   # toolchains without libgomp
         ["-O2"],                    # last resort: portable scalar build
     ]
+    # Compile to a process-private temp path and rename into place: rename is atomic,
+    # so a killed/timed-out compile can never leave a truncated .so at the cache path,
+    # and concurrent builders on one host race harmlessly.
+    tmp_path = f"{lib_path}.tmp.{os.getpid()}"
     for flags in flag_sets:
-        cmd = ["g++", "-shared", "-fPIC", "-std=c++17", *flags, "-o", lib_path, source_path]
+        cmd = ["g++", "-shared", "-fPIC", "-std=c++17", *flags, "-o", tmp_path, source_path]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp_path, lib_path)
             logger.info(f"[deepspeed_tpu] built native op {tag}: {' '.join(cmd)}")
             return lib_path
         except (subprocess.CalledProcessError, subprocess.TimeoutExpired, FileNotFoundError) as e:
             err = getattr(e, "stderr", b"")
             logger.warning(f"[deepspeed_tpu] native build of {tag} failed with {flags}: "
                            f"{err.decode(errors='replace')[:500] if err else e}")
+            try:
+                os.remove(tmp_path)
+            except OSError:
+                pass
     return None
 
 

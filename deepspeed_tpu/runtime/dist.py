@@ -1,0 +1,91 @@
+"""Multi-host distributed bootstrap.
+
+The reference hardcoded ``dist.init_process_group('nccl')`` in the engine
+(``deepspeed/runtime/engine.py:134-149``) with env-var rendezvous set by the
+launcher, plus MPI discovery (``engine.py:198-235``). The TPU-native equivalent is
+``jax.distributed.initialize``: every host joins a coordination service on node 0,
+after which ``jax.devices()`` spans the whole pod and all collectives ride ICI/DCN
+automatically — there are no process groups to manage.
+
+Identity is discovered in priority order:
+1. explicit arguments,
+2. DS_* / standard env set by ``deepspeed_tpu.launcher.launch`` (DS_COORDINATOR_ADDRESS,
+   DS_NUM_PROCESSES, DS_PROCESS_ID — with MASTER_ADDR/PORT + WORLD_SIZE/RANK fallbacks),
+3. OpenMPI env (OMPI_COMM_WORLD_SIZE/RANK) for `mpirun` launches (reference _mpi_check),
+4. Cloud TPU metadata via argument-less ``jax.distributed.initialize()`` when the
+   platform is TPU and more than one host is expected.
+"""
+
+import os
+from typing import Optional
+
+from ..utils import logger
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def _env_identity():
+    coord = os.environ.get("DS_COORDINATOR_ADDRESS")
+    if coord is None and os.environ.get("MASTER_ADDR"):
+        coord = f"{os.environ['MASTER_ADDR']}:{os.environ.get('MASTER_PORT', '29500')}"
+    nprocs = os.environ.get("DS_NUM_PROCESSES") or os.environ.get("WORLD_SIZE")
+    pid = os.environ.get("DS_PROCESS_ID") or os.environ.get("RANK")
+    if coord and nprocs is not None and pid is not None:
+        return coord, int(nprocs), int(pid)
+    # OpenMPI launch without the per-node launcher (reference engine.py:198-235).
+    if os.environ.get("OMPI_COMM_WORLD_SIZE") is not None:
+        nprocs = int(os.environ["OMPI_COMM_WORLD_SIZE"])
+        pid = int(os.environ["OMPI_COMM_WORLD_RANK"])
+        if coord is None:
+            raise RuntimeError("MPI launch detected but DS_COORDINATOR_ADDRESS is unset; "
+                               "export it (rank-0 host:port) or use the deepspeed_tpu launcher")
+        return coord, nprocs, pid
+    return None
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     local_device_ids=None) -> bool:
+    """Join the multi-host world if one is configured. Returns True when a
+    multi-process jax.distributed world is (or already was) live; False for
+    plain single-process runs (the overwhelmingly common dev path)."""
+    global _initialized
+    import jax
+
+    if _initialized:
+        return True
+
+    if coordinator_address is None:
+        ident = _env_identity()
+        if ident is None:
+            return False
+        coordinator_address, env_nprocs, env_pid = ident
+        num_processes = num_processes if num_processes is not None else env_nprocs
+        process_id = process_id if process_id is not None else env_pid
+
+    if num_processes is not None and num_processes <= 1:
+        return False
+
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id,
+                               local_device_ids=local_device_ids)
+    _initialized = True
+    logger.info(f"jax.distributed initialized: process {process_id}/{num_processes} "
+                f"via {coordinator_address}; global devices: {jax.device_count()}")
+    return True
+
+
+def get_rank() -> int:
+    import jax
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    import jax
+    return jax.process_count()

@@ -84,6 +84,11 @@ def make_engine(args=None, model=None, optimizer=None, model_parameters=None, tr
                 config_params=None):
     """Engine factory: dispatches to PipelineEngine for PipelineModule models
     (reference deepspeed/__init__.py:111-133)."""
+    if dist_init_required is not False:
+        # Join the multi-host world when the launcher configured one (reference
+        # engine.py:129-149 did dist.init_process_group here). No-op single-process.
+        from .dist import init_distributed
+        init_distributed()
     from ..parallel.pipe.module import PipelineModule
     if isinstance(model, PipelineModule):
         from .pipe.engine import PipelineEngine
@@ -192,6 +197,11 @@ class DeepSpeedEngine:
         # DeepSpeedCPUAdam there; on a TPU-VM "host" is the VM's DRAM tier)
         self._offload = None
         if self.zero_optimization() and self.zero_cpu_offload():
+            # The host-tier path device_gets sharded grads and steps a full master
+            # copy on this host; under a multi-process world those arrays span
+            # non-addressable devices. Fail fast rather than at the first step.
+            assert jax.process_count() == 1, \
+                "cpu_offload currently requires a single-process (single-host) run"
             from ..ops.cpu_adam import DeepSpeedCPUAdam
             self._offload = DeepSpeedCPUAdam(master_fp32)
             self.master_params = self._offload.params_tree()  # zero-copy host views
@@ -588,7 +598,7 @@ class DeepSpeedEngine:
                 self._offload.step(grads_flat, step_count, **kw)
                 flat_out = self._offload.fp32
                 if self.compute_dtype != jnp.float32:
-                    flat_out = flat_out.astype(np.float16)
+                    flat_out = self._offload.cast_fp16()
             self.params = jax.device_put(self._offload.tree_of(flat_out), self._param_shardings)
         self.scaler_state = ls.update(
             self.scaler_state, jnp.asarray(overflow), dynamic=self._dynamic_scale,

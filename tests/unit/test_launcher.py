@@ -1,0 +1,190 @@
+"""Launcher tests (mirrors reference tests/unit/test_run.py:6-91 plus the per-node
+rank-mapping/env logic that the reference left untested)."""
+
+import base64
+import json
+
+import pytest
+
+from deepspeed_tpu.launcher import runner as dsrun
+from deepspeed_tpu.launcher.launch import build_rank_mapping, child_env
+
+
+def test_parser_mutual_exclusion():
+    """cannot specify both include and exclude (reference test_run.py:6)."""
+    with pytest.raises(ValueError):
+        dsrun.parse_resource_filter({}, include_str="1", exclude_str="1")
+
+
+def test_num_plus_filter_rejected():
+    with pytest.raises(ValueError):
+        dsrun.main(args="--num_nodes 1 --include worker-0 foo.py".split())
+    with pytest.raises(ValueError):
+        dsrun.main(args="--num_gpus 1 --exclude worker-0:0 foo.py".split())
+
+
+def test_hostfile_parse(tmp_path):
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("worker-0 slots=4\nworker-1 slots=4\n\n# comment\n")
+    pool = dsrun.fetch_hostfile(str(hostfile))
+    assert list(pool.items()) == [("worker-0", 4), ("worker-1", 4)]
+
+
+def test_hostfile_duplicate_rejected(tmp_path):
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("worker-0 slots=4\nworker-0 slots=2\n")
+    with pytest.raises(ValueError):
+        dsrun.fetch_hostfile(str(hostfile))
+
+
+def test_hostfile_bad_format(tmp_path):
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("worker-0 4\n")
+    with pytest.raises(ValueError):
+        dsrun.fetch_hostfile(str(hostfile))
+
+
+def test_hostfile_missing():
+    assert dsrun.fetch_hostfile("/definitely/not/a/hostfile") is None
+
+
+@pytest.fixture
+def two_workers():
+    return {"worker-0": [0, 1, 2, 3], "worker-1": [0, 1, 2, 3]}
+
+
+def test_include_whole_host(two_workers):
+    out = dsrun.parse_resource_filter(two_workers, include_str="worker-1")
+    assert out == {"worker-1": [0, 1, 2, 3]}
+
+
+def test_include_slots(two_workers):
+    out = dsrun.parse_resource_filter(two_workers, include_str="worker-0@worker-1:0,2")
+    assert out == {"worker-0": [0, 1, 2, 3], "worker-1": [0, 2]}
+
+
+def test_exclude_slots(two_workers):
+    out = dsrun.parse_resource_filter(two_workers, exclude_str="worker-1:0")
+    assert out == {"worker-0": [0, 1, 2, 3], "worker-1": [1, 2, 3]}
+
+
+def test_exclude_whole_host(two_workers):
+    out = dsrun.parse_resource_filter(two_workers, exclude_str="worker-1")
+    assert out == {"worker-0": [0, 1, 2, 3]}
+
+
+def test_exclude_all_slots_drops_host(two_workers):
+    out = dsrun.parse_resource_filter(two_workers, exclude_str="worker-0:0,1,2,3")
+    assert out == {"worker-1": [0, 1, 2, 3]}
+
+
+def test_filter_unknown_host(two_workers):
+    with pytest.raises(ValueError):
+        dsrun.parse_resource_filter(two_workers, include_str="worker-7")
+    with pytest.raises(ValueError):
+        dsrun.parse_resource_filter(two_workers, exclude_str="worker-0:9")
+
+
+def test_filter_preserves_order(two_workers):
+    out = dsrun.parse_resource_filter(two_workers, include_str="worker-1@worker-0:1")
+    assert list(out.keys()) == ["worker-0", "worker-1"]
+
+
+def test_world_info_roundtrip(two_workers):
+    encoded = dsrun.encode_world_info(two_workers)
+    assert dsrun.decode_world_info(encoded) == two_workers
+    # urlsafe: usable inside a shell single token
+    assert "=" not in encoded.rstrip("=")[:-1] or True
+    json.loads(base64.urlsafe_b64decode(encoded))
+
+
+def test_rank_mapping():
+    world = {"worker-0": [0, 1], "worker-1": [0, 1], "worker-2": [0]}
+    mapping, world_size = build_rank_mapping(world)
+    assert world_size == 5
+    assert mapping == {"worker-0": [0, 1], "worker-1": [2, 3], "worker-2": [4]}
+
+
+def test_child_env_multi_proc_per_host():
+    world = {"worker-0": [0, 1], "worker-1": [0, 1]}
+    env = child_env({}, world, node_rank=1, local_rank=1, master_addr="10.0.0.1", master_port=29500)
+    assert env["RANK"] == "3" and env["WORLD_SIZE"] == "4" and env["LOCAL_RANK"] == "1"
+    assert env["DS_COORDINATOR_ADDRESS"] == "10.0.0.1:29500"
+    assert env["DS_PROCESS_ID"] == "3" and env["DS_NUM_PROCESSES"] == "4"
+    assert env["TPU_VISIBLE_DEVICES"] == "1"
+
+
+def test_child_env_one_proc_per_host():
+    """slots=1 per host: the process owns every local chip — no pinning env."""
+    world = {"worker-0": [0], "worker-1": [0]}
+    env = child_env({"HOME": "/root"}, world, node_rank=0, local_rank=0,
+                    master_addr="10.0.0.1", master_port=1234)
+    assert env["RANK"] == "0" and env["WORLD_SIZE"] == "2"
+    assert "TPU_VISIBLE_DEVICES" not in env
+    assert env["HOME"] == "/root"
+
+
+def test_env_identity_parsing(monkeypatch):
+    from deepspeed_tpu.runtime import dist as ds_dist
+    for k in ["DS_COORDINATOR_ADDRESS", "DS_NUM_PROCESSES", "DS_PROCESS_ID",
+              "MASTER_ADDR", "MASTER_PORT", "WORLD_SIZE", "RANK", "OMPI_COMM_WORLD_SIZE"]:
+        monkeypatch.delenv(k, raising=False)
+    assert ds_dist._env_identity() is None
+    monkeypatch.setenv("MASTER_ADDR", "host0")
+    monkeypatch.setenv("MASTER_PORT", "1111")
+    monkeypatch.setenv("WORLD_SIZE", "8")
+    monkeypatch.setenv("RANK", "5")
+    assert ds_dist._env_identity() == ("host0:1111", 8, 5)
+    monkeypatch.setenv("DS_COORDINATOR_ADDRESS", "host9:2222")
+    monkeypatch.setenv("DS_NUM_PROCESSES", "4")
+    monkeypatch.setenv("DS_PROCESS_ID", "2")
+    assert ds_dist._env_identity() == ("host9:2222", 4, 2)
+
+
+def test_init_distributed_noop_single_process(monkeypatch):
+    from deepspeed_tpu.runtime import dist as ds_dist
+    for k in ["DS_COORDINATOR_ADDRESS", "DS_NUM_PROCESSES", "DS_PROCESS_ID",
+              "MASTER_ADDR", "WORLD_SIZE", "RANK", "OMPI_COMM_WORLD_SIZE"]:
+        monkeypatch.delenv(k, raising=False)
+    assert ds_dist.init_distributed() is False
+
+
+def test_single_node_cmd(tmp_path, monkeypatch):
+    """single-host path builds a launch.py exec line (reference runner.py:309-319)."""
+    captured = {}
+
+    class FakeProc:
+        returncode = 0
+        def wait(self):
+            return 0
+
+    def fake_popen(cmd, env=None):
+        captured["cmd"] = cmd
+        return FakeProc()
+
+    monkeypatch.setattr(dsrun.subprocess, "Popen", fake_popen)
+    monkeypatch.setenv("DS_NUM_CHIPS", "4")
+    with pytest.raises(SystemExit):
+        dsrun.main(args=["--hostfile", "/nope", "train.py", "--foo", "1"])
+    cmd = captured["cmd"]
+    assert "deepspeed_tpu.launcher.launch" in cmd
+    assert cmd[-3:] == ["train.py", "--foo", "1"]
+    world_arg = [c for c in cmd if c.startswith("--world_info=")][0]
+    world = dsrun.decode_world_info(world_arg.split("=", 1)[1])
+    assert world == {"localhost": [0, 1, 2, 3]}
+
+
+def test_pdsh_cmd_construction(tmp_path):
+    args = dsrun.parse_args(["--hostfile", "/nope", "--master_addr", "10.0.0.1",
+                             "train.py", "--epochs", "2"])
+    from deepspeed_tpu.launcher.multinode_runner import PDSHRunner
+    r = PDSHRunner(args, world_info_base64="V0lORk8=")
+    r.add_export("XLA_FLAGS", "--xla_foo")
+    cmd = r.get_cmd({}, {"worker-0": [0], "worker-1": [0]})
+    joined = " ".join(cmd)
+    assert cmd[0] == "pdsh"
+    assert "-w worker-0,worker-1" in joined
+    assert "export XLA_FLAGS=--xla_foo;" in joined
+    assert "--node_rank=%n" in joined
+    assert "deepspeed_tpu.launcher.launch" in joined
+    assert "'2'" in joined  # non-flag user args quoted
