@@ -80,13 +80,25 @@ def child_env(base_env: dict, world_info: dict, node_rank: int, local_rank: int,
 
     num_local = len(local_slot_ids)
     if num_local > 1:
-        # Multiple processes sharing one host's chips: pin this process to its chip.
+        # Multiple processes sharing one host's chips: pin this process to its chip
+        # and give libtpu the full per-process topology it needs to form a donut.
         chip = str(local_slot_ids[local_rank])
         env["TPU_VISIBLE_DEVICES"] = chip
         env["CUDA_VISIBLE_DEVICES"] = chip  # GPU/CPU-cluster parity
-        # libtpu multi-process-per-host topology hints: 1 chip per process.
         env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = "1,1,1"
-        env.setdefault("TPU_PROCESS_PORT_BASE", "8476")
+        port_base = int(env.get("TPU_PROCESS_PORT_BASE", "8476"))
+        # every process needs a DISTINCT local port, and all processes need the full
+        # address list (host:port per process, world order = rank order)
+        env["TPU_PROCESS_PORT"] = str(port_base + local_rank)
+        addresses = []
+        for node_id, gids in world_info.items():
+            for i in range(len(gids)):
+                addresses.append(f"{node_id if len(world_info) > 1 else '127.0.0.1'}:{port_base + i}")
+        env["TPU_PROCESS_ADDRESSES"] = ",".join(addresses)
+        env["CLOUD_TPU_TASK_ID"] = str(dist_rank)
+        # Physical process bounds depend on slice topology; 1x1xN covers the common
+        # v5e/v4 single-row cases and is overridable via env for larger slices.
+        env.setdefault("TPU_PROCESS_BOUNDS", f"1,1,{world_size}")
     return env
 
 

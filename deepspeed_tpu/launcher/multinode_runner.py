@@ -7,6 +7,7 @@ the libtpu/JAX/XLA family (see constants.EXPORT_ENVS) rather than NCCL's.
 """
 
 import os
+import shlex
 import shutil
 import subprocess
 import sys
@@ -56,7 +57,8 @@ class PDSHRunner(MultiNodeRunner):
         if self.args.launcher_args:
             pdsh_cmd_args += self.args.launcher_args.split()
 
-        exports = "".join(f"export {key}={val}; " for key, val in self.exports.items())
+        # quote values: XLA_FLAGS et al. routinely contain spaces
+        exports = "".join(f"export {key}={shlex.quote(val)}; " for key, val in self.exports.items())
         launch_cmd = [
             exports,
             f"cd {os.path.abspath('.')};",
@@ -148,5 +150,7 @@ class MVAPICHRunner(MultiNodeRunner):
         for k, v in self.exports.items():
             export_cmd += ["-env", f"{k}={v}"]
         export_cmd += ["-env", f"DS_COORDINATOR_ADDRESS={self.args.master_addr}:{self.args.master_port}"]
+        # MVAPICH exposes rank/size as MV2_COMM_WORLD_* / PMI_* in the children;
+        # runtime.dist._env_identity reads those to complete the identity triple.
 
         return mpirun_cmd + export_cmd + [sys.executable, "-u", self.user_script] + self.user_arguments

@@ -210,8 +210,14 @@ def main(args=None):
         active_resources = collections.OrderedDict(
             (h, s) for i, (h, s) in enumerate(active_resources.items()) if i < args.num_nodes)
     if args.num_gpus > 0:
+        # cap to slots the hostfile actually declares — fabricating ids would fail
+        # chip pinning at runtime instead of erroring here
+        for h, slots in active_resources.items():
+            if args.num_gpus > len(slots):
+                raise ValueError(f"--num_gpus {args.num_gpus} exceeds the {len(slots)} slots "
+                                 f"declared for host '{h}'")
         active_resources = collections.OrderedDict(
-            (h, list(range(args.num_gpus))) for h in active_resources)
+            (h, slots[:args.num_gpus]) for h, slots in active_resources.items())
 
     world_info_base64 = encode_world_info(active_resources)
     multi_node_exec = args.force_multi or len(active_resources) > 1
@@ -249,6 +255,9 @@ def main(args=None):
             if os.path.isfile(environ_file):
                 with open(environ_file, "r") as fd:
                     for var in fd.readlines():
+                        var = var.strip()
+                        if not var or var.startswith("#") or "=" not in var:
+                            continue
                         key, val = var.split("=", 1)
                         runner.add_export(key, val)
 

@@ -29,21 +29,26 @@ def is_initialized() -> bool:
 
 
 def _env_identity():
+    from ..launcher.constants import DEFAULT_COORDINATOR_PORT
     coord = os.environ.get("DS_COORDINATOR_ADDRESS")
     if coord is None and os.environ.get("MASTER_ADDR"):
-        coord = f"{os.environ['MASTER_ADDR']}:{os.environ.get('MASTER_PORT', '29500')}"
+        coord = f"{os.environ['MASTER_ADDR']}:{os.environ.get('MASTER_PORT', DEFAULT_COORDINATOR_PORT)}"
     nprocs = os.environ.get("DS_NUM_PROCESSES") or os.environ.get("WORLD_SIZE")
     pid = os.environ.get("DS_PROCESS_ID") or os.environ.get("RANK")
     if coord and nprocs is not None and pid is not None:
         return coord, int(nprocs), int(pid)
-    # OpenMPI launch without the per-node launcher (reference engine.py:198-235).
-    if os.environ.get("OMPI_COMM_WORLD_SIZE") is not None:
-        nprocs = int(os.environ["OMPI_COMM_WORLD_SIZE"])
-        pid = int(os.environ["OMPI_COMM_WORLD_RANK"])
-        if coord is None:
-            raise RuntimeError("MPI launch detected but DS_COORDINATOR_ADDRESS is unset; "
-                               "export it (rank-0 host:port) or use the deepspeed_tpu launcher")
-        return coord, nprocs, pid
+    # MPI launch without the per-node launcher (reference engine.py:198-235):
+    # OpenMPI exposes OMPI_COMM_WORLD_*, MVAPICH exposes MV2_COMM_WORLD_* / PMI_*.
+    for size_key, rank_key in (("OMPI_COMM_WORLD_SIZE", "OMPI_COMM_WORLD_RANK"),
+                               ("MV2_COMM_WORLD_SIZE", "MV2_COMM_WORLD_RANK"),
+                               ("PMI_SIZE", "PMI_RANK")):
+        if os.environ.get(size_key) is not None:
+            nprocs = int(os.environ[size_key])
+            pid = int(os.environ[rank_key])
+            if coord is None:
+                raise RuntimeError("MPI launch detected but DS_COORDINATOR_ADDRESS is unset; "
+                                   "export it (rank-0 host:port) or use the deepspeed_tpu launcher")
+            return coord, nprocs, pid
     return None
 
 

@@ -172,6 +172,33 @@ class DeepSpeedEngine:
         if self._use_stacked_grads:
             assert zero_stage == 0, "1-bit Adam does not compose with ZeRO (reference parity)"
             assert param_shardings is None, "1-bit Adam requires replicated parameters"
+
+        # ---- sparse (row-sparse embedding) gradients (reference engine.py:176-187) ----
+        # The model declares which leaves are untied embedding tables via
+        # sparse_grad_paths() (the reference auto-detected nn.Embedding modules; a
+        # functional pytree has no module types to sniff).
+        self._sparse_grad_flags = None
+        if (self.config.sparse_gradients_enabled and not self._use_stacked_grads
+                and param_shardings is None):
+            patterns = tuple(getattr(model, "sparse_grad_paths", lambda: ())())
+            if patterns:
+                from .sparse_tensor import match_sparse_paths
+                paths = jax.tree_util.tree_flatten_with_path(master_fp32)[0]
+                flags = []
+                for path, leaf in paths:
+                    pstr = "/".join(str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p))))
+                                    for p in path)
+                    flags.append(bool(leaf.ndim == 2 and match_sparse_paths(pstr, patterns)))
+                self._sparse_grad_flags = jax.tree_util.tree_unflatten(
+                    jax.tree_util.tree_structure(master_fp32), flags)
+                matched = sum(jax.tree_util.tree_leaves(self._sparse_grad_flags))
+                logger.info(f"[deepspeed_tpu] sparse gradients enabled for {matched} "
+                            f"embedding leaves (patterns={patterns})")
+                if matched == 0:
+                    self._sparse_grad_flags = None
+            else:
+                logger.warning("sparse_gradients requested but the model defines no "
+                               "sparse_grad_paths(); falling back to dense reduction")
         if param_shardings is not None:
             # caller-provided layout (pipe-stacked stages, TP-sharded weights, ...);
             # ZeRO composes on top by claiming a free data-divisible axis per leaf
@@ -430,6 +457,38 @@ class DeepSpeedEngine:
                                out_specs=(P(), jax.tree_util.tree_map(lambda _: P(DATA_AXIS),
                                                                       self.params)),
                                check_vma=False)
+                return fn(params, scale, *batch)
+        elif self._sparse_grad_flags is not None and self.dp_size > 1:
+            # sparse_gradients mode (reference engine.py:1091-1147): embedding-table
+            # grads are reduced by gathering (indices, values) over the data axis
+            # instead of a dense psum; all other grads pmean as usual. shard_map
+            # replaces XLA's automatic reduction so we control the per-leaf strategy.
+            from jax import shard_map
+            from .sparse_tensor import row_sparse_allreduce
+            param_specs = jax.tree_util.tree_map(lambda _: P(), self.params)
+            sparse_flags = self._sparse_grad_flags
+
+            def loss_and_grad(params, scale, *batch):
+                # A token position contributes at most one nonzero row per table,
+                # so local token count exactly bounds the sparse row capacity.
+                local_tokens = int(np.prod(batch[0].shape)) // self.dp_size
+
+                def local(params, scale, *local_batch):
+                    loss, grads = local_loss_and_grad(params, scale, *local_batch)
+                    loss = jax.lax.pmean(loss, DATA_AXIS)
+                    flat, treedef = jax.tree_util.tree_flatten(grads)
+                    flat_flags = jax.tree_util.tree_leaves(sparse_flags)
+                    reduced = [
+                        row_sparse_allreduce(g, DATA_AXIS, capacity=min(local_tokens, g.shape[0]))
+                        if is_sparse else jax.lax.pmean(g, DATA_AXIS)
+                        for g, is_sparse in zip(flat, flat_flags)
+                    ]
+                    return loss, jax.tree_util.tree_unflatten(treedef, reduced)
+
+                batch_specs = tuple(P(DATA_AXIS) for _ in batch)
+                fn = shard_map(local, mesh=self.mesh,
+                               in_specs=(param_specs, P()) + batch_specs,
+                               out_specs=(P(), param_specs), check_vma=False)
                 return fn(params, scale, *batch)
         else:
             loss_and_grad = local_loss_and_grad

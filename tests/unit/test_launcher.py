@@ -93,8 +93,8 @@ def test_filter_preserves_order(two_workers):
 def test_world_info_roundtrip(two_workers):
     encoded = dsrun.encode_world_info(two_workers)
     assert dsrun.decode_world_info(encoded) == two_workers
-    # urlsafe: usable inside a shell single token
-    assert "=" not in encoded.rstrip("=")[:-1] or True
+    # urlsafe alphabet only (no +, /, spaces) — must survive as one shell token
+    assert set(encoded) <= set("ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789-_=")
     json.loads(base64.urlsafe_b64decode(encoded))
 
 
@@ -112,6 +112,35 @@ def test_child_env_multi_proc_per_host():
     assert env["DS_COORDINATOR_ADDRESS"] == "10.0.0.1:29500"
     assert env["DS_PROCESS_ID"] == "3" and env["DS_NUM_PROCESSES"] == "4"
     assert env["TPU_VISIBLE_DEVICES"] == "1"
+    # libtpu topology: distinct per-process port, full address list, task id
+    env0 = child_env({}, world, node_rank=1, local_rank=0, master_addr="10.0.0.1", master_port=29500)
+    assert env["TPU_PROCESS_PORT"] != env0["TPU_PROCESS_PORT"]
+    assert env["TPU_PROCESS_ADDRESSES"] == "worker-0:8476,worker-0:8477,worker-1:8476,worker-1:8477"
+    assert env["CLOUD_TPU_TASK_ID"] == "3"
+    assert env["TPU_PROCESS_BOUNDS"] == "1,1,4"
+
+
+def test_num_gpus_exceeding_slots_rejected(tmp_path, monkeypatch):
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("worker-0 slots=2\n")
+    monkeypatch.setattr(dsrun.subprocess, "check_output", lambda *a, **k: b"10.0.0.1 ")
+    with pytest.raises(ValueError, match="exceeds"):
+        dsrun.main(args=["--hostfile", str(hostfile), "--num_gpus", "4", "train.py"])
+
+
+def test_mpi_env_identity_variants(monkeypatch):
+    from deepspeed_tpu.runtime import dist as ds_dist
+    for k in ["DS_COORDINATOR_ADDRESS", "DS_NUM_PROCESSES", "DS_PROCESS_ID", "MASTER_ADDR",
+              "WORLD_SIZE", "RANK", "OMPI_COMM_WORLD_SIZE", "MV2_COMM_WORLD_SIZE", "PMI_SIZE"]:
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("DS_COORDINATOR_ADDRESS", "h0:29500")
+    monkeypatch.setenv("MV2_COMM_WORLD_SIZE", "4")
+    monkeypatch.setenv("MV2_COMM_WORLD_RANK", "1")
+    assert ds_dist._env_identity() == ("h0:29500", 4, 1)
+    monkeypatch.delenv("MV2_COMM_WORLD_SIZE")
+    monkeypatch.setenv("PMI_SIZE", "2")
+    monkeypatch.setenv("PMI_RANK", "0")
+    assert ds_dist._env_identity() == ("h0:29500", 2, 0)
 
 
 def test_child_env_one_proc_per_host():
