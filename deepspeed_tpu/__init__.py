@@ -9,6 +9,7 @@ __version__ = "0.1.0"
 __git_branch__ = "main"
 
 from .runtime.config import DeepSpeedConfig  # noqa: F401
+from .runtime.activation_checkpointing import checkpointing  # noqa: F401
 
 
 def initialize(args=None,

@@ -189,6 +189,7 @@ def main(args=None):
 
     multi_node_exec = True
     resource_pool = fetch_hostfile(args.hostfile)
+    from_hostfile = resource_pool is not None
     if not resource_pool:
         resource_pool = {"localhost": _local_device_count()}
         args.master_addr = "127.0.0.1"
@@ -210,14 +211,20 @@ def main(args=None):
         active_resources = collections.OrderedDict(
             (h, s) for i, (h, s) in enumerate(active_resources.items()) if i < args.num_nodes)
     if args.num_gpus > 0:
-        # cap to slots the hostfile actually declares — fabricating ids would fail
-        # chip pinning at runtime instead of erroring here
-        for h, slots in active_resources.items():
-            if args.num_gpus > len(slots):
-                raise ValueError(f"--num_gpus {args.num_gpus} exceeds the {len(slots)} slots "
-                                 f"declared for host '{h}'")
-        active_resources = collections.OrderedDict(
-            (h, slots[:args.num_gpus]) for h, slots in active_resources.items())
+        if from_hostfile:
+            # cap to slots the hostfile actually declares — fabricating ids would
+            # fail chip pinning at runtime instead of erroring here
+            for h, slots in active_resources.items():
+                if args.num_gpus > len(slots):
+                    raise ValueError(f"--num_gpus {args.num_gpus} exceeds the {len(slots)} "
+                                     f"slots declared for host '{h}'")
+            active_resources = collections.OrderedDict(
+                (h, slots[:args.num_gpus]) for h, slots in active_resources.items())
+        else:
+            # localhost slot count is a heuristic, not a declaration — honor the
+            # explicit request (reference runner.py:295-299 behavior)
+            active_resources = collections.OrderedDict(
+                (h, list(range(args.num_gpus))) for h in active_resources)
 
     world_info_base64 = encode_world_info(active_resources)
     multi_node_exec = args.force_multi or len(active_resources) > 1

@@ -144,7 +144,9 @@ class GPT2Model:
 
         block_fn = self._block
         if c.remat:
-            block_fn = jax.checkpoint(block_fn)
+            # config-aware remat: honors partition_activations / cpu_checkpointing
+            from ..runtime.activation_checkpointing.checkpointing import checkpoint_wrapper
+            block_fn = checkpoint_wrapper(block_fn)
         for bp in params["blocks"]:
             x = block_fn(x, bp)
         x = self._layer_norm(x, params["ln_f"], c.layer_norm_epsilon)

@@ -1,0 +1,220 @@
+"""Activation checkpointing (rematerialization) with partitioned / host-offloaded
+saveables and deterministic RNG.
+
+TPU-native analog of ``deepspeed/runtime/activation_checkpointing/checkpointing.py``
+(746 LoC, Megatron-derived). The reference re-ran forward in backward with exact
+CPU+CUDA RNG restore (CudaRNGStatesTracker, l.147-223), optionally narrowed saved
+input activations to 1/mp_size per rank (l.265-311) and moved them to CPU
+(``PA_TO_CPU``, l.370-413). Under JAX each concern collapses into existing machinery:
+
+- recompute-in-backward       → ``jax.checkpoint`` (this module adds the config layer)
+- exact RNG restore           → free: PRNG keys are explicit values, so the remat
+                                replay is bit-identical by construction; the
+                                ``RNGTracker`` here exists for Megatron-API parity
+- partition_activations       → sharding constraints on the wrapped function's inputs
+                                over the ``model`` mesh axis; GSPMD all-gathers them
+                                back in backward exactly like l.281-311
+- cpu_checkpointing (PA_TO_CPU) → ``save_and_offload_only_these_names`` policy moving
+                                named residuals to ``pinned_host`` memory
+- contiguous_memory/profile   → accepted for config parity; XLA owns memory layout,
+                                profiling maps to named-scope annotations
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
+
+from ...utils import logger
+
+# Name tag for residuals this module saves/offloads.
+_ACT_NAME = "ds_activation"
+
+# module-level config, set by configure() (reference checkpointing.py:654-700)
+_config = {
+    "partition_activations": False,
+    "cpu_checkpointing": False,
+    "contiguous_memory_optimization": False,
+    "number_checkpoints": None,
+    "synchronize": False,
+    "profile": False,
+    "model_axis": "model",
+    "mesh": None,
+    "configured": False,
+}
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None, checkpoint_in_cpu=None,
+              synchronize=None, profile=None, mesh=None, model_axis: str = "model"):
+    """Configure the module (reference checkpointing.py:654-700). Accepts either a
+    DeepSpeedConfig (uses its activation_checkpointing block) or explicit flags."""
+    if deepspeed_config is not None:
+        ac = deepspeed_config.activation_checkpointing_config
+        _config["partition_activations"] = ac.partition_activations
+        _config["cpu_checkpointing"] = ac.cpu_checkpointing
+        _config["contiguous_memory_optimization"] = ac.contiguous_memory_optimization
+        _config["number_checkpoints"] = ac.number_checkpoints
+        _config["synchronize"] = ac.synchronize_checkpoint_boundary
+        _config["profile"] = ac.profile
+    for key, val in (("partition_activations", partition_activations),
+                     ("contiguous_memory_optimization", contiguous_checkpointing),
+                     ("number_checkpoints", num_checkpoints),
+                     ("cpu_checkpointing", checkpoint_in_cpu),
+                     ("synchronize", synchronize),
+                     ("profile", profile)):
+        if val is not None:
+            _config[key] = val
+    if mesh is not None:
+        _config["mesh"] = mesh
+    _config["model_axis"] = model_axis
+    _config["configured"] = True
+    logger.info(f"[deepspeed_tpu] activation checkpointing configured: "
+                f"partition={_config['partition_activations']} "
+                f"cpu={_config['cpu_checkpointing']} num={_config['number_checkpoints']}")
+
+
+def is_configured() -> bool:
+    return _config["configured"]
+
+
+def cpu_checkpointing_enabled() -> bool:
+    return bool(_config["cpu_checkpointing"])
+
+
+def reset():
+    """Reference checkpointing.py reset() dropped the contiguous buffers; here it
+    just restores defaults."""
+    _config.update(partition_activations=False, cpu_checkpointing=False,
+                   contiguous_memory_optimization=False, number_checkpoints=None,
+                   synchronize=False, profile=False, mesh=None, model_axis="model",
+                   configured=False)
+
+
+def _offload_policy():
+    return jax.checkpoint_policies.save_and_offload_only_these_names(
+        names_which_can_be_saved=[],
+        names_which_can_be_offloaded=[_ACT_NAME],
+        offload_src="device", offload_dst="pinned_host")
+
+
+def _partition_constraint(x: jnp.ndarray):
+    """Shard a saveable over the model axis along its largest divisible dim
+    (reference narrowed saved activations to 1/mp_size per rank, l.265-311).
+    Inside jit, GSPMD inserts the gather on the backward replay."""
+    mesh = _config["mesh"]
+    axis = _config["model_axis"]
+    if mesh is None or axis not in mesh.shape or mesh.shape[axis] <= 1 or x.ndim == 0:
+        return x
+    mp = mesh.shape[axis]
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    for dim in sorted(range(x.ndim), key=lambda d: -x.shape[d]):
+        if x.shape[dim] % mp == 0:
+            spec = [None] * x.ndim
+            spec[dim] = axis
+            return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+    return x
+
+
+def checkpoint_wrapper(fn):
+    """Wrap ``fn(*args)`` so its forward is rematerialized in backward, honoring the
+    configured saveable placement. The TPU analog of CheckpointFunction
+    (reference checkpointing.py:314-576)."""
+
+    @functools.wraps(fn)
+    def inner(*args):
+        # Tag+place the block inputs: they are the residuals jax.checkpoint saves.
+        def placed(*inner_args):
+            processed = []
+            for a in inner_args:
+                if isinstance(a, jnp.ndarray) and jnp.issubdtype(a.dtype, jnp.inexact):
+                    if _config["cpu_checkpointing"]:
+                        a = checkpoint_name(a, _ACT_NAME)
+                    if _config["partition_activations"]:
+                        a = _partition_constraint(a)
+                processed.append(a)
+            return fn(*processed)
+
+        policy = _offload_policy() if _config["cpu_checkpointing"] else None
+        ckpt = jax.checkpoint(placed, policy=policy)
+        if _config["profile"]:
+            with jax.named_scope("ds_activation_checkpoint"):
+                return ckpt(*args)
+        return ckpt(*args)
+
+    return inner
+
+
+def checkpoint(function, *args):
+    """Reference-style call: ``checkpoint(run_function, *args)``
+    (checkpointing.py:739-746)."""
+    return checkpoint_wrapper(function)(*args)
+
+
+# ---------------------------------------------------------------------------
+# RNG parity API (reference CudaRNGStatesTracker, checkpointing.py:147-223).
+# JAX PRNG keys are explicit, so remat replay is deterministic with zero effort;
+# this tracker exists so Megatron-style callers keep working.
+# ---------------------------------------------------------------------------
+
+class RNGTracker:
+    """Named PRNG streams. ``fork(name)`` returns a fresh subkey each call;
+    inside a remat replay the same sequence is regenerated bit-identically
+    because the stream state is a pure value captured in the trace."""
+
+    def __init__(self):
+        self._keys = {}
+
+    def reset(self):
+        self._keys = {}
+
+    def get_states(self):
+        return dict(self._keys)
+
+    def set_states(self, states):
+        self._keys = dict(states)
+
+    def add(self, name: str, seed: int):
+        if name in self._keys:
+            raise ValueError(f"RNG state {name} already exists")
+        self._keys[name] = jax.random.PRNGKey(seed)
+
+    def fork(self, name: str = "model-parallel-rng"):
+        if name not in self._keys:
+            raise KeyError(f"RNG state {name} not added")
+        self._keys[name], sub = jax.random.split(self._keys[name])
+        return sub
+
+
+_RNG_TRACKER = RNGTracker()
+
+
+def get_rng_tracker() -> RNGTracker:
+    return _RNG_TRACKER
+
+
+# reference alias (checkpointing.py:218)
+get_cuda_rng_tracker = get_rng_tracker
+
+
+def model_parallel_seed(seed: int, axis: Optional[str] = None):
+    """Per-model-parallel-rank PRNG key (reference model_parallel_cuda_manual_seed,
+    checkpointing.py:223-262): dropout must differ across TP ranks while staying
+    reproducible. Call inside shard_map/jit with the mesh axis bound; outside a
+    bound axis it returns the base key."""
+    key = jax.random.PRNGKey(seed)
+    axis = axis or _config["model_axis"]
+    try:
+        idx = jax.lax.axis_index(axis)
+    except NameError:
+        return key
+    return jax.random.fold_in(key, idx)
+
+
+def model_parallel_cuda_manual_seed(seed: int):
+    """Parity shim: seeds the tracker's default streams (reference l.223-262)."""
+    _RNG_TRACKER.reset()
+    _RNG_TRACKER.add("model-parallel-rng", seed + 2718)
+    _RNG_TRACKER.add("data-parallel-rng", seed)

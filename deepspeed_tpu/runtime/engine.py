@@ -271,6 +271,10 @@ class DeepSpeedEngine:
             steps_per_output=self.steps_per_print(),
             monitor_memory=False)
 
+        # module-level activation-checkpointing config (reference engine.py:385-400)
+        from .activation_checkpointing import checkpointing as act_ckpt
+        act_ckpt.configure(deepspeed_config=self.config, mesh=self.mesh)
+
         self._compile_steps()
 
         if self.config.dump_state:
@@ -436,69 +440,79 @@ class DeepSpeedEngine:
             grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
             return loss, grads
 
+        def shard_mapped_loss_and_grad(reduce_grads, grad_out_specs):
+            """shard_map scaffold shared by the stacked (1-bit Adam) and sparse
+            reduction modes: replicated params, data-sharded batch, pmean'd loss;
+            only the per-leaf grad handling differs."""
+            from jax import shard_map
+            param_specs = jax.tree_util.tree_map(lambda _: P(), self.params)
+
+            def loss_and_grad(params, scale, *batch):
+                def local(params, scale, *local_batch):
+                    loss, grads = local_loss_and_grad(params, scale, *local_batch)
+                    return jax.lax.pmean(loss, DATA_AXIS), reduce_grads(grads, batch)
+
+                batch_specs = tuple(P(DATA_AXIS) for _ in batch)
+                fn = shard_map(local, mesh=self.mesh,
+                               in_specs=(param_specs, P()) + batch_specs,
+                               out_specs=(P(), grad_out_specs), check_vma=False)
+                return fn(params, scale, *batch)
+
+            return loss_and_grad
+
         if self._use_stacked_grads:
             # 1-bit Adam path: keep per-worker grads stacked over a leading dp axis
             # instead of letting XLA psum them — the compressed allreduce in the optimizer
             # replaces the gradient averaging (reference disables engine allreduce when
             # frozen, onebit_adam.py:372).
-            from jax import shard_map
-            param_specs = jax.tree_util.tree_map(lambda _: P(), self.params)
-
-            def loss_and_grad(params, scale, *batch):
-                def local(params, scale, *local_batch):
-                    loss, grads = local_loss_and_grad(params, scale, *local_batch)
-                    loss = jax.lax.pmean(loss, DATA_AXIS)
-                    grads = jax.tree_util.tree_map(lambda g: g[None], grads)
-                    return loss, grads
-
-                batch_specs = tuple(P(DATA_AXIS) for _ in batch)
-                fn = shard_map(local, mesh=self.mesh,
-                               in_specs=(param_specs, P()) + batch_specs,
-                               out_specs=(P(), jax.tree_util.tree_map(lambda _: P(DATA_AXIS),
-                                                                      self.params)),
-                               check_vma=False)
-                return fn(params, scale, *batch)
+            loss_and_grad = shard_mapped_loss_and_grad(
+                lambda grads, batch: jax.tree_util.tree_map(lambda g: g[None], grads),
+                jax.tree_util.tree_map(lambda _: P(DATA_AXIS), self.params))
         elif self._sparse_grad_flags is not None and self.dp_size > 1:
             # sparse_gradients mode (reference engine.py:1091-1147): embedding-table
             # grads are reduced by gathering (indices, values) over the data axis
             # instead of a dense psum; all other grads pmean as usual. shard_map
             # replaces XLA's automatic reduction so we control the per-leaf strategy.
-            from jax import shard_map
             from .sparse_tensor import row_sparse_allreduce
-            param_specs = jax.tree_util.tree_map(lambda _: P(), self.params)
             sparse_flags = self._sparse_grad_flags
+            dp = self.dp_size
 
-            def loss_and_grad(params, scale, *batch):
+            def reduce_sparse(grads, batch):
                 # A token position contributes at most one nonzero row per table,
                 # so local token count exactly bounds the sparse row capacity.
-                local_tokens = int(np.prod(batch[0].shape)) // self.dp_size
+                local_tokens = int(np.prod(batch[0].shape)) // dp
+                flat, treedef = jax.tree_util.tree_flatten(grads)
+                flat_flags = jax.tree_util.tree_leaves(sparse_flags)
+                reduced = []
+                for g, is_sparse in zip(flat, flat_flags):
+                    cap = min(local_tokens, g.shape[0]) if is_sparse else 0
+                    # sparse gather ships dp*cap rows; dense psum ships rows/...: only
+                    # gather when the table is genuinely sparse this step
+                    if is_sparse and cap * dp < g.shape[0]:
+                        reduced.append(row_sparse_allreduce(g, DATA_AXIS, capacity=cap))
+                    else:
+                        reduced.append(jax.lax.pmean(g, DATA_AXIS))
+                return jax.tree_util.tree_unflatten(treedef, reduced)
 
-                def local(params, scale, *local_batch):
-                    loss, grads = local_loss_and_grad(params, scale, *local_batch)
-                    loss = jax.lax.pmean(loss, DATA_AXIS)
-                    flat, treedef = jax.tree_util.tree_flatten(grads)
-                    flat_flags = jax.tree_util.tree_leaves(sparse_flags)
-                    reduced = [
-                        row_sparse_allreduce(g, DATA_AXIS, capacity=min(local_tokens, g.shape[0]))
-                        if is_sparse else jax.lax.pmean(g, DATA_AXIS)
-                        for g, is_sparse in zip(flat, flat_flags)
-                    ]
-                    return loss, jax.tree_util.tree_unflatten(treedef, reduced)
-
-                batch_specs = tuple(P(DATA_AXIS) for _ in batch)
-                fn = shard_map(local, mesh=self.mesh,
-                               in_specs=(param_specs, P()) + batch_specs,
-                               out_specs=(P(), param_specs), check_vma=False)
-                return fn(params, scale, *batch)
+            loss_and_grad = shard_mapped_loss_and_grad(
+                reduce_sparse, jax.tree_util.tree_map(lambda _: P(), self.params))
         else:
             loss_and_grad = local_loss_and_grad
 
         # Inputs carry their shardings (params/batch were device_put with the right
         # layouts); out_shardings on the grads is what makes stage-2 store them
         # reduce-scattered instead of materializing full replicas.
-        self._jit_loss_and_grad = jax.jit(
-            loss_and_grad,
-            out_shardings=(NamedSharding(self.mesh, P()), self._grad_shardings))
+        # Exception: host-offloaded remat residuals introduce side-effecting
+        # placement custom-calls that XLA's SPMD partitioner refuses to combine
+        # with explicit (esp. replicated) out_shardings — there we let XLA pick
+        # output layouts and the downstream jits re-shard via their in_shardings.
+        from .activation_checkpointing.checkpointing import cpu_checkpointing_enabled
+        if cpu_checkpointing_enabled():
+            self._jit_loss_and_grad = jax.jit(loss_and_grad)
+        else:
+            self._jit_loss_and_grad = jax.jit(
+                loss_and_grad,
+                out_shardings=(NamedSharding(self.mesh, P()), self._grad_shardings))
 
         def accumulate(acc, grads):
             return jax.tree_util.tree_map(lambda a, g: a + g, acc, grads)

@@ -113,13 +113,28 @@ class PipelineEngine(DeepSpeedEngine):
     # ------------------------------------------------------------- stage functions
     def _stage_fn(self, stage_id: int) -> Callable:
         lo, hi = self.pipe_module.parts[stage_id], self.pipe_module.parts[stage_id + 1]
+        interval = self.pipe_module.activation_checkpoint_interval
 
-        def fn(stage_params, x):
-            for idx in range(lo, hi):
-                x = self._apply_layer(idx, stage_params, x)
-            return x
+        def run_range(start, end):
+            def range_fn(stage_params, x):
+                for idx in range(start, end):
+                    x = self._apply_layer(idx, stage_params, x)
+                return x
+            return range_fn
 
-        return fn
+        if interval and interval > 0:
+            # remat each interval-sized chunk (reference PipelineModule.forward,
+            # pipe/module.py:292-346: exec_range_func wrapped per interval)
+            from ..activation_checkpointing.checkpointing import checkpoint_wrapper
+            chunks = [(s, min(s + interval, hi)) for s in range(lo, hi, interval)]
+
+            def fn(stage_params, x):
+                for start, end in chunks:
+                    x = checkpoint_wrapper(run_range(start, end))(stage_params, x)
+                return x
+            return fn
+
+        return run_range(lo, hi)
 
     def _stage_param_keys(self, stage_id: int) -> List[str]:
         lo, hi = self.pipe_module.parts[stage_id], self.pipe_module.parts[stage_id + 1]

@@ -217,3 +217,21 @@ def test_pdsh_cmd_construction(tmp_path):
     assert "--node_rank=%n" in joined
     assert "deepspeed_tpu.launcher.launch" in joined
     assert "'2'" in joined  # non-flag user args quoted
+
+
+def test_num_gpus_without_hostfile_honored(monkeypatch):
+    """localhost slot count is a heuristic → --num_gpus overrides it."""
+    captured = {}
+
+    class FakeProc:
+        returncode = 0
+        def wait(self):
+            return 0
+
+    monkeypatch.setattr(dsrun.subprocess, "Popen",
+                        lambda cmd, env=None: captured.update(cmd=cmd) or FakeProc())
+    monkeypatch.delenv("DS_NUM_CHIPS", raising=False)
+    with pytest.raises(SystemExit):
+        dsrun.main(args=["--hostfile", "/nope", "--num_gpus", "4", "train.py"])
+    world_arg = [c for c in captured["cmd"] if c.startswith("--world_info=")][0]
+    assert dsrun.decode_world_info(world_arg.split("=", 1)[1]) == {"localhost": [0, 1, 2, 3]}

@@ -152,3 +152,26 @@ def test_pipe_deep_schedule_many_microbatches():
     losses = [float(jax.device_get(engine.train_batch(it))) for _ in range(5)]
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]
+
+
+def test_pipe_activation_checkpoint_interval():
+    """activation_checkpoint_interval remats chunks of stage layers and must be a
+    pure memory/compute tradeoff — identical training results."""
+    results = []
+    for interval in [0, 1, 2]:
+        layers = [LayerSpec(Linear, HIDDEN) for _ in range(4)]
+        module = PipelineModule(layers=layers, num_stages=2, loss_fn=mse_loss,
+                                activation_checkpoint_interval=interval)
+        sample = jnp.zeros((4, HIDDEN), jnp.float32)
+        params = module.init_params(jax.random.PRNGKey(3), sample)
+        engine, _, _, _ = deepspeed_tpu.initialize(model=module, model_parameters=params,
+                                                   config_params=pipe_config())
+        it = data_iter(batch=16, seed=13)
+        for _ in range(3):
+            engine.train_batch(it)
+        results.append(jax.device_get(engine.master_params))
+    for other in results[1:]:
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                    rtol=1e-5, atol=1e-6),
+            results[0], other)
