@@ -189,7 +189,7 @@ def main(args=None):
 
     multi_node_exec = True
     resource_pool = fetch_hostfile(args.hostfile)
-    from_hostfile = resource_pool is not None
+    from_hostfile = bool(resource_pool)  # a comments-only hostfile declares nothing
     if not resource_pool:
         resource_pool = {"localhost": _local_device_count()}
         args.master_addr = "127.0.0.1"

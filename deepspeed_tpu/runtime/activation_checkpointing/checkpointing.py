@@ -48,7 +48,7 @@ _config = {
 
 def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
               contiguous_checkpointing=None, num_checkpoints=None, checkpoint_in_cpu=None,
-              synchronize=None, profile=None, mesh=None, model_axis: str = "model"):
+              synchronize=None, profile=None, mesh=None, model_axis: Optional[str] = None):
     """Configure the module (reference checkpointing.py:654-700). Accepts either a
     DeepSpeedConfig (uses its activation_checkpointing block) or explicit flags."""
     if deepspeed_config is not None:
@@ -69,7 +69,8 @@ def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
             _config[key] = val
     if mesh is not None:
         _config["mesh"] = mesh
-    _config["model_axis"] = model_axis
+    if model_axis is not None:
+        _config["model_axis"] = model_axis
     _config["configured"] = True
     logger.info(f"[deepspeed_tpu] activation checkpointing configured: "
                 f"partition={_config['partition_activations']} "

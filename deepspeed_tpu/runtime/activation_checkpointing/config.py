@@ -41,6 +41,9 @@ ACT_CHKPT_DEFAULT = {
 class DeepSpeedActivationCheckpointingConfig:
 
     def __init__(self, param_dict):
+        # whether the user's JSON actually carried the block (engines only push
+        # their settings into the process-global checkpointing module when it did)
+        self.configured_in_json = ACTIVATION_CHKPT in param_dict
         act_chkpt_config_dict = param_dict.get(ACTIVATION_CHKPT, ACT_CHKPT_DEFAULT)
 
         self.partition_activations = get_scalar_param(act_chkpt_config_dict, ACT_CHKPT_PARTITION_ACTIVATIONS,

@@ -253,6 +253,7 @@ class DeepSpeedEngine:
         self._grad_acc = None  # lazily zero-initialized with grad shardings
         self._pending_grads = None
         self._pending_loss = None
+        self._last_grad_norm = None
 
         # ---- lr scheduler ----
         self._configure_lr_scheduler(lr_scheduler)
@@ -271,9 +272,19 @@ class DeepSpeedEngine:
             steps_per_output=self.steps_per_print(),
             monitor_memory=False)
 
-        # module-level activation-checkpointing config (reference engine.py:385-400)
+        # module-level activation-checkpointing config (reference engine.py:385-400).
+        # Only push settings into the process-global module when THIS config carries
+        # the block — a second engine without one must not clobber the first's setup.
         from .activation_checkpointing import checkpointing as act_ckpt
-        act_ckpt.configure(deepspeed_config=self.config, mesh=self.mesh)
+        if self.config.activation_checkpointing_config.configured_in_json:
+            act_ckpt.configure(deepspeed_config=self.config, mesh=self.mesh)
+
+        # ---- scalar monitor (reference tensorboard wiring, engine.py:151-152, 246-261) ----
+        self.monitor = None
+        if self.config.tensorboard_enabled:
+            from ..utils.monitor import SummaryMonitor
+            self.monitor = SummaryMonitor(self.config.tensorboard_output_path or None,
+                                          self.config.tensorboard_job_name)
 
         self._compile_steps()
 
@@ -506,8 +517,9 @@ class DeepSpeedEngine:
         # placement custom-calls that XLA's SPMD partitioner refuses to combine
         # with explicit (esp. replicated) out_shardings — there we let XLA pick
         # output layouts and the downstream jits re-shard via their in_shardings.
-        from .activation_checkpointing.checkpointing import cpu_checkpointing_enabled
-        if cpu_checkpointing_enabled():
+        # Decided from THIS engine's config (the global module state can be
+        # reconfigured later by other engines; the jit choice must not drift).
+        if self.config.activation_checkpointing_config.cpu_checkpointing:
             self._jit_loss_and_grad = jax.jit(loss_and_grad)
         else:
             self._jit_loss_and_grad = jax.jit(
@@ -691,6 +703,22 @@ class DeepSpeedEngine:
         if report_progress:
             self._report_progress(self.global_steps + 1)
         self.global_steps += 1
+        if self.monitor is not None:
+            # reference scalars: Train/Samples/train_loss + lr + loss_scale
+            # (engine.py:779-790, 920-936)
+            samples = self.global_steps * self.train_batch_size()
+            if self._pending_loss is not None:
+                self.monitor.add_scalar("Train/Samples/train_loss",
+                                        float(jax.device_get(self._pending_loss)), samples)
+            lr = self.get_lr()
+            if lr:
+                self.monitor.add_scalar("Train/Samples/lr", lr[0], samples)
+            if self.fp16_enabled():
+                self.monitor.add_scalar("Train/Samples/loss_scale",
+                                        float(jax.device_get(self.scaler_state.cur_scale)), samples)
+            if self._last_grad_norm is not None:
+                self.monitor.add_scalar("Train/Samples/grad_norm",
+                                        float(jax.device_get(self._last_grad_norm)), samples)
         if self.wall_clock_breakdown():
             self.timers("step_microstep").stop()
             self.timers.log(["forward_microstep", "backward_microstep", "step_microstep"],

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from ...ops import adam as adam_opt
 from ...ops import lamb as lamb_opt
 from ...utils import logger
-from ..utils import global_norm, has_inf_or_nan_tree
+from ..utils import clip_grads_by_global_norm, has_inf_or_nan_tree
 from . import loss_scaler as ls
 
 
@@ -80,6 +80,7 @@ class FP16_Optimizer:
         self.scaler = ls.init_state(static_loss_scale, initial_scale_power, hysteresis)
         self.steps = jnp.asarray(0, jnp.int32)
         self._jit_step = jax.jit(self._step_impl, donate_argnums=(0, 1, 2, 3))
+        self._jit_backwards = {}  # per-loss_fn compiled backward cache
         self.overflow = False  # python-visible last-step overflow flag (reference l.245)
 
     # ------------------------------------------------------------------ loss scaling
@@ -95,13 +96,19 @@ class FP16_Optimizer:
 
     def backward(self, loss_fn: Callable, params16, *batch):
         """Scaled value_and_grad (reference backward l.159: loss*scale → autograd).
-        Returns (unscaled loss, scaled grads in fp32)."""
-        def scaled(p, *b):
-            loss = loss_fn(p, *b)
-            return loss * self.scaler.cur_scale.astype(loss.dtype), loss
-
-        (_, loss), grads = jax.value_and_grad(scaled, has_aux=True)(params16, *batch)
-        return loss, jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+        Returns (unscaled loss, scaled grads in fp32). The compiled backward is
+        cached per loss_fn with the scale as an explicit argument, so repeated
+        steps pay zero retrace."""
+        jitted = self._jit_backwards.get(loss_fn)
+        if jitted is None:
+            def scaled_loss_and_grad(p, scale, *b):
+                def scaled(p, *bb):
+                    loss = loss_fn(p, *bb)
+                    return loss * scale.astype(loss.dtype), loss
+                (_, loss), grads = jax.value_and_grad(scaled, has_aux=True)(p, *b)
+                return loss, jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+            jitted = self._jit_backwards[loss_fn] = jax.jit(scaled_loss_and_grad)
+        return jitted(params16, self.scaler.cur_scale, *batch)
 
     # ------------------------------------------------------------------ step
     def _step_impl(self, master, state, scaler, steps, grads, hyper):
@@ -109,9 +116,7 @@ class FP16_Optimizer:
         grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
         overflow = has_inf_or_nan_tree(grads)
         if self.clip_grad > 0:
-            norm = global_norm(grads)
-            factor = jnp.minimum(1.0, self.clip_grad / (norm + 1e-6))
-            grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
+            grads = clip_grads_by_global_norm(grads, self.clip_grad)
         new_steps = jnp.where(overflow, steps, steps + 1)
         new_master, new_state = self._apply(grads, state, master, new_steps, hyper)
         # select: skip the update entirely on overflow (reference step l.191-273)

@@ -155,3 +155,82 @@ def test_checkpoint_elastic_world_size_change(tmp_path, eight_devices):
     trees_equal(engine.master_params, engine2.master_params)
     trees_equal(engine.opt_state, engine2.opt_state)
     assert engine2.global_steps == engine.global_steps
+
+
+def test_checkpoint_elastic_grow(tmp_path, eight_devices):
+    """Save under dp=4, reload under dp=8 (elastic regrow; reference stage1.py:836-947
+    supports arbitrary saved→current dp)."""
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    model = SimpleModel(HIDDEN)
+    mesh4 = build_mesh(data=4, model=1, pipe=1, devices=eight_devices[:4])
+    engine = DeepSpeedEngine(model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+                             config_params=simple_config(batch=4, zero_optimization={"stage": 2}),
+                             mesh=mesh4)
+    data = random_dataset(64, HIDDEN, seed=0)
+    it = iter(engine.deepspeed_io(data))
+    for _ in range(3):
+        x, y = next(it)
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+    engine.save_checkpoint(str(tmp_path))
+
+    engine2, _ = make_engine(simple_config(zero_optimization={"stage": 2}), seed=9)
+    assert engine2.dp_size == 8
+    path, _ = engine2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    trees_equal(engine.master_params, engine2.master_params)
+    trees_equal(engine.opt_state, engine2.opt_state)
+
+
+def test_checkpoint_pipe_topology_change(tmp_path):
+    """Pipeline checkpoints are layer-keyed, so stage boundaries can move between
+    save and load (reference pipe/module.py:536-567, test_checkpointing.py:617+)."""
+    from deepspeed_tpu.parallel.pipe import LayerSpec, PipelineModule
+
+    class Linear:
+        def __init__(self, dim):
+            self.dim = dim
+        def init(self, rng, x):
+            k1, _ = jax.random.split(rng)
+            return {"w": jax.random.normal(k1, (x.shape[-1], self.dim), jnp.float32) * 0.3}
+        def apply(self, p, x):
+            return jnp.tanh(x @ p["w"].astype(x.dtype))
+
+    def mse(out, tgt):
+        return jnp.mean(jnp.square(out.astype(jnp.float32) - tgt.astype(jnp.float32)))
+
+    def build(num_stages):
+        module = PipelineModule(layers=[LayerSpec(Linear, HIDDEN) for _ in range(4)],
+                                num_stages=num_stages, loss_fn=mse)
+        params = module.init_params(jax.random.PRNGKey(1), jnp.zeros((4, HIDDEN), jnp.float32))
+        cfg = {"train_batch_size": 32, "gradient_accumulation_steps": 2,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}}
+        engine, _, _, _ = deepspeed_tpu.initialize(model=module, model_parameters=params,
+                                                   config_params=cfg)
+        return engine
+
+    def data_iter():
+        rng = np.random.default_rng(3)
+        while True:
+            x = rng.normal(size=(16, HIDDEN)).astype(np.float32)
+            yield x, np.tanh(x @ np.ones((HIDDEN, HIDDEN), np.float32) * 0.1)
+
+    engine = build(num_stages=2)
+    it = data_iter()
+    for _ in range(3):
+        engine.train_batch(it)
+    engine.save_checkpoint(str(tmp_path))
+
+    for new_stages in (1, 4):
+        engine2 = build(num_stages=new_stages)
+        path, _ = engine2.load_checkpoint(str(tmp_path))
+        assert path is not None, f"reload at {new_stages} stages failed"
+        trees_equal(engine.master_params, engine2.master_params)
+        # training continues identically after the re-partition
+        e1_it, e2_it = data_iter(), data_iter()
+        l1 = float(jax.device_get(engine.eval_batch(e1_it)))
+        l2 = float(jax.device_get(engine2.eval_batch(e2_it)))
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)

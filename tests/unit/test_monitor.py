@@ -1,0 +1,61 @@
+"""Monitor tests: JSONL scalar sink + engine tensorboard-config wiring."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.utils.monitor import SummaryMonitor
+from simple_model import SimpleModel, random_dataset, simple_config
+
+HIDDEN = 16
+
+
+def test_monitor_writes_jsonl(tmp_path):
+    mon = SummaryMonitor(str(tmp_path), "job1")
+    mon.add_scalar("Train/loss", 1.5, 10)
+    mon.add_scalar("Train/loss", 1.25, 20)
+    mon.close()
+    lines = [json.loads(l) for l in
+             open(os.path.join(str(tmp_path), "job1", "scalars.jsonl"))]
+    assert [l["value"] for l in lines] == [1.5, 1.25]
+    assert [l["step"] for l in lines] == [10, 20]
+    assert all(l["tag"] == "Train/loss" for l in lines)
+
+
+def test_monitor_disabled_is_noop(tmp_path):
+    mon = SummaryMonitor(str(tmp_path), "job2", enabled=False)
+    mon.add_scalar("x", 1.0, 0)  # must not raise or create files
+    mon.close()
+    assert not os.path.exists(os.path.join(str(tmp_path), "job2"))
+
+
+def test_engine_emits_scalars(tmp_path):
+    cfg = simple_config()
+    cfg["tensorboard"] = {"enabled": True, "output_path": str(tmp_path), "job_name": "run0"}
+    model = SimpleModel(HIDDEN)
+    params = model.init(jax.random.PRNGKey(0))
+    data = random_dataset(64, HIDDEN, seed=0)
+    engine, _, loader, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                                    training_data=data, config_params=cfg)
+    assert engine.monitor is not None
+    it = iter(loader)
+    for _ in range(3):
+        x, y = next(it)
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+    engine.monitor.close()
+    scalars = [json.loads(l) for l in
+               open(os.path.join(str(tmp_path), "run0", "scalars.jsonl"))]
+    tags = {s["tag"] for s in scalars}
+    assert "Train/Samples/train_loss" in tags
+    assert "Train/Samples/lr" in tags
+    losses = [s for s in scalars if s["tag"] == "Train/Samples/train_loss"]
+    assert len(losses) == 3
+    assert all(np.isfinite(s["value"]) for s in losses)
+    # samples axis = step * global batch
+    assert losses[0]["step"] == engine.train_batch_size()
