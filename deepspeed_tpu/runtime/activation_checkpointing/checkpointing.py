@@ -42,6 +42,7 @@ _config = {
     "profile": False,
     "model_axis": "model",
     "mesh": None,
+    "mesh_explicit": False,
     "configured": False,
 }
 
@@ -69,12 +70,26 @@ def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
             _config[key] = val
     if mesh is not None:
         _config["mesh"] = mesh
+        _config["mesh_explicit"] = True
     if model_axis is not None:
         _config["model_axis"] = model_axis
     _config["configured"] = True
     logger.info(f"[deepspeed_tpu] activation checkpointing configured: "
                 f"partition={_config['partition_activations']} "
                 f"cpu={_config['cpu_checkpointing']} num={_config['number_checkpoints']}")
+
+
+def set_default_mesh(mesh, model_axis: Optional[str] = None):
+    """Publish a mesh for the partition constraint without flipping any flags or marking
+    the module configured. The engine calls this so a later Megatron-style
+    ``configure(partition_activations=True)`` — which has no mesh parameter — still
+    shards saveables over the model axis instead of silently no-opping. Latest engine
+    wins (a discarded engine's mesh must not linger), but a mesh passed explicitly to
+    ``configure(mesh=...)`` is never overridden."""
+    if not _config.get("mesh_explicit"):
+        _config["mesh"] = mesh
+        if model_axis is not None:
+            _config["model_axis"] = model_axis
 
 
 def is_configured() -> bool:
@@ -91,7 +106,7 @@ def reset():
     _config.update(partition_activations=False, cpu_checkpointing=False,
                    contiguous_memory_optimization=False, number_checkpoints=None,
                    synchronize=False, profile=False, mesh=None, model_axis="model",
-                   configured=False)
+                   configured=False, mesh_explicit=False)
 
 
 def _offload_policy():

@@ -253,6 +253,7 @@ class DeepSpeedEngine:
         self._grad_acc = None  # lazily zero-initialized with grad shardings
         self._pending_grads = None
         self._pending_loss = None
+        self._window_losses = []  # per-accumulation-window losses for monitor emission
         self._last_grad_norm = None
 
         # ---- lr scheduler ----
@@ -278,6 +279,8 @@ class DeepSpeedEngine:
         from .activation_checkpointing import checkpointing as act_ckpt
         if self.config.activation_checkpointing_config.configured_in_json:
             act_ckpt.configure(deepspeed_config=self.config, mesh=self.mesh)
+        else:
+            act_ckpt.set_default_mesh(self.mesh)
 
         # ---- scalar monitor (reference tensorboard wiring, engine.py:151-152, 246-261) ----
         self.monitor = None
@@ -517,14 +520,11 @@ class DeepSpeedEngine:
         # placement custom-calls that XLA's SPMD partitioner refuses to combine
         # with explicit (esp. replicated) out_shardings — there we let XLA pick
         # output layouts and the downstream jits re-shard via their in_shardings.
-        # Decided from THIS engine's config (the global module state can be
-        # reconfigured later by other engines; the jit choice must not drift).
-        if self.config.activation_checkpointing_config.cpu_checkpointing:
-            self._jit_loss_and_grad = jax.jit(loss_and_grad)
-        else:
-            self._jit_loss_and_grad = jax.jit(
-                loss_and_grad,
-                out_shardings=(NamedSharding(self.mesh, P()), self._grad_shardings))
+        # The choice is deferred to first forward (see _jit_loss_and_grad) so a
+        # Megatron-style act_ckpt.configure(checkpoint_in_cpu=True) AFTER engine
+        # construction still lands on the compatible jit.
+        self._loss_and_grad_fn = loss_and_grad
+        self._jit_loss_and_grad_cached = None
 
         def accumulate(acc, grads):
             return jax.tree_util.tree_map(lambda a, g: a + g, acc, grads)
@@ -592,6 +592,30 @@ class DeepSpeedEngine:
     def __call__(self, *inputs, **kwargs):
         return self.forward(*inputs, **kwargs)
 
+    @property
+    def _jit_loss_and_grad(self):
+        """Built lazily at first training forward so the cpu-checkpointing decision sees
+        both this engine's JSON config and any later module-level act_ckpt.configure()
+        call (a post-first-step reconfigure cannot retroactively change the jit)."""
+        if self._jit_loss_and_grad_cached is None:
+            from .activation_checkpointing import checkpointing as act_ckpt
+            ac = self.config.activation_checkpointing_config
+            # An engine WITH a JSON activation_checkpointing block decides from its own
+            # config (another engine's configure() must not strip its grad shardings);
+            # an engine WITHOUT one consults the process-global module, since its model's
+            # checkpoint_wrapper traces against that same global state.
+            if ac.configured_in_json:
+                cpu_ckpt = ac.cpu_checkpointing
+            else:
+                cpu_ckpt = act_ckpt.cpu_checkpointing_enabled()
+            if cpu_ckpt:
+                self._jit_loss_and_grad_cached = jax.jit(self._loss_and_grad_fn)
+            else:
+                self._jit_loss_and_grad_cached = jax.jit(
+                    self._loss_and_grad_fn,
+                    out_shardings=(NamedSharding(self.mesh, P()), self._grad_shardings))
+        return self._jit_loss_and_grad_cached
+
     def forward(self, *inputs):
         """Compute the loss (and cache this micro-batch's gradients for backward)."""
         if self.wall_clock_breakdown():
@@ -623,6 +647,11 @@ class DeepSpeedEngine:
         else:
             self._grad_acc = self._jit_accumulate(self._grad_acc, self._pending_grads)
         self._pending_grads = None
+        if self._pending_loss is not None:
+            # Defer the device sync: keep the per-micro-batch loss arrays and average at
+            # emission time, so the monitor logs the accumulation-window mean (reference
+            # logs the accumulated loss, not the last micro-batch's).
+            self._window_losses.append(self._pending_loss)
         self.micro_steps += 1
         if self.wall_clock_breakdown():
             self.timers("backward_microstep").stop()
@@ -707,18 +736,21 @@ class DeepSpeedEngine:
             # reference scalars: Train/Samples/train_loss + lr + loss_scale
             # (engine.py:779-790, 920-936)
             samples = self.global_steps * self.train_batch_size()
-            if self._pending_loss is not None:
+            if self._window_losses:
+                window = [float(jax.device_get(l)) for l in self._window_losses]
                 self.monitor.add_scalar("Train/Samples/train_loss",
-                                        float(jax.device_get(self._pending_loss)), samples)
+                                        sum(window) / len(window), samples)
             lr = self.get_lr()
             if lr:
                 self.monitor.add_scalar("Train/Samples/lr", lr[0], samples)
             if self.fp16_enabled():
-                self.monitor.add_scalar("Train/Samples/loss_scale",
-                                        float(jax.device_get(self.scaler_state.cur_scale)), samples)
+                self.monitor.add_scalar("Train/Samples/loss_scale", self.loss_scale(),
+                                        samples)
             if self._last_grad_norm is not None:
                 self.monitor.add_scalar("Train/Samples/grad_norm",
                                         float(jax.device_get(self._last_grad_norm)), samples)
+            self.monitor.flush()  # reference flushes per emission (engine.py:790)
+        self._window_losses = []
         if self.wall_clock_breakdown():
             self.timers("step_microstep").stop()
             self.timers.log(["forward_microstep", "backward_microstep", "step_microstep"],

@@ -18,6 +18,7 @@ Everything (overflow select, scaler update, master update) runs in ONE jitted ca
 with donated state — step-skip costs no host round-trip (SURVEY §7 hard part).
 """
 
+from collections import OrderedDict
 from typing import Any, Callable, Optional
 
 import jax
@@ -80,7 +81,11 @@ class FP16_Optimizer:
         self.scaler = ls.init_state(static_loss_scale, initial_scale_power, hysteresis)
         self.steps = jnp.asarray(0, jnp.int32)
         self._jit_step = jax.jit(self._step_impl, donate_argnums=(0, 1, 2, 3))
-        self._jit_backwards = {}  # per-loss_fn compiled backward cache
+        # Per-loss_fn compiled backward cache, LRU-bounded: the jitted closure holds a
+        # strong ref to its loss_fn, so an unbounded dict would leak executables (and
+        # whatever the loss_fn closes over) for callers that pass a fresh lambda per step.
+        self._jit_backwards = OrderedDict()
+        self._jit_backwards_max = 4
         self.overflow = False  # python-visible last-step overflow flag (reference l.245)
 
     # ------------------------------------------------------------------ loss scaling
@@ -99,7 +104,16 @@ class FP16_Optimizer:
         Returns (unscaled loss, scaled grads in fp32). The compiled backward is
         cached per loss_fn with the scale as an explicit argument, so repeated
         steps pay zero retrace."""
-        jitted = self._jit_backwards.get(loss_fn)
+        # Closure-free functions are keyed by their code object, so the documented
+        # fresh-lambda-per-step pattern (`opt.backward(lambda p, x: ..., p, x)`) hits the
+        # cache instead of recompiling every step; a closure-carrying loss_fn must be
+        # keyed by identity (same code, different captured values → different trace).
+        if (getattr(loss_fn, "__closure__", True) is None
+                and not getattr(loss_fn, "__defaults__", None)):
+            key = getattr(loss_fn, "__code__", loss_fn)
+        else:
+            key = loss_fn
+        jitted = self._jit_backwards.get(key)
         if jitted is None:
             def scaled_loss_and_grad(p, scale, *b):
                 def scaled(p, *bb):
@@ -107,7 +121,11 @@ class FP16_Optimizer:
                     return loss * scale.astype(loss.dtype), loss
                 (_, loss), grads = jax.value_and_grad(scaled, has_aux=True)(p, *b)
                 return loss, jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
-            jitted = self._jit_backwards[loss_fn] = jax.jit(scaled_loss_and_grad)
+            jitted = self._jit_backwards[key] = jax.jit(scaled_loss_and_grad)
+            while len(self._jit_backwards) > self._jit_backwards_max:
+                self._jit_backwards.popitem(last=False)
+        else:
+            self._jit_backwards.move_to_end(key)
         return jitted(params16, self.scaler.cur_scale, *batch)
 
     # ------------------------------------------------------------------ step

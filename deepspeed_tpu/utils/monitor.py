@@ -8,6 +8,7 @@ TPU-native analog of the reference's tensorboardX wiring
 additionally when a writer implementation is importable. Only process 0 writes.
 """
 
+import atexit
 import json
 import os
 import time
@@ -33,6 +34,7 @@ class SummaryMonitor:
         self.log_dir = os.path.join(output_path, job_name)
         os.makedirs(self.log_dir, exist_ok=True)
         self._jsonl = open(os.path.join(self.log_dir, "scalars.jsonl"), "a", buffering=1)
+        atexit.register(self.close)  # flush TB events on normal interpreter exit
         try:
             from torch.utils.tensorboard import SummaryWriter
             self._tb = SummaryWriter(log_dir=self.log_dir)
@@ -54,6 +56,7 @@ class SummaryMonitor:
             self._tb.flush()
 
     def close(self):
+        self.enabled = False  # a late add_scalar (e.g. one more step) becomes a no-op
         if self._jsonl is not None:
             self._jsonl.close()
             self._jsonl = None

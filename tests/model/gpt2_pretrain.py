@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""GPT-2 functional pretraining driver — the integration-test workload.
+
+Analog of the reference's ``tests/model/Megatron_GPT2`` suite (``ds_gpt2_test.sh`` builds a
+Megatron pretrain command line; ``test_common.py:69-98`` parses the resulting logs). Here the
+workload is our own tiny GPT-2 launched as a subprocess by ``run_func_test.py`` /
+``run_checkpoint_test.py`` with a ``--deepspeed_config`` JSON, training on deterministic
+synthetic data over an 8-virtual-device CPU mesh, printing parseable per-step lines:
+
+    step: N loss: X lr: Y
+
+Supports checkpoint save (``--save-dir`` + ``--save-interval``) and resume (``--load-dir``)
+so the checkpoint test can compare an interrupted-and-resumed run against a straight run.
+"""
+
+import os
+
+# Must precede any JAX backend initialization (see tests/conftest.py for why both the env
+# var and the explicit config update are needed under this environment's sitecustomize).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    _n = os.environ.get("DS_TEST_CPU_DEVICES", "8")
+    os.environ["XLA_FLAGS"] = _flags + f" --xla_force_host_platform_device_count={_n}"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import argparse  # noqa: E402
+import sys  # noqa: E402
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model  # noqa: E402
+
+
+def get_args():
+    p = argparse.ArgumentParser(description="tiny GPT-2 pretraining (integration tests)")
+    p.add_argument("--steps", type=int, default=8, help="optimizer steps to run")
+    p.add_argument("--seed", type=int, default=17)
+    p.add_argument("--vocab-size", type=int, default=64)
+    p.add_argument("--seq", type=int, default=16)
+    p.add_argument("--n-layer", type=int, default=2)
+    p.add_argument("--n-embd", type=int, default=32)
+    p.add_argument("--n-head", type=int, default=2)
+    p.add_argument("--save-dir", type=str, default=None)
+    p.add_argument("--save-interval", type=int, default=0,
+                   help="save a checkpoint every N steps (0 = never)")
+    p.add_argument("--load-dir", type=str, default=None,
+                   help="resume from the latest checkpoint in this directory")
+    p = deepspeed_tpu.add_config_arguments(p)
+    return p.parse_args()
+
+
+def build_dataset(args, total_steps, global_batch, gas):
+    """Deterministic learnable LM stream, generated in full so a resumed run sees the
+    exact same batches for steps it replays (same role as Megatron's seeded dataloader)."""
+    micro = global_batch // gas
+    rng = np.random.default_rng(args.seed)
+    toks = rng.integers(0, args.vocab_size,
+                        size=(total_steps, gas, micro, args.seq)).astype(np.int32)
+    # Make every odd position predictable from the previous token so loss can fall fast.
+    toks[..., 1::2] = (toks[..., 0::2] + 1) % args.vocab_size
+    labels = np.roll(toks, -1, axis=-1)
+    return toks, labels
+
+
+def main():
+    args = get_args()
+    cfg = GPT2Config(vocab_size=args.vocab_size, n_positions=args.seq, n_embd=args.n_embd,
+                     n_layer=args.n_layer, n_head=args.n_head)
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(args.seed))
+
+    engine, _, _, _ = deepspeed_tpu.initialize(args=args, model=model,
+                                               model_parameters=params)
+
+    start_step = 0
+    if args.load_dir:
+        path, _client = engine.load_checkpoint(args.load_dir)
+        assert path is not None, f"no checkpoint found in {args.load_dir}"
+        start_step = engine.global_steps
+        print(f"resumed_from: {start_step}", flush=True)
+
+    gas = engine.gradient_accumulation_steps()
+    toks, labels = build_dataset(args, args.steps, engine.train_batch_size(), gas)
+
+    for step in range(start_step, args.steps):
+        total = 0.0
+        for m in range(gas):
+            loss = engine(toks[step, m], labels[step, m])
+            engine.backward(loss)
+            total += float(jax.device_get(loss))
+        engine.step()
+        lr = engine.get_lr()
+        print(f"step: {step + 1} loss: {total / gas:.6f} lr: {lr[0] if lr else 0.0:.8f}",
+              flush=True)
+        if args.save_dir and args.save_interval and (step + 1) % args.save_interval == 0:
+            engine.save_checkpoint(args.save_dir)
+            print(f"saved_at: {step + 1}", flush=True)
+
+    print("training_complete", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Top-level sanity runner (analog of reference ``tests/model/run_sanity_check.py`` +
+``basic_install_test.py``): import the package, check version/ops availability, and run
+one tiny end-to-end training subprocess. Usable both as a pytest module and a script."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+
+def test_import_and_version():
+    import deepspeed_tpu
+    assert deepspeed_tpu.__version__
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine  # noqa: F401
+    from deepspeed_tpu.ops.sparse_attention import SparseSelfAttention  # noqa: F401
+    from deepspeed_tpu.ops.transformer import DeepSpeedTransformerLayer  # noqa: F401
+    from deepspeed_tpu.runtime.pipe.schedule import TrainSchedule  # noqa: F401
+    from deepspeed_tpu.launcher.runner import fetch_hostfile  # noqa: F401
+
+
+def test_native_cpu_adam_builds():
+    from deepspeed_tpu.ops.cpu_adam import DeepSpeedCPUAdam
+    assert DeepSpeedCPUAdam is not None
+
+
+def test_one_training_run(tmp_path):
+    from .test_common import load_config, run_gpt2
+    records, _ = run_gpt2(load_config("ds_config_func_bs8_zero2.json"), tmp_path,
+                          steps=2, name="sanity")
+    assert len(records) == 2
+
+
+if __name__ == "__main__":
+    import pytest
+    raise SystemExit(pytest.main([__file__, "-v"]))

@@ -1,0 +1,51 @@
+"""Shared harness for subprocess model tests.
+
+Mirrors the reference's ``tests/model/Megatron_GPT2/test_common.py:69-98``: build a command
+line, run the workload as a real subprocess (fresh JAX runtime, real launcher-style entry),
+and parse per-step losses/LRs out of its stdout.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+THIS_DIR = os.path.dirname(os.path.abspath(__file__))
+SCRIPT = os.path.join(THIS_DIR, "gpt2_pretrain.py")
+
+_STEP_RE = re.compile(r"^step: (\d+) loss: ([\d.eE+-]+) lr: ([\d.eE+-]+)$", re.M)
+
+
+def load_config(name):
+    with open(os.path.join(THIS_DIR, name)) as f:
+        return json.load(f)
+
+
+def parse_steps(stdout):
+    """-> list of dicts {step, loss, lr} in step order."""
+    return [{"step": int(m.group(1)), "loss": float(m.group(2)), "lr": float(m.group(3))}
+            for m in _STEP_RE.finditer(stdout)]
+
+
+def run_gpt2(config, workdir, steps=8, extra_args=(), name="run", timeout=600):
+    """Write `config` to JSON, launch gpt2_pretrain.py as a subprocess, parse its output.
+
+    Returns (records, completed_process). Raises AssertionError with full output on a
+    nonzero exit (the reference's harness turns subprocess failures into test failures
+    the same way, tests/unit/common.py:60-84).
+    """
+    os.makedirs(workdir, exist_ok=True)
+    cfg_path = os.path.join(str(workdir), f"{name}.json")
+    with open(cfg_path, "w") as f:
+        json.dump(config, f, indent=2)
+    cmd = [sys.executable, SCRIPT, "--deepspeed", "--deepspeed_config", cfg_path,
+           "--steps", str(steps), *map(str, extra_args)]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout, env=env)
+    assert proc.returncode == 0, (
+        f"workload failed (rc={proc.returncode})\ncmd: {' '.join(cmd)}\n"
+        f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr[-4000:]}")
+    records = parse_steps(proc.stdout)
+    return records, proc
