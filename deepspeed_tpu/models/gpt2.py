@@ -33,6 +33,8 @@ class GPT2Config:
     initializer_range: float = 0.02
     use_flash_attention: bool = False
     remat: bool = False            # activation checkpointing over blocks
+    remat_policy: Any = None       # None=full recompute; "dots"=save matmul outputs
+    loss_chunk: int = 128          # seq-chunked fused CE (0 = materialize full logits)
     compute_dtype: Any = jnp.bfloat16
 
     # named sizes for convenience
@@ -136,7 +138,8 @@ class GPT2Model:
         return x
 
     # ------------------------------------------------------------- apply
-    def logits(self, params, tokens):
+    def _backbone(self, params, tokens):
+        """Embeddings → transformer blocks → final layernorm: (B, T, H) hidden states."""
         c = self.config
         B, T = tokens.shape
         pos = jnp.arange(T)
@@ -146,21 +149,52 @@ class GPT2Model:
         if c.remat:
             # config-aware remat: honors partition_activations / cpu_checkpointing
             from ..runtime.activation_checkpointing.checkpointing import checkpoint_wrapper
-            block_fn = checkpoint_wrapper(block_fn)
+            block_fn = checkpoint_wrapper(block_fn, policy=c.remat_policy)
         for bp in params["blocks"]:
             x = block_fn(x, bp)
-        x = self._layer_norm(x, params["ln_f"], c.layer_norm_epsilon)
+        return self._layer_norm(x, params["ln_f"], c.layer_norm_epsilon)
+
+    def logits(self, params, tokens):
+        x = self._backbone(params, tokens)
         # tied LM head: logits = x @ wte.T
-        logits = jnp.dot(x, params["wte"].T.astype(x.dtype), preferred_element_type=jnp.float32)
-        return logits
+        return jnp.dot(x, params["wte"].T.astype(x.dtype), preferred_element_type=jnp.float32)
+
+    def _chunked_ce(self, x, wte, labels, chunk):
+        """Fused LM-head + softmax cross-entropy, scanned over sequence chunks so the
+        (B, T, vocab) fp32 logits tensor never materializes — at GPT-2 vocab (50k) full
+        logits for a 16×1024 batch are 3.3 GB and dominate HBM. The rematted scan body
+        recomputes each chunk's logits in backward from the (tiny) hidden states."""
+        B, T, H = x.shape
+        n = T // chunk
+        xs = x.reshape(B, n, chunk, H).swapaxes(0, 1)     # (n, B, C, H)
+        ls = labels.reshape(B, n, chunk).swapaxes(0, 1)   # (n, B, C)
+        w = wte.T.astype(x.dtype)                         # (H, V)
+
+        def body(tot, xc_lc):
+            xc, lc = xc_lc
+            logits = jnp.dot(xc, w, preferred_element_type=jnp.float32)  # (B, C, V)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
+            return tot + jnp.sum(lse - gold), None
+
+        total, _ = jax.lax.scan(jax.checkpoint(body), jnp.zeros((), jnp.float32), (xs, ls))
+        return total / (B * T)
 
     def apply(self, params, tokens, labels=None):
         """With labels: mean token cross-entropy loss (the training objective).
         Without: fp32 logits."""
-        logits = self.logits(params, tokens)
         if labels is None:
-            return logits
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            return self.logits(params, tokens)
+        c = self.config
+        x = self._backbone(params, tokens)
+        T = x.shape[1]
+        if c.loss_chunk:
+            # largest divisor of T not exceeding loss_chunk (static shapes for XLA)
+            chunk = next(cc for cc in range(min(c.loss_chunk, T), 0, -1) if T % cc == 0)
+            if chunk < T:
+                return self._chunked_ce(x, params["wte"], labels, chunk)
+        logits = jnp.dot(x, params["wte"].T.astype(x.dtype), preferred_element_type=jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         return -jnp.mean(ll)
 

@@ -737,7 +737,7 @@ class DeepSpeedEngine:
             # (engine.py:779-790, 920-936)
             samples = self.global_steps * self.train_batch_size()
             if self._window_losses:
-                window = [float(jax.device_get(l)) for l in self._window_losses]
+                window = [float(l) for l in jax.device_get(self._window_losses)]
                 self.monitor.add_scalar("Train/Samples/train_loss",
                                         sum(window) / len(window), samples)
             lr = self.get_lr()

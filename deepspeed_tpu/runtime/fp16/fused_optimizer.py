@@ -18,6 +18,7 @@ Everything (overflow select, scaler update, master update) runs in ONE jitted ca
 with donated state — step-skip costs no host round-trip (SURVEY §7 hard part).
 """
 
+import types
 from collections import OrderedDict
 from typing import Any, Callable, Optional
 
@@ -104,13 +105,14 @@ class FP16_Optimizer:
         Returns (unscaled loss, scaled grads in fp32). The compiled backward is
         cached per loss_fn with the scale as an explicit argument, so repeated
         steps pay zero retrace."""
-        # Closure-free functions are keyed by their code object, so the documented
+        # Closure-free plain functions are keyed by their code object, so the documented
         # fresh-lambda-per-step pattern (`opt.backward(lambda p, x: ..., p, x)`) hits the
-        # cache instead of recompiling every step; a closure-carrying loss_fn must be
-        # keyed by identity (same code, different captured values → different trace).
-        if (getattr(loss_fn, "__closure__", True) is None
-                and not getattr(loss_fn, "__defaults__", None)):
-            key = getattr(loss_fn, "__code__", loss_fn)
+        # cache instead of recompiling every step. Anything else — closures, bound
+        # methods (which share __code__ across instances!), arbitrary callables — is
+        # keyed by identity (same code, different captured state → different trace).
+        if (isinstance(loss_fn, types.FunctionType) and loss_fn.__closure__ is None
+                and not loss_fn.__defaults__):
+            key = loss_fn.__code__
         else:
             key = loss_fn
         jitted = self._jit_backwards.get(key)

@@ -30,13 +30,14 @@ _cache = {}
 
 
 def _run(name, tmp_path_factory, extra_args=()):
-    """One subprocess per config per session; parity tests reuse the cached records."""
-    if name not in _cache:
+    """One subprocess per (config, args) per session; parity tests reuse cached records."""
+    key = (name, tuple(map(str, extra_args)))
+    if key not in _cache:
         workdir = tmp_path_factory.mktemp(name.replace(".json", ""))
         records, proc = run_gpt2(load_config(name), workdir, steps=STEPS,
                                  extra_args=extra_args, name=name.replace(".json", ""))
-        _cache[name] = (records, proc.stdout)
-    return _cache[name]
+        _cache[key] = (records, proc.stdout)
+    return _cache[key]
 
 
 @pytest.mark.parametrize("config_name", CONFIGS)
