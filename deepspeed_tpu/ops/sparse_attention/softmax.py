@@ -1,0 +1,99 @@
+"""Fused block-sparse softmax over layout-active blocks.
+
+TPU-native rebuild of the reference's Triton sparse softmax
+(``deepspeed/ops/sparse_attention/softmax.py:207-292`` + ``trsrc/softmax_fwd.tr`` /
+``softmax_bwd.tr``): numerically-stable softmax across each logical row of a block-sparse
+score matrix, fused with optional scale, relative position embedding, key-padding mask and
+attention mask. Rows are distributed across blocks, so the row reductions are scatter-max /
+scatter-add over a row-segment LUT; XLA lowers these to efficient segmented reductions and
+the surrounding elementwise work fuses into one kernel.
+
+Sparse input/output format matches ``matmul.MatMul``: ``[batch, nnz, block, block]`` in
+row-major ``(head, row_block, col_block)`` layout order.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .matmul import _lut
+
+__all__ = ["Softmax"]
+
+
+class Softmax:
+    """softmax(scale*x + rpe + masks) across logical rows of the sparse matrix
+    (reference softmax.py:207 ``Softmax``; mask semantics l.244-292)."""
+
+    def __init__(self, layout: np.ndarray, block: int):
+        self.layout = np.asarray(layout)
+        self.block = int(block)
+        self.lut_h, self.lut_i, self.lut_j = _lut(self.layout)
+        H, Mb, Nb = self.layout.shape
+        # segment id of each nonzero block = its logical (head, row-block) pair
+        self.row_seg = (self.lut_h.astype(np.int64) * Mb + self.lut_i).astype(np.int32)
+        self.num_segs = H * Mb
+
+    def __call__(self, x: jnp.ndarray, scale: float = 1.0,
+                 rpe: Optional[jnp.ndarray] = None,
+                 key_padding_mask: Optional[jnp.ndarray] = None,
+                 attn_mask: Optional[jnp.ndarray] = None,
+                 key_padding_mask_mode: str = "add",
+                 attn_mask_mode: str = "mul") -> jnp.ndarray:
+        blk = self.block
+        B, nnz, _, _ = x.shape
+        assert nnz == len(self.lut_h), \
+            f"values nnz={nnz} does not match layout nnz={len(self.lut_h)}"
+        dtype = x.dtype
+        x = x.astype(jnp.float32) * scale
+
+        if rpe is not None:
+            # [H, T, T] (or [1, T, T]) relative position bias, gathered blockwise
+            rpe = jnp.asarray(rpe, jnp.float32)
+            H = self.layout.shape[0]
+            if rpe.shape[0] == 1 and H > 1:
+                rpe = jnp.broadcast_to(rpe, (H,) + rpe.shape[1:])
+            T = rpe.shape[-1]
+            rpe_blocks = rpe.reshape(H, T // blk, blk, T // blk, blk).transpose(0, 1, 3, 2, 4)
+            x = x + rpe_blocks[self.lut_h, self.lut_i, self.lut_j][None]
+
+        if attn_mask is not None:
+            # [T, T] mask over (query, key) positions. "mul" semantics follow the
+            # reference kernel: zero mask lanes become -inf before the row reduction
+            # (softmax_fwd.tr), nonzero lanes scale the score.
+            attn_mask = jnp.asarray(attn_mask, jnp.float32)
+            T = attn_mask.shape[-1]
+            am_blocks = attn_mask.reshape(T // blk, blk, T // blk, blk).transpose(0, 2, 1, 3)
+            am = am_blocks[self.lut_i, self.lut_j][None]
+            if attn_mask_mode == "mul":
+                x = jnp.where(am == 0.0, -jnp.inf, x * am)
+            else:
+                x = x + am
+
+        if key_padding_mask is not None:
+            # [B, T] mask over key positions (broadcast down each block row)
+            key_padding_mask = jnp.asarray(key_padding_mask, jnp.float32)
+            kp_blocks = key_padding_mask.reshape(B, -1, blk)        # [B, Nb, blk]
+            kp = kp_blocks[:, self.lut_j][:, :, None, :]            # [B, nnz, 1, blk]
+            if key_padding_mask_mode == "mul":
+                x = jnp.where(kp == 0.0, -jnp.inf, x * kp)
+            else:
+                x = x + kp
+
+        # --- segmented stable softmax across each logical row ---
+        neg_inf = jnp.float32(-jnp.inf)
+        block_rowmax = x.max(axis=-1)                                # [B, nnz, blk]
+        rowmax = jnp.full((B, self.num_segs, blk), neg_inf)
+        rowmax = rowmax.at[:, self.row_seg].max(block_rowmax)
+        rowmax = jax.lax.stop_gradient(rowmax)
+        shifted = x - rowmax[:, self.row_seg][..., None]
+        # fully-masked rows: exp(-inf - -inf) = nan -> force 0
+        ex = jnp.where(jnp.isnan(shifted), 0.0, jnp.exp(shifted))
+        block_rowsum = ex.sum(axis=-1)                               # [B, nnz, blk]
+        rowsum = jnp.zeros((B, self.num_segs, blk))
+        rowsum = rowsum.at[:, self.row_seg].add(block_rowsum)
+        denom = rowsum[:, self.row_seg][..., None]
+        out = jnp.where(denom > 0, ex / jnp.where(denom > 0, denom, 1.0), 0.0)
+        return out.astype(dtype)

@@ -111,7 +111,7 @@ class FP16_Optimizer:
         # methods (which share __code__ across instances!), arbitrary callables — is
         # keyed by identity (same code, different captured state → different trace).
         if (isinstance(loss_fn, types.FunctionType) and loss_fn.__closure__ is None
-                and not loss_fn.__defaults__):
+                and not loss_fn.__defaults__ and not loss_fn.__kwdefaults__):
             key = loss_fn.__code__
         else:
             key = loss_fn
