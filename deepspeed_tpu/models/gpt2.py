@@ -47,11 +47,71 @@ def _dense_init(rng, shape, scale):
     return jax.random.normal(rng, shape, jnp.float32) * scale
 
 
+def qkv_tp_permutation(n_embd: int, tp: int) -> "np.ndarray":
+    """Column permutation turning the ``[q | k | v]`` fused-qkv layout into rank-grouped
+    ``[q_0 k_0 v_0 | q_1 k_1 v_1 | ...]`` so a contiguous model-axis shard of width
+    3*n_embd/tp is a valid local (q, k, v) triple for manual (shard_map) TP. GSPMD TP
+    needs no permutation — it keeps global semantics through the qkv split."""
+    import numpy as np
+    per = n_embd // tp
+    cols = []
+    for r in range(tp):
+        for third in range(3):
+            start = third * n_embd + r * per
+            cols.append(np.arange(start, start + per))
+    return np.concatenate(cols)
+
+
 class GPT2Model:
-    """Pure-function GPT-2: ``init(rng) -> params``, ``apply(params, tokens[, labels])``."""
+    """Pure-function GPT-2: ``init(rng) -> params``, ``apply(params, tokens[, labels])``.
+
+    Tensor parallelism comes in two flavors (SURVEY §2.3: TP is first-class here where
+    the reference delegated to Megatron's mpu):
+    - GSPMD: pass ``param_shardings(mesh)`` to the engine; XLA inserts the collectives
+      from the Megatron-style weight layouts (requires ``use_flash_attention=False`` —
+      a Pallas call cannot be auto-partitioned over the model axis).
+    - Manual (inside ``shard_map``, e.g. the SPMD pipeline): ``with_tp(axis, size)``
+      returns a model whose attention/MLP consume model-axis weight shards and psum the
+      row-parallel projections, the Megatron forward exactly.
+    """
 
     def __init__(self, config: GPT2Config):
         self.config = config
+        self.tp_axis = None   # set via with_tp() for manual-collective (shard_map) TP
+        self.tp_size = 1
+
+    def with_tp(self, axis: str, size: int) -> "GPT2Model":
+        """A copy configured for manual tensor parallelism over mesh axis ``axis``."""
+        assert self.config.n_head % size == 0, \
+            f"n_head={self.config.n_head} must divide by tp size {size}"
+        assert (4 * self.config.n_embd) % size == 0
+        m = GPT2Model(self.config)
+        m.tp_axis = axis
+        m.tp_size = size
+        return m
+
+    def param_shardings(self, mesh):
+        """Megatron-style TP layouts over the mesh's ``model`` axis for the GSPMD path:
+        column-parallel c_attn/c_fc (output dim sharded), row-parallel c_proj (input dim
+        sharded), vocab-sharded embedding; norms/biases-of-row-parallel replicated."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.mesh import MODEL_AXIS
+
+        def ns(*spec):
+            return NamedSharding(mesh, P(*spec))
+
+        repl = ns()
+        ln = {"scale": repl, "bias": repl}
+        block = {
+            "ln_1": ln,
+            "attn": {"c_attn_w": ns(None, MODEL_AXIS), "c_attn_b": ns(MODEL_AXIS),
+                     "c_proj_w": ns(MODEL_AXIS, None), "c_proj_b": repl},
+            "ln_2": ln,
+            "mlp": {"c_fc_w": ns(None, MODEL_AXIS), "c_fc_b": ns(MODEL_AXIS),
+                    "c_proj_w": ns(MODEL_AXIS, None), "c_proj_b": repl},
+        }
+        return {"wte": ns(MODEL_AXIS, None), "wpe": repl, "ln_f": dict(ln),
+                "blocks": [block for _ in range(self.config.n_layer)]}
 
     # ------------------------------------------------------------- init
     def init(self, rng) -> Dict:
@@ -100,12 +160,13 @@ class GPT2Model:
     def _attention(self, x, p, dropout_rng=None):
         c = self.config
         B, T, E = x.shape
+        nh = c.n_head // self.tp_size  # local heads under manual TP (all heads otherwise)
         qkv = jnp.dot(x, p["c_attn_w"].astype(x.dtype),
                       preferred_element_type=jnp.float32).astype(x.dtype) + p["c_attn_b"].astype(x.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(B, T, c.n_head, c.head_dim).transpose(0, 2, 1, 3)
-        k = k.reshape(B, T, c.n_head, c.head_dim).transpose(0, 2, 1, 3)
-        v = v.reshape(B, T, c.n_head, c.head_dim).transpose(0, 2, 1, 3)
+        q = q.reshape(B, T, nh, c.head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(B, T, nh, c.head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(B, T, nh, c.head_dim).transpose(0, 2, 1, 3)
 
         if c.use_flash_attention:
             from ..ops.pallas.flash_attention import flash_attention
@@ -118,18 +179,21 @@ class GPT2Model:
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             y = jnp.einsum("bhqk,bhkd->bhqd", probs, v,
                            preferred_element_type=jnp.float32).astype(x.dtype)
-        y = y.transpose(0, 2, 1, 3).reshape(B, T, E)
-        y = jnp.dot(y, p["c_proj_w"].astype(x.dtype),
-                    preferred_element_type=jnp.float32).astype(x.dtype) + p["c_proj_b"].astype(x.dtype)
-        return y
+        y = y.transpose(0, 2, 1, 3).reshape(B, T, nh * c.head_dim)
+        y = jnp.dot(y, p["c_proj_w"].astype(x.dtype), preferred_element_type=jnp.float32)
+        if self.tp_axis is not None:
+            # row-parallel projection: partial sums over the model axis (Megatron fwd)
+            y = jax.lax.psum(y, self.tp_axis)
+        return y.astype(x.dtype) + p["c_proj_b"].astype(x.dtype)
 
     def _mlp(self, x, p):
         h = jnp.dot(x, p["c_fc_w"].astype(x.dtype),
                     preferred_element_type=jnp.float32).astype(x.dtype) + p["c_fc_b"].astype(x.dtype)
         h = jax.nn.gelu(h, approximate=True)
-        out = jnp.dot(h, p["c_proj_w"].astype(x.dtype),
-                      preferred_element_type=jnp.float32).astype(x.dtype) + p["c_proj_b"].astype(x.dtype)
-        return out
+        out = jnp.dot(h, p["c_proj_w"].astype(x.dtype), preferred_element_type=jnp.float32)
+        if self.tp_axis is not None:
+            out = jax.lax.psum(out, self.tp_axis)
+        return out.astype(x.dtype) + p["c_proj_b"].astype(x.dtype)
 
     def _block(self, x, bp):
         c = self.config

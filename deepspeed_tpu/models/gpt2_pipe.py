@@ -13,24 +13,52 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 
-from ..parallel.mesh import PIPE_AXIS
-from ..parallel.pipeline_spmd import pipeline_apply, stacked_param_sharding
-from .gpt2 import GPT2Config, GPT2Model
+from ..parallel.mesh import MODEL_AXIS, PIPE_AXIS
+from ..parallel.pipeline_spmd import pipeline_apply
+from .gpt2 import GPT2Config, GPT2Model, qkv_tp_permutation
+
+# per-leaf model-axis dims of a block's ORIGINAL (unstacked) weight shapes:
+# column-parallel c_attn/c_fc shard the output dim, row-parallel c_proj the input dim
+# (Megatron layout; reference delegated this to the external mpu, SURVEY §2.3)
+_BLOCK_TP_DIMS = {
+    "ln_1": {"scale": (None,), "bias": (None,)},
+    "attn": {"c_attn_w": (None, MODEL_AXIS), "c_attn_b": (MODEL_AXIS,),
+             "c_proj_w": (MODEL_AXIS, None), "c_proj_b": (None,)},
+    "ln_2": {"scale": (None,), "bias": (None,)},
+    "mlp": {"c_fc_w": (None, MODEL_AXIS), "c_fc_b": (MODEL_AXIS,),
+            "c_proj_w": (MODEL_AXIS, None), "c_proj_b": (None,)},
+}
 
 
 class GPT2Pipe:
-    """Pipelined GPT-2. ``init`` returns {"io": embed/head params, "stages": stacked blocks}."""
+    """Pipelined GPT-2. ``init`` returns {"io": embed/head params, "stages": stacked blocks}.
 
-    def __init__(self, config: GPT2Config, num_stages: int):
+    With ``tp > 1`` the block weights additionally shard over the ``model`` mesh axis
+    (3D = pipe × data × model): the fused qkv columns are stored rank-grouped (see
+    ``qkv_tp_permutation``) so each model rank's contiguous shard is a valid local
+    (q, k, v), and the stage functions run the Megatron manual-collective forward.
+    Note: checkpoints written with tp>1 store the permuted qkv layout — reload with the
+    same tp, or re-permute through ``from_dense``.
+    """
+
+    def __init__(self, config: GPT2Config, num_stages: int, tp: int = 1):
         assert config.n_layer % num_stages == 0, "n_layer must divide evenly into stages"
         self.config = config
         self.num_stages = num_stages
         self.layers_per_stage = config.n_layer // num_stages
-        self._dense = GPT2Model(config)
+        self.tp = tp
+        self._dense = GPT2Model(config) if tp == 1 else GPT2Model(config).with_tp(MODEL_AXIS, tp)
 
-    def init(self, rng) -> Dict[str, Any]:
-        flat = self._dense.init(rng)
+    def _stack(self, flat) -> Dict[str, Any]:
         blocks = flat.pop("blocks")
+        if self.tp > 1:
+            perm = qkv_tp_permutation(self.config.n_embd, self.tp)
+            # rebuild (never mutate) the caller's nested dicts: from_dense takes a tree
+            # the user may keep using with the unpermuted dense model
+            blocks = [{**b, "attn": {**b["attn"],
+                                     "c_attn_w": b["attn"]["c_attn_w"][:, perm],
+                                     "c_attn_b": b["attn"]["c_attn_b"][perm]}}
+                      for b in blocks]
         # stack per-layer block params → [L, ...], then fold into [S, L/S, ...]
         stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
         S, LpS = self.num_stages, self.layers_per_stage
@@ -38,18 +66,30 @@ class GPT2Pipe:
             lambda a: a.reshape((S, LpS) + a.shape[1:]), stacked)
         return {"io": flat, "stages": stacked}
 
+    def init(self, rng) -> Dict[str, Any]:
+        return self._stack(self._dense.init(rng))
+
     def from_dense(self, dense_params) -> Dict[str, Any]:
-        flat = dict(dense_params)
-        blocks = flat.pop("blocks")
-        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
-        stacked = jax.tree_util.tree_map(
-            lambda a: a.reshape((self.num_stages, self.layers_per_stage) + a.shape[1:]), stacked)
-        return {"io": flat, "stages": stacked}
+        return self._stack(dict(dense_params))
+
+    def _stacked_specs(self, stages):
+        """P(pipe, None, *tp_dims) per stacked leaf (tp dims only meaningful for tp>1)."""
+        from jax.sharding import PartitionSpec as P
+
+        def leaf_spec(a, dims):
+            tp_dims = tuple(d if self.tp > 1 else None for d in dims)
+            assert a.ndim == 2 + len(dims), f"stacked leaf rank {a.ndim} vs dims {dims}"
+            return P(PIPE_AXIS, None, *tp_dims)
+
+        return jax.tree_util.tree_map(leaf_spec, stages, _BLOCK_TP_DIMS)
 
     def param_shardings(self, mesh, params):
         from jax.sharding import NamedSharding, PartitionSpec as P
         io_sh = jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), params["io"])
-        return {"io": io_sh, "stages": stacked_param_sharding(mesh, params["stages"])}
+        stage_specs = self._stacked_specs(params["stages"])
+        stages_sh = jax.tree_util.tree_map(lambda spec: NamedSharding(mesh, spec), stage_specs,
+                                           is_leaf=lambda x: isinstance(x, P))
+        return {"io": io_sh, "stages": stages_sh}
 
     # ---- stage functions ----
     def _stage_fn(self, stage_params, x):
@@ -84,6 +124,10 @@ class GPT2Pipe:
     # ---- training loss over micro-batches ----
     def loss(self, params, tokens_mb, labels_mb, *, mesh):
         """Mean LM loss over [M, B, T] micro-batches through the pipe-axis pipeline."""
+        if self.tp > 1:
+            tp_in_mesh = mesh.shape.get(MODEL_AXIS, 1)
+            assert tp_in_mesh == self.tp, \
+                f"model constructed with tp={self.tp} but mesh model axis is {tp_in_mesh}"
         io = params["io"]
         return pipeline_apply(
             self._stage_fn,
@@ -94,4 +138,5 @@ class GPT2Pipe:
             first_stage_args=(io,),
             last_stage_fn=lambda y, io_p, labels, mb: self._head_loss(y, io_p, labels, mb),
             last_stage_args=(io, labels_mb),
+            stacked_param_specs=self._stacked_specs(params["stages"]),
         )

@@ -53,7 +53,8 @@ def pipeline_apply(stage_fn: Callable,
                    last_stage_args=(),
                    first_stage_fn: Callable = None,
                    first_stage_args=(),
-                   last_stage_args_specs=None):
+                   last_stage_args_specs=None,
+                   stacked_param_specs=None):
     """Run micro-batches through the pipe-axis pipeline inside shard_map.
 
     Args:
@@ -142,8 +143,13 @@ def pipeline_apply(stage_fn: Callable,
     # shardings: stacked params split over pipe; everything else replicated over pipe
     # (data-dim sharding of the micro-batches is preserved by P(None, 'data', ...)).
     x_spec = P(*([None, DATA_AXIS] + [None] * (x_microbatches.ndim - 2)))
-    stacked_spec = jax.tree_util.tree_map(lambda a: P(*([PIPE_AXIS] + [None] * (a.ndim - 1))),
-                                          stacked_params)
+    if stacked_param_specs is not None:
+        # caller-provided layout (e.g. model-axis TP dims on the weight shards); the
+        # stage_fn is then responsible for the matching manual collectives
+        stacked_spec = stacked_param_specs
+    else:
+        stacked_spec = jax.tree_util.tree_map(
+            lambda a: P(*([PIPE_AXIS] + [None] * (a.ndim - 1))), stacked_params)
 
     def _last_arg_spec(a):
         # micro-batched leaves ([M, batch, ...], e.g. labels) keep their data sharding;
