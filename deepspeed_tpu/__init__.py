@@ -58,3 +58,17 @@ def add_config_arguments(parser):
     """Update an argument parser to enable the DeepSpeed config block."""
     parser = _add_core_arguments(parser)
     return parser
+
+
+# ---- legacy `deepspeed.pt` module-structure shim (reference deepspeed/__init__.py:41-49)
+import sys as _sys
+import types as _types
+
+from .runtime import config as _rt_config, utils as _rt_utils
+
+pt = _types.ModuleType("pt", "legacy pt module alias for backwards compatibility")
+pt.deepspeed_utils = _rt_utils
+pt.deepspeed_config = _rt_config
+_sys.modules[__name__ + ".pt"] = pt
+_sys.modules[__name__ + ".pt.deepspeed_utils"] = _rt_utils
+_sys.modules[__name__ + ".pt.deepspeed_config"] = _rt_config

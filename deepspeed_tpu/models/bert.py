@@ -128,3 +128,47 @@ class BertForMaskedLM:
 
     def param_count(self, params) -> int:
         return sum(int(l.size) for l in jax.tree_util.tree_leaves(params))
+
+
+class BertForQuestionAnswering:
+    """BERT + span-extraction head: the BingBertSquad fine-tuning workload of the
+    reference (tests/model/BingBertSquad drives a SQuAD fine-tune through the engine).
+    ``apply`` returns the mean of start- and end-position cross-entropies."""
+
+    def __init__(self, config: BertConfig):
+        self.config = config
+        self.bert = BertModel(config)
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        params = self.bert.init(k1)
+        h = self.config.hidden_size
+        params["qa_outputs"] = {
+            "w": jax.random.normal(k2, (h, 2), jnp.float32) * self.config.initializer_range,
+            "b": jnp.zeros((2,), jnp.float32),
+        }
+        return params
+
+    def logits(self, params, input_ids, token_type_ids=None, attention_mask=None,
+               rng=None, deterministic=True):
+        """-> (start_logits, end_logits), each [B, T] fp32."""
+        x = self.bert.apply(params, input_ids, token_type_ids, attention_mask, rng,
+                            deterministic)
+        qa = params["qa_outputs"]
+        out = jnp.dot(x, qa["w"].astype(x.dtype),
+                      preferred_element_type=jnp.float32) + qa["b"]
+        return out[..., 0], out[..., 1]
+
+    def apply(self, params, input_ids, start_positions, end_positions,
+              token_type_ids=None, attention_mask=None, rng=None, deterministic=True):
+        start_logits, end_logits = self.logits(params, input_ids, token_type_ids,
+                                               attention_mask, rng, deterministic)
+
+        def ce(logits, pos):
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.mean(jnp.take_along_axis(logp, pos[:, None], axis=-1)[:, 0])
+
+        return (ce(start_logits, start_positions) + ce(end_logits, end_positions)) / 2.0
+
+    def param_count(self, params) -> int:
+        return sum(int(l.size) for l in jax.tree_util.tree_leaves(params))

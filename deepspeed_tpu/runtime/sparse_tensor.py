@@ -106,3 +106,8 @@ def match_sparse_paths(path_str: str, patterns: Sequence[str]) -> bool:
     on ``isinstance(module, nn.Embedding)``, engine.py:180-187; a functional pytree
     keys on leaf path substrings instead)."""
     return any(p in path_str for p in patterns)
+
+
+# Reference-name alias (deepspeed/runtime/csr_tensor.py exports CSRTensor; the TPU
+# rebuild is row-sparse rather than true CSR, but the role and API surface match).
+CSRTensor = SparseTensor

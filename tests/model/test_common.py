@@ -28,8 +28,8 @@ def parse_steps(stdout):
             for m in _STEP_RE.finditer(stdout)]
 
 
-def run_gpt2(config, workdir, steps=8, extra_args=(), name="run", timeout=600):
-    """Write `config` to JSON, launch gpt2_pretrain.py as a subprocess, parse its output.
+def run_workload(script, config, workdir, steps=8, extra_args=(), name="run", timeout=600):
+    """Write `config` to JSON, launch `script` as a subprocess, parse its step lines.
 
     Returns (records, completed_process). Raises AssertionError with full output on a
     nonzero exit (the reference's harness turns subprocess failures into test failures
@@ -39,7 +39,7 @@ def run_gpt2(config, workdir, steps=8, extra_args=(), name="run", timeout=600):
     cfg_path = os.path.join(str(workdir), f"{name}.json")
     with open(cfg_path, "w") as f:
         json.dump(config, f, indent=2)
-    cmd = [sys.executable, SCRIPT, "--deepspeed", "--deepspeed_config", cfg_path,
+    cmd = [sys.executable, script, "--deepspeed", "--deepspeed_config", cfg_path,
            "--steps", str(steps), *map(str, extra_args)]
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -49,3 +49,8 @@ def run_gpt2(config, workdir, steps=8, extra_args=(), name="run", timeout=600):
         f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr[-4000:]}")
     records = parse_steps(proc.stdout)
     return records, proc
+
+
+def run_gpt2(config, workdir, steps=8, extra_args=(), name="run", timeout=600):
+    return run_workload(SCRIPT, config, workdir, steps=steps, extra_args=extra_args,
+                        name=name, timeout=timeout)
