@@ -127,7 +127,8 @@ class BertForMaskedLM:
         return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
     def param_count(self, params) -> int:
-        return sum(int(l.size) for l in jax.tree_util.tree_leaves(params))
+        from ..runtime.utils import param_count
+        return param_count(params)
 
 
 class BertForQuestionAnswering:
@@ -171,4 +172,5 @@ class BertForQuestionAnswering:
         return (ce(start_logits, start_positions) + ce(end_logits, end_positions)) / 2.0
 
     def param_count(self, params) -> int:
-        return sum(int(l.size) for l in jax.tree_util.tree_leaves(params))
+        from ..runtime.utils import param_count
+        return param_count(params)

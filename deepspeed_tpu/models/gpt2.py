@@ -263,4 +263,5 @@ class GPT2Model:
         return -jnp.mean(ll)
 
     def param_count(self, params) -> int:
-        return sum(int(l.size) for l in jax.tree_util.tree_leaves(params))
+        from ..runtime.utils import param_count
+        return param_count(params)

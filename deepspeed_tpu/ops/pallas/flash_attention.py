@@ -55,7 +55,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
     bq = q_ref.shape[0]
     d = q_ref.shape[1]
     q_blk_idx = pl.program_id(1)
-    q = q_ref[...].astype(jnp.float32) * sm_scale
+    # keep MXU operands in the input dtype (bf16): bf16-in/fp32-accumulate is the MXU's
+    # native mode — upcasting to fp32 before the dot ran the matmuls many times slower
+    q = q_ref[...]
 
     num_k_blocks = pl.cdiv(seq_len, block_k)
     if causal:
@@ -70,9 +72,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
 
     def body(kb, carry):
         m, l, acc = carry
-        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
         if causal:
             q_pos = q_blk_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
@@ -81,7 +83,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+        acc_new = acc * alpha + jnp.dot(p.astype(v_blk.dtype), v_blk,
+                                        preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(0, last_blk, body, (m0, l0, acc0))
@@ -130,8 +133,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                    sm_scale, causal, block_k, seq_len):
     bq, d = q_ref.shape
     q_blk_idx = pl.program_id(1)
-    q = q_ref[...].astype(jnp.float32) * sm_scale
-    do = do_ref[...].astype(jnp.float32)
+    q = q_ref[...]      # input dtype: bf16-in/fp32-out MXU dots (see _fwd_kernel note)
+    do = do_ref[...]
     lse = lse_ref[...].reshape(bq, 1)
     delta = delta_ref[...].reshape(bq, 1)
 
@@ -142,9 +145,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         last_blk = num_k_blocks
 
     def body(kb, dq):
-        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
             q_pos = q_blk_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
@@ -152,7 +155,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+        return dq + jnp.dot(ds.astype(k_blk.dtype), k_blk, preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, last_blk, body, jnp.zeros((bq, d), jnp.float32))
     dq_ref[...] = (dq * sm_scale).astype(dq_ref.dtype)
@@ -162,8 +165,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
                     sm_scale, causal, block_q, seq_len):
     bk, d = k_ref.shape
     k_blk_idx = pl.program_id(1)
-    k = k_ref[...].astype(jnp.float32)
-    v = v_ref[...].astype(jnp.float32)
+    k = k_ref[...]      # input dtype: bf16-in/fp32-out MXU dots (see _fwd_kernel note)
+    v = v_ref[...]
 
     num_q_blocks = pl.cdiv(seq_len, block_q)
     if causal:
@@ -173,25 +176,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
 
     def body(qb, carry):
         dk, dv = carry
-        q_blk = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32) * sm_scale
-        do_blk = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        q_blk = q_ref[pl.ds(qb * block_q, block_q), :]
+        do_blk = do_ref[pl.ds(qb * block_q, block_q), :]
         lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q)].reshape(block_q, 1)
         delta_blk = delta_ref[0, pl.ds(qb * block_q, block_q)].reshape(block_q, 1)
-        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
         if causal:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
             k_pos = k_blk_idx * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse_blk)
-        dv_new = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
+        dv_new = dv + jnp.dot(p.T.astype(do_blk.dtype), do_blk,
+                              preferred_element_type=jnp.float32)
         dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta_blk)
-        dk_new = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
+        dk_new = dk + jnp.dot(ds.T.astype(q_blk.dtype), q_blk,
+                              preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
     dk, dv = jax.lax.fori_loop(first_blk, num_q_blocks, body,
                                (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
-    dk_ref[...] = dk.astype(dk_ref.dtype)  # q already carried sm_scale
+    dk_ref[...] = (dk * sm_scale).astype(dk_ref.dtype)
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 

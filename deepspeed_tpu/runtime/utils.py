@@ -20,6 +20,11 @@ import numpy as np
 from ..utils import logger
 
 
+def param_count(params) -> int:
+    """Total element count of a parameter pytree (shared by the model families)."""
+    return sum(int(l.size) for l in jax.tree_util.tree_leaves(params))
+
+
 def set_random_seed(seed: int):
     """Seed python/numpy and return a JAX PRNG key (stateless JAX analog of l.33)."""
     import random

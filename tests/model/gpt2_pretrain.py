@@ -14,25 +14,16 @@ so the checkpoint test can compare an interrupted-and-resumed run against a stra
 """
 
 import os
+import sys
 
-# Must precede any JAX backend initialization (see tests/conftest.py for why both the env
-# var and the explicit config update are needed under this environment's sitecustomize).
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    _n = os.environ.get("DS_TEST_CPU_DEVICES", "8")
-    os.environ["XLA_FLAGS"] = _flags + f" --xla_force_host_platform_device_count={_n}"
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from workload_env import setup  # noqa: E402  (must precede jax backend init)
+
+jax = setup()
 
 import argparse  # noqa: E402
-import sys  # noqa: E402
 
 import numpy as np  # noqa: E402
-import jax  # noqa: E402
-
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    jax.config.update("jax_platforms", "cpu")
-
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
 
 import deepspeed_tpu  # noqa: E402
 from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model  # noqa: E402

@@ -1,0 +1,22 @@
+"""Shared environment bootstrap for subprocess workload drivers.
+
+Must be imported (and ``setup()`` called) BEFORE jax initializes a backend: forces the
+virtual multi-device CPU platform despite this environment's sitecustomize pinning a real
+TPU platform (see tests/conftest.py for the full story)."""
+
+import os
+import sys
+
+
+def setup():
+    """Configure the CPU test platform and repo import path; returns the jax module."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        n = os.environ.get("DS_TEST_CPU_DEVICES", "8")
+        os.environ["XLA_FLAGS"] = flags + f" --xla_force_host_platform_device_count={n}"
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+    return jax

@@ -9,30 +9,43 @@ import pytest
 from deepspeed_tpu.ops.pallas.flash_attention import flash_attention, dense_attention
 
 
+# bf16 exercises the kernels' MXU-native cast paths (bf16 operands, fp32 accumulate);
+# fp32 pins exact numerics. Tolerances scale with the dtype's epsilon.
+_DTYPES = [(jnp.float32, 2e-5, 2e-4), (jnp.bfloat16, 3e-2, 5e-2)]
+
+
+@pytest.mark.parametrize("dtype,fwd_tol,bwd_tol", _DTYPES)
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("shape", [(2, 3, 256, 64), (1, 2, 128, 32)])
-def test_forward_parity(causal, shape):
+def test_forward_parity(causal, shape, dtype, fwd_tol, bwd_tol):
     B, H, T, D = shape
-    q, k, v = (jax.random.normal(kk, shape, jnp.float32)
+    q, k, v = (jax.random.normal(kk, shape, jnp.float32).astype(dtype)
                for kk in jax.random.split(jax.random.PRNGKey(0), 3))
-    out_f = flash_attention(q, k, v, causal, None, 128, 128, True)
-    out_d = dense_attention(q, k, v, causal=causal)
-    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_d), rtol=2e-5, atol=2e-5)
+    out_f = flash_attention(q, k, v, causal, None, 128, 128, True).astype(jnp.float32)
+    # reference in fp32 regardless of input dtype
+    out_d = dense_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                            v.astype(jnp.float32), causal=causal)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_d),
+                               rtol=fwd_tol, atol=fwd_tol)
 
 
+@pytest.mark.parametrize("dtype,fwd_tol,bwd_tol", _DTYPES)
 @pytest.mark.parametrize("causal", [False, True])
-def test_backward_parity(causal):
+def test_backward_parity(causal, dtype, fwd_tol, bwd_tol):
     shape = (2, 3, 256, 64)
-    q, k, v = (jax.random.normal(kk, shape, jnp.float32)
+    q, k, v = (jax.random.normal(kk, shape, jnp.float32).astype(dtype)
                for kk in jax.random.split(jax.random.PRNGKey(0), 3))
-    g = jax.random.normal(jax.random.PRNGKey(9), shape)
-    gf = jax.grad(lambda q, k, v: jnp.sum(flash_attention(q, k, v, causal, None, 128, 128, True) * g),
+    g = jax.random.normal(jax.random.PRNGKey(9), shape, jnp.float32).astype(dtype)
+    gf = jax.grad(lambda q, k, v: jnp.sum((flash_attention(q, k, v, causal, None, 128, 128, True)
+                                           * g).astype(jnp.float32)),
                   argnums=(0, 1, 2))(q, k, v)
-    gd = jax.grad(lambda q, k, v: jnp.sum(dense_attention(q, k, v, causal=causal) * g),
-                  argnums=(0, 1, 2))(q, k, v)
+    f32 = (q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32))
+    gd = jax.grad(lambda q, k, v: jnp.sum(dense_attention(q, k, v, causal=causal)
+                                          * g.astype(jnp.float32)),
+                  argnums=(0, 1, 2))(*f32)
     for a, b, name in zip(gf, gd, "qkv"):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4,
-                                   err_msg=f"d{name}")
+        np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b),
+                                   rtol=bwd_tol, atol=bwd_tol, err_msg=f"d{name}")
 
 
 def test_block_size_autofit():
