@@ -157,6 +157,14 @@ class GPT2Model:
         out = (xf - mean) * jax.lax.rsqrt(var + eps)
         return (out * p["scale"] + p["bias"]).astype(x.dtype)
 
+    def _dropout(self, x, rng):
+        """Stateless inverted dropout (rate = config.dropout). The PRNG key is threaded
+        explicitly, so recompute-under-remat reproduces identical masks — the TPU analog
+        of the reference's CUDA RNG state tracker (checkpointing.py:147-262)."""
+        keep = 1.0 - self.config.dropout
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / jnp.asarray(keep, x.dtype), jnp.zeros((), x.dtype))
+
     def _attention(self, x, p, dropout_rng=None):
         c = self.config
         B, T, E = x.shape
@@ -177,6 +185,10 @@ class GPT2Model:
             mask = jnp.tril(jnp.ones((T, T), jnp.bool_))
             scores = jnp.where(mask, scores, jnp.float32(-1e9))
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            if dropout_rng is not None and c.dropout > 0:
+                # attention-probability dropout (dense path only; the flash kernel has
+                # no in-kernel dropout — residual/embedding dropout still apply there)
+                probs = self._dropout(probs, dropout_rng)
             y = jnp.einsum("bhqk,bhkd->bhqd", probs, v,
                            preferred_element_type=jnp.float32).astype(x.dtype)
         y = y.transpose(0, 2, 1, 3).reshape(B, T, nh * c.head_dim)
@@ -195,19 +207,33 @@ class GPT2Model:
             out = jax.lax.psum(out, self.tp_axis)
         return out.astype(x.dtype) + p["c_proj_b"].astype(x.dtype)
 
-    def _block(self, x, bp):
+    def _block(self, x, bp, rng=None):
         c = self.config
-        x = x + self._attention(self._layer_norm(x, bp["ln_1"], c.layer_norm_epsilon), bp["attn"])
-        x = x + self._mlp(self._layer_norm(x, bp["ln_2"], c.layer_norm_epsilon), bp["mlp"])
-        return x
+        k_attn = k_res1 = k_res2 = None
+        if rng is not None and c.dropout > 0:
+            k_attn, k_res1, k_res2 = jax.random.split(rng, 3)
+        a = self._attention(self._layer_norm(x, bp["ln_1"], c.layer_norm_epsilon),
+                            bp["attn"], dropout_rng=k_attn)
+        if k_res1 is not None:
+            a = self._dropout(a, k_res1)
+        x = x + a
+        m = self._mlp(self._layer_norm(x, bp["ln_2"], c.layer_norm_epsilon), bp["mlp"])
+        if k_res2 is not None:
+            m = self._dropout(m, k_res2)
+        return x + m
 
     # ------------------------------------------------------------- apply
-    def _backbone(self, params, tokens):
-        """Embeddings → transformer blocks → final layernorm: (B, T, H) hidden states."""
+    def _backbone(self, params, tokens, rng=None):
+        """Embeddings → transformer blocks → final layernorm: (B, T, H) hidden states.
+        ``rng`` enables stateless dropout (config.dropout) — omit it for eval."""
         c = self.config
         B, T = tokens.shape
         pos = jnp.arange(T)
         x = params["wte"][tokens].astype(c.compute_dtype) + params["wpe"][pos].astype(c.compute_dtype)
+        use_dropout = rng is not None and c.dropout > 0
+        if use_dropout:
+            rng, k_embd = jax.random.split(rng)
+            x = self._dropout(x, k_embd)
 
         block_fn = self._block
         if c.remat:
@@ -215,11 +241,15 @@ class GPT2Model:
             from ..runtime.activation_checkpointing.checkpointing import checkpoint_wrapper
             block_fn = checkpoint_wrapper(block_fn, policy=c.remat_policy)
         for bp in params["blocks"]:
-            x = block_fn(x, bp)
+            if use_dropout:
+                rng, kb = jax.random.split(rng)
+                x = block_fn(x, bp, kb)
+            else:
+                x = block_fn(x, bp)
         return self._layer_norm(x, params["ln_f"], c.layer_norm_epsilon)
 
-    def logits(self, params, tokens):
-        x = self._backbone(params, tokens)
+    def logits(self, params, tokens, rng=None):
+        x = self._backbone(params, tokens, rng=rng)
         # tied LM head: logits = x @ wte.T
         return jnp.dot(x, params["wte"].T.astype(x.dtype), preferred_element_type=jnp.float32)
 
@@ -244,13 +274,13 @@ class GPT2Model:
         total, _ = jax.lax.scan(jax.checkpoint(body), jnp.zeros((), jnp.float32), (xs, ls))
         return total / (B * T)
 
-    def apply(self, params, tokens, labels=None):
+    def apply(self, params, tokens, labels=None, rng=None):
         """With labels: mean token cross-entropy loss (the training objective).
-        Without: fp32 logits."""
+        Without: fp32 logits. ``rng`` enables stateless dropout when config.dropout > 0."""
         if labels is None:
-            return self.logits(params, tokens)
+            return self.logits(params, tokens, rng=rng)
         c = self.config
-        x = self._backbone(params, tokens)
+        x = self._backbone(params, tokens, rng=rng)
         T = x.shape[1]
         if c.loss_chunk:
             # largest divisor of T not exceeding loss_chunk (static shapes for XLA)

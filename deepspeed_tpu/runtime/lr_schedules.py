@@ -299,3 +299,94 @@ def get_scheduler(name, optimizer, params: dict):
     if name not in _SCHEDULES:
         raise ValueError(f"unknown lr schedule {name!r}; valid: {VALID_LR_SCHEDULES}")
     return _SCHEDULES[name](optimizer, **params)
+
+
+# ---------------------------------------------------------------------------
+# CLI convergence-tuning arguments (reference lr_schedules.py:54-239): schedules can be
+# configured/overridden from the command line in addition to the JSON config.
+# ---------------------------------------------------------------------------
+
+def add_tuning_arguments(parser):
+    group = parser.add_argument_group("Convergence Tuning",
+                                      "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None,
+                       help="LR schedule for training.")
+    # Learning rate range test
+    group.add_argument("--lr_range_test_min_lr", type=float, default=None,
+                       help="Starting lr value.")
+    group.add_argument("--lr_range_test_step_rate", type=float, default=None,
+                       help="scaling rate for LR range test.")
+    group.add_argument("--lr_range_test_step_size", type=int, default=None,
+                       help="training steps per LR change.")
+    group.add_argument("--lr_range_test_staircase", default=None, action="store_true",
+                       help="use staircase scaling for LR range test.")
+    # OneCycle schedule
+    group.add_argument("--cycle_first_step_size", type=int, default=None,
+                       help="size of first step of 1Cycle schedule (training steps).")
+    group.add_argument("--cycle_first_stair_count", type=int, default=None,
+                       help="first stair count for 1Cycle schedule.")
+    group.add_argument("--cycle_second_step_size", type=int, default=None,
+                       help="size of second step of 1Cycle schedule (default first_step_size).")
+    group.add_argument("--cycle_second_stair_count", type=int, default=None,
+                       help="second stair count for 1Cycle schedule.")
+    group.add_argument("--decay_step_size", type=int, default=None,
+                       help="size of intervals for applying post cycle decay (training steps).")
+    group.add_argument("--cycle_min_lr", type=float, default=None,
+                       help="1Cycle LR lower bound.")
+    group.add_argument("--cycle_max_lr", type=float, default=None,
+                       help="1Cycle LR upper bound.")
+    group.add_argument("--decay_lr_rate", type=float, default=None,
+                       help="post cycle LR decay rate.")
+    group.add_argument("--cycle_momentum", default=None, action="store_true",
+                       help="Enable 1Cycle momentum schedule.")
+    group.add_argument("--cycle_min_mom", type=float, default=None,
+                       help="1Cycle momentum lower bound.")
+    group.add_argument("--cycle_max_mom", type=float, default=None,
+                       help="1Cycle momentum upper bound.")
+    group.add_argument("--decay_mom_rate", type=float, default=None,
+                       help="post cycle momentum decay rate.")
+    # Warmup LR
+    group.add_argument("--warmup_min_lr", type=float, default=None,
+                       help="WarmupLR minimum/initial LR value")
+    group.add_argument("--warmup_max_lr", type=float, default=None,
+                       help="WarmupLR maximum LR value.")
+    group.add_argument("--warmup_num_steps", type=int, default=None,
+                       help="WarmupLR step count for LR warmup.")
+    return parser
+
+
+def parse_arguments():
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser = add_tuning_arguments(parser)
+    lr_sched_args, unknown_args = parser.parse_known_args()
+    return lr_sched_args, unknown_args
+
+
+def _override_from(args, params, keys):
+    for key in keys:
+        if getattr(args, key, None) is not None:
+            params[key] = getattr(args, key)
+
+
+def override_lr_range_test_params(args, params):
+    _override_from(args, params, (LR_RANGE_TEST_MIN_LR, LR_RANGE_TEST_STEP_RATE,
+                                  LR_RANGE_TEST_STEP_SIZE, LR_RANGE_TEST_STAIRCASE))
+
+
+def override_1cycle_params(args, params):
+    _override_from(args, params, (CYCLE_FIRST_STEP_SIZE, CYCLE_FIRST_STAIR_COUNT,
+                                  CYCLE_SECOND_STEP_SIZE, CYCLE_SECOND_STAIR_COUNT,
+                                  DECAY_STEP_SIZE, CYCLE_MIN_LR, CYCLE_MAX_LR,
+                                  DECAY_LR_RATE, CYCLE_MIN_MOM, CYCLE_MAX_MOM,
+                                  DECAY_MOM_RATE))
+
+
+def override_warmupLR_params(args, params):
+    _override_from(args, params, (WARMUP_MIN_LR, WARMUP_MAX_LR, WARMUP_NUM_STEPS))
+
+
+def override_params(args, params):
+    override_lr_range_test_params(args, params)
+    override_1cycle_params(args, params)
+    override_warmupLR_params(args, params)
