@@ -1,0 +1,127 @@
+"""Small ResNet for CIFAR-class vision workloads, TPU-first.
+
+The reference's canonical beginner workload is DeepSpeedExamples/cifar (a small CNN
+driven through ``deepspeed.initialize`` — BASELINE.json lists it as a target config).
+This is the in-tree equivalent: a pure-function CIFAR ResNet (conv stem → N residual
+stages → global-pool → linear) built on ``lax.conv_general_dilated`` with NHWC layout
+(TPU-native) and GroupNorm (batch-statistics-free, so train/eval and per-shard
+data-parallel behavior match without cross-device BN syncs).
+
+``apply(params, images, labels)`` -> mean cross-entropy; ``logits(params, images)``.
+"""
+
+import math
+from dataclasses import dataclass
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class ResNetConfig:
+    num_classes: int = 10
+    width: int = 32                      # stem channels
+    stage_sizes: Tuple[int, ...] = (2, 2, 2)   # residual blocks per stage (ResNet-14ish)
+    groups: int = 8                      # GroupNorm groups
+    compute_dtype: Any = jnp.float32
+
+
+def _conv_init(rng, shape):
+    # He/Kaiming fan-in init for [kh, kw, cin, cout]
+    fan_in = shape[0] * shape[1] * shape[2]
+    return jax.random.normal(rng, shape, jnp.float32) * math.sqrt(2.0 / fan_in)
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+class ResNet:
+    """Functional ResNet: ``init(rng) -> params``, ``apply(params, images[, labels])``."""
+
+    def __init__(self, config: ResNetConfig = None):
+        self.config = config or ResNetConfig()
+
+    # ------------------------------------------------------------------ init
+    def init(self, rng):
+        c = self.config
+        n_blocks = sum(c.stage_sizes)
+        keys = iter(jax.random.split(rng, 3 + 3 * n_blocks))
+        params = {"stem": {"w": _conv_init(next(keys), (3, 3, 3, c.width)),
+                           "gn": self._gn_init(c.width)},
+                  "stages": [], }
+        cin = c.width
+        for si, blocks in enumerate(c.stage_sizes):
+            cout = c.width * (2 ** si)
+            stage = []
+            for bi in range(blocks):
+                block = {
+                    "conv1": {"w": _conv_init(next(keys), (3, 3, cin, cout)),
+                              "gn": self._gn_init(cout)},
+                    "conv2": {"w": _conv_init(next(keys), (3, 3, cout, cout)),
+                              "gn": self._gn_init(cout)},
+                }
+                if cin != cout:
+                    block["proj"] = {"w": _conv_init(next(keys), (1, 1, cin, cout))}
+                stage.append(block)
+                cin = cout
+            params["stages"].append(stage)
+        params["head"] = {"w": jax.random.normal(next(keys), (cin, c.num_classes),
+                                                 jnp.float32) * 0.01,
+                          "b": jnp.zeros((c.num_classes,), jnp.float32)}
+        return params
+
+    @staticmethod
+    def _gn_init(ch):
+        return {"scale": jnp.ones((ch,), jnp.float32), "bias": jnp.zeros((ch,), jnp.float32)}
+
+    # ------------------------------------------------------------------ layers
+    def _group_norm(self, x, p):
+        c = self.config
+        B, H, W, C = x.shape
+        g = min(c.groups, C)
+        xf = x.astype(jnp.float32).reshape(B, H, W, g, C // g)
+        mean = jnp.mean(xf, axis=(1, 2, 4), keepdims=True)
+        var = jnp.var(xf, axis=(1, 2, 4), keepdims=True)
+        xf = (xf - mean) * jax.lax.rsqrt(var + 1e-5)
+        xf = xf.reshape(B, H, W, C) * p["scale"] + p["bias"]
+        return xf.astype(x.dtype)
+
+    def _block(self, x, p, stride):
+        y = _conv(x, p["conv1"]["w"], stride)
+        y = jax.nn.relu(self._group_norm(y, p["conv1"]["gn"]))
+        y = _conv(y, p["conv2"]["w"])
+        y = self._group_norm(y, p["conv2"]["gn"])
+        # stride=2 only occurs at a stage boundary, where channels also change, so the
+        # projection conv always carries the downsample
+        shortcut = _conv(x, p["proj"]["w"], stride) if "proj" in p else x
+        return jax.nn.relu(y + shortcut)
+
+    # ------------------------------------------------------------------ apply
+    def logits(self, params, images):
+        c = self.config
+        x = images.astype(c.compute_dtype)
+        x = jax.nn.relu(self._group_norm(_conv(x, params["stem"]["w"]), params["stem"]["gn"]))
+        for si, stage in enumerate(params["stages"]):
+            for bi, block in enumerate(stage):
+                stride = 2 if (si > 0 and bi == 0) else 1
+                x = self._block(x, block, stride)
+        x = jnp.mean(x, axis=(1, 2))                      # global average pool
+        head = params["head"]
+        return jnp.dot(x, head["w"].astype(x.dtype),
+                       preferred_element_type=jnp.float32) + head["b"]
+
+    def apply(self, params, images, labels=None):
+        logits = self.logits(params, images)
+        if labels is None:
+            return logits
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0])
+
+    def param_count(self, params) -> int:
+        from ..runtime.utils import param_count
+        return param_count(params)

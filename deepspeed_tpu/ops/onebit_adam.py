@@ -74,6 +74,20 @@ class OneBitAdam:
         self.freeze_step = int(freeze_step)
         self.dp_size = int(dp_size)
         self.mesh = mesh
+        self._seg_ids = None   # per-leaf scale segments (built lazily from the param tree)
+
+    def _segment_ids(self, master_params, n_pad: int):
+        """Element -> parameter-leaf segment map: the reference compresses each tensor
+        with its own scale (per-param state); the padded tail gets its own segment so
+        its zeros never perturb a real tensor's RMS."""
+        if self._seg_ids is None or self._seg_ids.shape[0] != n_pad:
+            sizes = [int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(master_params)]
+            ids = np.repeat(np.arange(len(sizes), dtype=np.int32), sizes)
+            if n_pad > ids.shape[0]:
+                ids = np.concatenate([ids, np.full(n_pad - ids.shape[0], len(sizes),
+                                                   np.int32)])
+            self._seg_ids = ids
+        return self._seg_ids
 
     # ---------------------------------------------------------------- state
     def init(self, master_params) -> OneBitAdamState:
@@ -120,11 +134,15 @@ class OneBitAdam:
             new_v = beta2 * v + (1.0 - beta2) * jnp.square(g_mean)
             return new_m, new_v, we, se
 
+        seg_ids = self._segment_ids(master_params, n_pad)
+
         def frozen_branch(operand):
             m, v, g_stacked, we, se = operand
-            # Worker-local momentum update (onebit_adam.py:335-336), then 1-bit averaging.
+            # Worker-local momentum update (onebit_adam.py:335-336), then 1-bit averaging
+            # with per-tensor scales (reference compresses each param separately).
             m_local = beta1 * m[None, :] + (1.0 - beta1) * g_stacked
-            new_m, new_we, new_se = compressed_allreduce(self.mesh, m_local, we, se)
+            new_m, new_we, new_se = compressed_allreduce(self.mesh, m_local, we, se,
+                                                         seg_ids=seg_ids)
             return new_m, v, new_we, new_se
 
         m, v, we, se = jax.lax.cond(

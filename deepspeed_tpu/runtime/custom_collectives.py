@@ -26,6 +26,7 @@ shard_map body needs it.
 from functools import partial
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
@@ -33,19 +34,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..parallel.mesh import DATA_AXIS
 
 
-def _sign_compress(buf):
-    """RMS-scaled sign compression: returns (int8 signs, fp32 scale, residual error).
-
-    Matches the reference's ``worker_scale = norm(buf)/sqrt(numel)`` and sign(0) -> +1
-    convention (onebit_adam.py:124-130: sign().add_(1).bool() maps {0,+1} to +1).
-    """
-    scale = jnp.sqrt(jnp.mean(jnp.square(buf)))
-    signs = jnp.where(buf >= 0, 1, -1).astype(jnp.int8)
-    error = buf - scale * signs.astype(jnp.float32)
-    return signs, scale, error
-
-
-def compressed_allreduce(mesh: Mesh, x, worker_error, server_error, axis_name: str = DATA_AXIS):
+def compressed_allreduce(mesh: Mesh, x, worker_error, server_error,
+                         axis_name: str = DATA_AXIS, seg_ids=None):
     """Average per-worker buffers ``x`` across the ``data`` axis with 1-bit compression.
 
     Args:
@@ -54,6 +44,14 @@ def compressed_allreduce(mesh: Mesh, x, worker_error, server_error, axis_name: s
       worker_error: (dp, n) fp32 persistent worker error feedback, sharded ``P(data, None)``.
       server_error: (dp, n // dp) fp32 persistent server error feedback, same sharding.
         ``n`` must be divisible by dp.
+      seg_ids: optional STATIC (n,) int array mapping each element to a scale segment.
+        The reference compresses per parameter TENSOR — each tensor gets its own RMS
+        scale (onebit_adam.py keeps per-param state). A single global scale over the
+        fused buffer overscales small-momentum tensors (LN scales, biases) to the
+        buffer-wide RMS, and the error feedback then oscillates unboundedly — measured
+        as training divergence a few steps after freeze_step. Segment scales restore the
+        reference's per-tensor semantics at the cost of shipping an extra (n_segs,) fp32
+        vector per phase. None = one segment (a single scale).
 
     Returns:
       (out, new_worker_error, new_server_error): ``out`` is the (n,) compressed average,
@@ -63,26 +61,49 @@ def compressed_allreduce(mesh: Mesh, x, worker_error, server_error, axis_name: s
     n = x.shape[-1]
     assert n % dp == 0, f"buffer size {n} must be divisible by dp={dp} (pad first)"
     chunk = n // dp
+    seg_np = (np.zeros((n,), np.int32) if seg_ids is None
+              else np.asarray(seg_ids, np.int32))
+    assert seg_np.shape == (n,), f"seg_ids must be ({n},), got {seg_np.shape}"
+    n_segs = int(seg_np.max()) + 1
+    seg_const = jnp.asarray(seg_np)
+    seg_counts = jnp.asarray(np.maximum(np.bincount(seg_np, minlength=n_segs), 1)
+                             .astype(np.float32))
+
+    def _seg_rms(buf, ids, counts):
+        ss = jax.ops.segment_sum(jnp.square(buf), ids, num_segments=n_segs)
+        return jnp.sqrt(ss / counts)
 
     def body(x_row, we_row, se_row):
         # Per-device shapes: x_row/we_row (1, n); se_row (1, chunk).
         corrected = x_row[0] + we_row[0]
-        signs, wscale, new_we = _sign_compress(corrected)
+        wscale = _seg_rms(corrected, seg_const, seg_counts)          # (n_segs,)
+        signs = jnp.where(corrected >= 0, 1, -1).astype(jnp.int8)
+        new_we = corrected - wscale[seg_const] * signs.astype(jnp.float32)
 
         # Phase 1: chunk j of my signs -> server j (int8 on the wire).
         send = signs.reshape(dp, chunk)
         recv = jax.lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0, tiled=False)
         recv = recv.reshape(dp, chunk)
-        wscales = jax.lax.all_gather(wscale, axis_name)  # (dp,)
+        wscales = jax.lax.all_gather(wscale, axis_name)              # (dp, n_segs)
 
-        server_m = jnp.mean(recv.astype(jnp.float32) * wscales[:, None], axis=0)  # (chunk,)
+        my = jax.lax.axis_index(axis_name)
+        seg_chunk = jax.lax.dynamic_slice(seg_const, (my * chunk,), (chunk,))
+        per_elem_wscale = jnp.take_along_axis(wscales, seg_chunk[None, :]
+                                              .repeat(dp, 0), axis=1)  # (dp, chunk)
+        server_m = jnp.mean(recv.astype(jnp.float32) * per_elem_wscale, axis=0)
         corrected_s = server_m + se_row[0]
-        s_signs, sscale, new_se = _sign_compress(corrected_s)
+        chunk_counts = jnp.maximum(jax.ops.segment_sum(jnp.ones((chunk,), jnp.float32),
+                                                       seg_chunk, num_segments=n_segs), 1.0)
+        sscale = _seg_rms(corrected_s, seg_chunk, chunk_counts)      # (n_segs,)
+        s_signs = jnp.where(corrected_s >= 0, 1, -1).astype(jnp.int8)
+        new_se = corrected_s - sscale[seg_chunk] * s_signs.astype(jnp.float32)
 
         # Phase 2: allgather the compressed server chunks.
-        all_signs = jax.lax.all_gather(s_signs, axis_name)  # (dp, chunk) int8
-        sscales = jax.lax.all_gather(sscale, axis_name)     # (dp,)
-        out = (all_signs.astype(jnp.float32) * sscales[:, None]).reshape(n)
+        all_signs = jax.lax.all_gather(s_signs, axis_name)           # (dp, chunk) int8
+        sscales = jax.lax.all_gather(sscale, axis_name)              # (dp, n_segs)
+        seg_by_chunk = seg_const.reshape(dp, chunk)
+        per_elem_sscale = jnp.take_along_axis(sscales, seg_by_chunk, axis=1)
+        out = (all_signs.astype(jnp.float32) * per_elem_sscale).reshape(n)
         return out, new_we[None], new_se[None]
 
     fn = shard_map(body, mesh=mesh,

@@ -31,6 +31,7 @@ DECAY_STEP_SIZE = "decay_step_size"
 CYCLE_MIN_LR = "cycle_min_lr"
 CYCLE_MAX_LR = "cycle_max_lr"
 DECAY_LR_RATE = "decay_lr_rate"
+CYCLE_MOMENTUM = "cycle_momentum"
 CYCLE_MIN_MOM = "cycle_min_mom"
 CYCLE_MAX_MOM = "cycle_max_mom"
 DECAY_MOM_RATE = "decay_mom_rate"
@@ -378,7 +379,7 @@ def override_1cycle_params(args, params):
     _override_from(args, params, (CYCLE_FIRST_STEP_SIZE, CYCLE_FIRST_STAIR_COUNT,
                                   CYCLE_SECOND_STEP_SIZE, CYCLE_SECOND_STAIR_COUNT,
                                   DECAY_STEP_SIZE, CYCLE_MIN_LR, CYCLE_MAX_LR,
-                                  DECAY_LR_RATE, CYCLE_MIN_MOM, CYCLE_MAX_MOM,
+                                  DECAY_LR_RATE, CYCLE_MOMENTUM, CYCLE_MIN_MOM, CYCLE_MAX_MOM,
                                   DECAY_MOM_RATE))
 
 

@@ -1,0 +1,49 @@
+"""CIFAR ResNet workload through the engine (the reference's DeepSpeedExamples/cifar
+config, BASELINE.json) — proves the engine is model-agnostic beyond transformers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.resnet import ResNet, ResNetConfig
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.parallel.mesh import build_mesh
+
+
+def _data(batch=8, classes=10, seed=0):
+    """Learnable synthetic CIFAR: class k images have channel means biased by k."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, classes, (batch,)).astype(np.int32)
+    images = rng.normal(size=(batch, 16, 16, 3)).astype(np.float32) * 0.3
+    images += (labels[:, None, None, None] / classes - 0.5) * 2.0
+    return images, labels
+
+
+@pytest.mark.parametrize("zero_stage", [0, 2])
+def test_cifar_resnet_trains(zero_stage, eight_devices):
+    model = ResNet(ResNetConfig(width=8, stage_sizes=(1, 1), groups=4))
+    params = model.init(jax.random.PRNGKey(0))
+    engine = DeepSpeedEngine(
+        model=model, model_parameters=params,
+        mesh=build_mesh(data=8, model=1, pipe=1),
+        config_params={"train_batch_size": 8, "steps_per_print": 100,
+                       "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+                       "zero_optimization": {"stage": zero_stage}})
+    images, labels = _data()
+    losses = []
+    for _ in range(6):
+        loss = engine(images, labels)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], f"CIFAR loss did not decrease: {losses}"
+
+
+def test_resnet_logits_shape_and_downsampling():
+    model = ResNet(ResNetConfig(width=8, stage_sizes=(1, 1, 1), groups=4))
+    params = model.init(jax.random.PRNGKey(1))
+    logits = model.logits(params, jnp.zeros((2, 32, 32, 3)))
+    assert logits.shape == (2, 10)
+    assert model.param_count(params) > 0
