@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""GPT-2 throughput comparison runner (reference ``run_perf_baseline.py`` /
+``run_perf_test.py``): measures samples/sec for each ``ds_config_perf_*.json``, records a
+baseline JSON, and on later runs compares against it.
+
+Not collected by pytest (perf numbers are machine-dependent); run manually:
+
+    python tests/model/run_perf_test.py --baseline        # record tests/model/perf_baseline.json
+    python tests/model/run_perf_test.py                   # compare vs the recorded baseline
+
+On the TPU host this exercises the real chip; elsewhere it measures the virtual CPU
+mesh (useful only for regression-shaped comparisons, not absolute numbers).
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+THIS_DIR = os.path.dirname(os.path.abspath(__file__))
+BASELINE_PATH = os.path.join(THIS_DIR, "perf_baseline.json")
+STEPS = 12
+TOLERANCE = 0.10   # fail if >10% slower than baseline (reference compares the same way)
+
+
+def measure(config_path):
+    cmd = [sys.executable, os.path.join(THIS_DIR, "gpt2_pretrain.py"), "--deepspeed",
+           "--deepspeed_config", config_path, "--steps", str(STEPS)]
+    t0 = time.time()
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    wall = time.time() - t0
+    with open(config_path) as f:
+        batch = json.load(f)["train_batch_size"]
+    # crude but stable: amortized samples/sec including compile (reference parses
+    # Megatron's per-iteration logs; our driver prints per-step lines without timings)
+    return batch * STEPS / wall
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--baseline", action="store_true",
+                    help="record results as the new baseline instead of comparing")
+    args = ap.parse_args()
+
+    results = {}
+    for cfg in sorted(glob.glob(os.path.join(THIS_DIR, "ds_config_perf_*.json"))):
+        name = os.path.basename(cfg)
+        results[name] = round(measure(cfg), 2)
+        print(f"{name}: {results[name]} samples/sec")
+
+    if args.baseline or not os.path.exists(BASELINE_PATH):
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(results, f, indent=2)
+        print(f"baseline written to {BASELINE_PATH}")
+        return 0
+
+    with open(BASELINE_PATH) as f:
+        baseline = json.load(f)
+    rc = 0
+    for name, sps in results.items():
+        base = baseline.get(name)
+        if base is None:
+            continue
+        ratio = sps / base
+        status = "OK" if ratio >= 1.0 - TOLERANCE else "REGRESSION"
+        if status == "REGRESSION":
+            rc = 1
+        print(f"{name}: {sps} vs baseline {base} ({ratio:.2%}) {status}")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
