@@ -71,7 +71,8 @@ def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
     row = h * nb + i
     bq = q_ref.shape[0]
     d = q_ref.shape[1]
-    q = q_ref[...].astype(jnp.float32) * sm_scale
+    # bf16-in/fp32-accumulate is the MXU's native mode (see flash_attention._fwd_kernel)
+    q = q_ref[...]
 
     n_active = counts_ref[row]
 
@@ -103,9 +104,9 @@ def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
         wait_dma(j, slot)
         kb = cols_ref[row, j]
         # buffers hold K/V blocks TRANSPOSED [D, block] (lane dim = block, 128-aligned)
-        kt_blk = kbuf[slot].astype(jnp.float32)
-        vt_blk = vbuf[slot].astype(jnp.float32)
-        s = jnp.dot(q, kt_blk, preferred_element_type=jnp.float32)  # [bq, block]
+        kt_blk = kbuf[slot]
+        vt_blk = vbuf[slot]
+        s = jnp.dot(q, kt_blk, preferred_element_type=jnp.float32) * sm_scale  # [bq, block]
         if causal:
             q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block), 0)
             k_pos = kb * block + jax.lax.broadcasted_iota(jnp.int32, (bq, block), 1)
@@ -115,7 +116,8 @@ def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         # p @ v with v stored [D, block]: contract p's block dim with vt's block dim
-        pv = jax.lax.dot_general(p, vt_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+        pv = jax.lax.dot_general(p.astype(vt_blk.dtype), vt_blk,
+                                 dimension_numbers=(((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         acc_new = acc * alpha + pv
         return m_new, l_new, acc_new
@@ -133,16 +135,16 @@ def _bs_dq_kernel(counts_ref, cols_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, de
     h = b % num_heads
     row = h * nb + i
     bq, d = q_ref.shape
-    q = q_ref[...].astype(jnp.float32) * sm_scale
-    do = do_ref[...].astype(jnp.float32)
+    q = q_ref[...]
+    do = do_ref[...]
     lse = lse_ref[...].reshape(bq, 1)
     delta = delta_ref[...].reshape(bq, 1)
 
     def body(j, dq):
         kb = cols_ref[row, j]
-        k_blk = k_ref[pl.ds(kb * block, block), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(kb * block, block), :].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        k_blk = k_ref[pl.ds(kb * block, block), :]
+        v_blk = v_ref[pl.ds(kb * block, block), :]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
             q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block), 0)
             k_pos = kb * block + jax.lax.broadcasted_iota(jnp.int32, (bq, block), 1)
@@ -150,7 +152,7 @@ def _bs_dq_kernel(counts_ref, cols_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, de
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+        return dq + jnp.dot(ds.astype(k_blk.dtype), k_blk, preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, counts_ref[row], body, jnp.zeros((bq, d), jnp.float32))
     dq_ref[...] = (dq * sm_scale).astype(dq_ref.dtype)
@@ -163,31 +165,33 @@ def _bs_dkv_kernel(counts_t_ref, rows_t_ref, q_ref, k_ref, v_ref, do_ref, lse_re
     h = b % num_heads
     col = h * nb + i
     bk, d = k_ref.shape
-    k = k_ref[...].astype(jnp.float32)
-    v = v_ref[...].astype(jnp.float32)
+    k = k_ref[...]
+    v = v_ref[...]
 
     def body(j, carry):
         dk, dv = carry
         qb = rows_t_ref[col, j]
-        q_blk = q_ref[pl.ds(qb * block, block), :].astype(jnp.float32) * sm_scale
-        do_blk = do_ref[pl.ds(qb * block, block), :].astype(jnp.float32)
+        q_blk = q_ref[pl.ds(qb * block, block), :]
+        do_blk = do_ref[pl.ds(qb * block, block), :]
         lse_blk = lse_ref[0, pl.ds(qb * block, block)].reshape(block, 1)
         delta_blk = delta_ref[0, pl.ds(qb * block, block)].reshape(block, 1)
-        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32)
+        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
             q_pos = qb * block + jax.lax.broadcasted_iota(jnp.int32, (block, bk), 0)
             k_pos = i * bk + jax.lax.broadcasted_iota(jnp.int32, (block, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse_blk)
-        dv_new = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
+        dv_new = dv + jnp.dot(p.T.astype(do_blk.dtype), do_blk,
+                              preferred_element_type=jnp.float32)
         dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta_blk)
-        dk_new = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
+        dk_new = dk + jnp.dot(ds.T.astype(q_blk.dtype), q_blk,
+                              preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
     dk, dv = jax.lax.fori_loop(0, counts_t_ref[col], body,
                                (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
-    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dk_ref[...] = (dk * sm_scale).astype(dk_ref.dtype)
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
@@ -225,8 +229,8 @@ def _bs_fwd(q, k, v, counts, cols, sm_scale, causal, block, interpret):
             grid=(B * H, nb),
             in_specs=[
                 pl.BlockSpec((None, block, D), lambda b, i, c0, c1: (b, i, 0)),
-                pl.BlockSpec(memory_space=pltpu.ANY),  # K stays in HBM
-                pl.BlockSpec(memory_space=pltpu.ANY),  # V stays in HBM
+                pl.BlockSpec(memory_space=pl.ANY),  # K stays in HBM
+                pl.BlockSpec(memory_space=pl.ANY),  # V stays in HBM
             ],
             out_specs=[
                 pl.BlockSpec((None, block, D), lambda b, i, c0, c1: (b, i, 0)),

@@ -3,20 +3,21 @@
 ``run_perf_test.py``): measures samples/sec for each ``ds_config_perf_*.json``, records a
 baseline JSON, and on later runs compares against it.
 
-Not collected by pytest (perf numbers are machine-dependent); run manually:
+Not collected by pytest (ignored via tests/model/conftest.py — perf numbers are
+machine-dependent); run manually:
 
     python tests/model/run_perf_test.py --baseline        # record tests/model/perf_baseline.json
     python tests/model/run_perf_test.py                   # compare vs the recorded baseline
 
-On the TPU host this exercises the real chip; elsewhere it measures the virtual CPU
-mesh (useful only for regression-shaped comparisons, not absolute numbers).
+By default the workload driver pins the 8-virtual-device CPU platform, so numbers are
+regression-shaped only; export JAX_PLATFORMS=tpu to measure the real chip (the driver's
+setdefault honors it).
 """
 
 import argparse
 import glob
 import json
 import os
-import re
 import subprocess
 import sys
 import time
