@@ -1,0 +1,36 @@
+"""Timer tests (reference utils/timer.py: SynchronizedWallClockTimer l.20,
+ThroughputTimer l.100)."""
+
+import time
+
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+
+
+def test_wallclock_timer_accumulates_and_resets():
+    timers = SynchronizedWallClockTimer(sync_fn=lambda: None)
+    t = timers("fwd")
+    t.start(); time.sleep(0.02); t.stop()
+    e1 = t.elapsed(reset=False)
+    assert e1 >= 0.015
+    t.start(); time.sleep(0.02); t.stop()
+    assert t.elapsed(reset=False) > e1, "stop() must accumulate across windows"
+    t.reset()
+    assert t.elapsed(reset=False) == 0.0
+    # same name returns the same timer object
+    assert timers("fwd") is t
+
+
+def test_wallclock_timer_log_runs(caplog):
+    timers = SynchronizedWallClockTimer(sync_fn=lambda: None)
+    timers("a").start(); timers("a").stop()
+    timers("b").start(); timers("b").stop()
+    timers.log(["a", "b"])          # must not raise; resets by default
+    assert timers("a").elapsed(reset=False) == 0.0
+
+
+def test_throughput_timer_reports_samples_per_sec():
+    tt = ThroughputTimer(batch_size=8, num_workers=1, start_step=1, steps_per_output=None)
+    for _ in range(4):
+        tt.start(); time.sleep(0.005); tt.stop(report_speed=False)
+    sps = tt.avg_samples_per_sec()
+    assert 0 < sps < 8 / 0.005 * 2, sps
