@@ -122,3 +122,36 @@ def test_engine_zero_offload_checkpoint_roundtrip(tmp_path):
     l1 = _train(e1, steps=1)[0]
     l2 = _train(e2, steps=1)[0]
     assert abs(l1 - l2) < 1e-5
+
+
+def test_engine_zero_offload_fp16_overflow_skips_step():
+    """Inf/NaN grads on the host tier must skip the master update and back off the loss
+    scale (reference: CheckOverflow before DeepSpeedCPUAdam.step), not poison fp32."""
+    model = SimpleModel(hidden_dim=16)
+    params = model.init(jax.random.PRNGKey(0))
+    cfg = simple_config(batch=8)
+    cfg["zero_optimization"] = {"stage": 2, "cpu_offload": True}
+    cfg["fp16"] = {"enabled": True, "loss_scale": 0, "initial_scale_power": 4,
+                   "hysteresis": 1}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                               config_params=cfg)
+    master_before = np.array(engine._offload.fp32, copy=True)
+    s0 = float(engine.loss_scale())
+
+    # SimpleModel computes in the input dtype, so fp32 math stays finite; the overflow
+    # comes from the fp16 PARAM leaves: the huge target makes cotangents ~1e19, which
+    # overflow when the grads are produced for the engine's fp16-stored params.
+    x = np.ones((8, 16), np.float32)
+    y = np.full((8, 16), 1e20, np.float32)
+    loss = engine(x, y)
+    engine.backward(loss)
+    engine.step()
+
+    assert engine.skipped_steps == 1
+    np.testing.assert_array_equal(engine._offload.fp32, master_before)
+    assert np.all(np.isfinite(engine._offload.fp32))
+    assert float(engine.loss_scale()) == s0 / 2, (s0, float(engine.loss_scale()))
+
+    # and a sane batch afterwards still trains
+    losses = _train(engine, steps=3)
+    assert np.isfinite(losses).all()
