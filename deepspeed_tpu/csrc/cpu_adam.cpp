@@ -20,7 +20,8 @@ extern "C" {
 
 // One Adam/AdamW step over a flat fp32 buffer. All state updated in place.
 //   adamw != 0    -> decoupled weight decay: p -= lr * (m_hat/denom + wd * p)
-//   adamw == 0    -> L2-style decay matching ops/adam.py: p -= lr*update + lr*wd*p
+//   adamw == 0    -> classic L2 Adam (torch.optim.Adam): wd*p is folded into the
+//                    gradient BEFORE the moment updates, no separate decay term
 //   bias_correction != 0 -> m_hat = m/(1-b1^t), v_hat = v/(1-b2^t)
 void ds_adam_step(float* __restrict__ p,
                   const float* __restrict__ g,
@@ -44,22 +45,21 @@ void ds_adam_step(float* __restrict__ p,
   const float inv_sqrt_bc2 = 1.0f / sqrtf(bc2);
   const float omb1 = 1.0f - beta1;
   const float omb2 = 1.0f - beta2;
-  const float wd_factor = lr * weight_decay;
+  // branchless mode select keeps the loop auto-vectorizable
+  const float l2_factor = adamw ? 0.0f : weight_decay;        // into the gradient
+  const float wd_factor = adamw ? lr * weight_decay : 0.0f;   // decoupled decay
 
 #pragma omp parallel for simd schedule(static)
   for (int64_t i = 0; i < n; ++i) {
-    const float grad = g[i];
+    const float grad = g[i] + l2_factor * p[i];
     const float mi = beta1 * m[i] + omb1 * grad;
     const float vi = beta2 * v[i] + omb2 * grad * grad;
     m[i] = mi;
     v[i] = vi;
     const float denom = sqrtf(vi) * inv_sqrt_bc2 + eps;
     const float update = (mi * inv_bc1) / denom;
-    // both decay modes reduce to the same fused form: p -= lr*update + lr*wd*p
-    // (matches ops/adam.py:52-57, where the reference FusedAdam also decays p directly)
     p[i] = p[i] - lr * update - wd_factor * p[i];
   }
-  (void)adamw;  // both modes share the fused decay form above
 }
 
 static inline uint16_t fp32_to_bf16_rne(float x) {
@@ -98,11 +98,12 @@ void ds_adam_step_copy(float* __restrict__ p,
   const float inv_sqrt_bc2 = 1.0f / sqrtf(bc2);
   const float omb1 = 1.0f - beta1;
   const float omb2 = 1.0f - beta2;
-  const float wd_factor = lr * weight_decay;
+  const float l2_factor = adamw ? 0.0f : weight_decay;
+  const float wd_factor = adamw ? lr * weight_decay : 0.0f;
 
 #pragma omp parallel for simd schedule(static)
   for (int64_t i = 0; i < n; ++i) {
-    const float grad = g[i];
+    const float grad = g[i] + l2_factor * p[i];
     const float mi = beta1 * m[i] + omb1 * grad;
     const float vi = beta2 * v[i] + omb2 * grad * grad;
     m[i] = mi;
@@ -113,7 +114,6 @@ void ds_adam_step_copy(float* __restrict__ p,
     p[i] = pi;
     out_bf16[i] = fp32_to_bf16_rne(pi);
   }
-  (void)adamw;
 }
 
 }  // extern "C"

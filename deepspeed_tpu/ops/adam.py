@@ -44,6 +44,10 @@ def apply(grads, state: AdamState, master_params, step, hyper, adamw: bool = Tru
 
     def leaf(g, m, v, p):
         g = g.astype(jnp.float32)
+        if not adamw:
+            # classic L2 Adam (torch.optim.Adam / reference apex FusedAdam): the decay
+            # term enters the gradient BEFORE the moment updates
+            g = g + wd * p
         m = b1 * m + (1.0 - b1) * g
         v = b2 * v + (1.0 - b2) * jnp.square(g)
         m_hat = m / bc1
@@ -52,9 +56,7 @@ def apply(grads, state: AdamState, master_params, step, hyper, adamw: bool = Tru
         if adamw:
             new_p = p - lr * (update + wd * p)
         else:
-            # L2-style: wd folded into the gradient before moments would differ; the
-            # reference FusedAdam applies decoupled decay too, so both paths decay p.
-            new_p = p - lr * update - lr * wd * p
+            new_p = p - lr * update
         return new_p, m, v
 
     flat_g, treedef = jax.tree_util.tree_flatten(grads)

@@ -130,12 +130,18 @@ class DeepSpeedCPUAdam:
         bc1 = 1.0 - beta1 ** step if self.bias_correction else 1.0
         bc2 = 1.0 - beta2 ** step if self.bias_correction else 1.0
         m, v, p = self.exp_avg, self.exp_avg_sq, self.fp32
+        if not self.adamw:
+            # classic L2 Adam: decay enters the gradient before the moments
+            g = g + weight_decay * p
         np.multiply(m, beta1, out=m)
         m += (1.0 - beta1) * g
         np.multiply(v, beta2, out=v)
         v += (1.0 - beta2) * np.square(g)
         update = (m / bc1) / (np.sqrt(v / bc2) + eps)
-        p -= lr * update + lr * weight_decay * p
+        if self.adamw:
+            p -= lr * update + lr * weight_decay * p
+        else:
+            p -= lr * update
 
     # ------------------------------------------------------------- checkpoint plumbing
     def load_flat(self, fp32: Optional[np.ndarray] = None, exp_avg: Optional[np.ndarray] = None,

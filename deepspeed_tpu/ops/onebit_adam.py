@@ -75,18 +75,24 @@ class OneBitAdam:
         self.dp_size = int(dp_size)
         self.mesh = mesh
         self._seg_ids = None   # per-leaf scale segments (built lazily from the param tree)
+        self._seg_key = None   # (treedef, leaf shapes, n_pad) the cached map was built for
 
     def _segment_ids(self, master_params, n_pad: int):
         """Element -> parameter-leaf segment map: the reference compresses each tensor
         with its own scale (per-param state); the padded tail gets its own segment so
-        its zeros never perturb a real tensor's RMS."""
-        if self._seg_ids is None or self._seg_ids.shape[0] != n_pad:
-            sizes = [int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(master_params)]
+        its zeros never perturb a real tensor's RMS. Cached keyed on the tree structure
+        and leaf shapes (not just n_pad): a differently-structured tree that happens to
+        pad to the same length must not reuse a stale map."""
+        leaves, treedef = jax.tree_util.tree_flatten(master_params)
+        key = (treedef, tuple(l.shape for l in leaves), n_pad)
+        if self._seg_ids is None or self._seg_key != key:
+            sizes = [int(np.prod(s)) for s in key[1]]
             ids = np.repeat(np.arange(len(sizes), dtype=np.int32), sizes)
             if n_pad > ids.shape[0]:
                 ids = np.concatenate([ids, np.full(n_pad - ids.shape[0], len(sizes),
                                                    np.int32)])
             self._seg_ids = ids
+            self._seg_key = key
         return self._seg_ids
 
     # ---------------------------------------------------------------- state

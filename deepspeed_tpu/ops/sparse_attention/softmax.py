@@ -50,25 +50,35 @@ class Softmax:
         x = x.astype(jnp.float32) * scale
 
         if rpe is not None:
-            # [H, T, T] (or [1, T, T]) relative position bias, gathered blockwise
+            # [H, T, T] / [1, T, T] relative position bias, or [B, H, T, T] for a
+            # per-batch bias (the reference kernel strides RPE by batch: pidz *
+            # stride_zrpe in softmax_fwd.tr), gathered blockwise either way
             rpe = jnp.asarray(rpe, jnp.float32)
             H = self.layout.shape[0]
-            if rpe.shape[0] == 1 and H > 1:
-                rpe = jnp.broadcast_to(rpe, (H,) + rpe.shape[1:])
             T = rpe.shape[-1]
-            rpe_blocks = rpe.reshape(H, T // blk, blk, T // blk, blk).transpose(0, 1, 3, 2, 4)
-            x = x + rpe_blocks[self.lut_h, self.lut_i, self.lut_j][None]
+            if rpe.ndim == 4:
+                if rpe.shape[1] == 1 and H > 1:
+                    rpe = jnp.broadcast_to(rpe, (rpe.shape[0], H) + rpe.shape[2:])
+                rpe_blocks = (rpe.reshape(rpe.shape[0], H, T // blk, blk, T // blk, blk)
+                              .transpose(0, 1, 2, 4, 3, 5))
+                x = x + rpe_blocks[:, self.lut_h, self.lut_i, self.lut_j]
+            else:
+                if rpe.shape[0] == 1 and H > 1:
+                    rpe = jnp.broadcast_to(rpe, (H,) + rpe.shape[1:])
+                rpe_blocks = rpe.reshape(H, T // blk, blk, T // blk, blk).transpose(0, 1, 3, 2, 4)
+                x = x + rpe_blocks[self.lut_h, self.lut_i, self.lut_j][None]
 
         if attn_mask is not None:
             # [T, T] mask over (query, key) positions. "mul" semantics follow the
-            # reference kernel: zero mask lanes become -inf before the row reduction
-            # (softmax_fwd.tr), nonzero lanes scale the score.
+            # reference kernel (softmax_fwd.tr ATTN_MASK_MUL): zero mask lanes become
+            # -inf before the row reduction; nonzero lanes leave the score UNCHANGED
+            # (the kernel adds +0 there — it never scales by the mask value).
             attn_mask = jnp.asarray(attn_mask, jnp.float32)
             T = attn_mask.shape[-1]
             am_blocks = attn_mask.reshape(T // blk, blk, T // blk, blk).transpose(0, 2, 1, 3)
             am = am_blocks[self.lut_i, self.lut_j][None]
             if attn_mask_mode == "mul":
-                x = jnp.where(am == 0.0, -jnp.inf, x * am)
+                x = jnp.where(am == 0.0, -jnp.inf, x)
             else:
                 x = x + am
 
@@ -78,7 +88,8 @@ class Softmax:
             kp_blocks = key_padding_mask.reshape(B, -1, blk)        # [B, Nb, blk]
             kp = kp_blocks[:, self.lut_j][:, :, None, :]            # [B, nnz, 1, blk]
             if key_padding_mask_mode == "mul":
-                x = jnp.where(kp == 0.0, -jnp.inf, x * kp)
+                # KP_MASK_MUL: zero -> -inf, nonzero -> score unchanged
+                x = jnp.where(kp == 0.0, -jnp.inf, x)
             else:
                 x = x + kp
 

@@ -19,6 +19,7 @@ but the mechanics are functional JAX:
   (engine.py:1016-1089, stage2.py:682-745, 1441-1472).
 """
 
+import functools
 import os
 from typing import Any, Callable, Optional
 
@@ -72,7 +73,8 @@ class OptimizerHandle:
 
 
 _OPTIMIZER_APPLY = {
-    ADAM_OPTIMIZER: (adam_opt.init, adam_opt.apply),
+    ADAM_OPTIMIZER: (adam_opt.init,
+                     functools.partial(adam_opt.apply, adamw=False)),
     ADAMW_OPTIMIZER: (adam_opt.init, adam_opt.apply),
     LAMB_OPTIMIZER: (lamb_opt.init, lamb_opt.apply),
     SGD_OPTIMIZER: (sgd_opt.init, sgd_opt.apply),
@@ -230,7 +232,9 @@ class DeepSpeedEngine:
             assert jax.process_count() == 1, \
                 "cpu_offload currently requires a single-process (single-host) run"
             from ..ops.cpu_adam import DeepSpeedCPUAdam
-            self._offload = DeepSpeedCPUAdam(master_fp32)
+            _offload_name = self.config.optimizer_name or ADAM_OPTIMIZER
+            self._offload = DeepSpeedCPUAdam(master_fp32,
+                                             adamw=(_offload_name == ADAMW_OPTIMIZER))
             self.master_params = self._offload.params_tree()  # zero-copy host views
         else:
             self.master_params = jax.device_put(master_fp32, self._master_shardings)

@@ -39,6 +39,76 @@ def test_cpu_adam_matches_fused_adam():
         np.testing.assert_allclose(got[k], np.asarray(jp[k]), rtol=3e-5, atol=3e-6)
 
 
+def _reference_adam_step(p, g, m, v, step, lr, b1, b2, eps, wd, adamw):
+    """Hand-rolled fp64 oracle with torch semantics: torch.optim.Adam folds wd*p into
+    the gradient BEFORE the moments (classic L2); torch.optim.AdamW decays p directly."""
+    p, g, m, v = (np.asarray(a, np.float64) for a in (p, g, m, v))
+    if not adamw:
+        g = g + wd * p
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    update = (m / (1 - b1 ** step)) / (np.sqrt(v / (1 - b2 ** step)) + eps)
+    p = p - lr * update - (lr * wd * p if adamw else 0.0)
+    return p, m, v
+
+
+@pytest.mark.parametrize("adamw", [False, True])
+def test_adam_decay_semantics(adamw):
+    """'type': 'Adam' must be classic L2 Adam (wd folded into the gradient before the
+    moments, torch.optim.Adam semantics); 'AdamW' decoupled decay. Parity for the
+    jitted fused path (ops/adam.py) and the host-tier DeepSpeedCPUAdam (native + numpy)
+    vs a hand-rolled fp64 oracle — and vs torch itself when available.
+    Reference update: csrc/adam/cpu_adam.cpp."""
+    rng = np.random.default_rng(7)
+    params = _params(rng)
+    wd, lr = 0.1, 1e-2
+
+    try:
+        import torch
+    except ImportError:
+        torch = None
+    if torch is not None:
+        tparams = [torch.nn.Parameter(torch.from_numpy(params[k].copy()))
+                   for k in sorted(params)]
+        topt = (torch.optim.AdamW if adamw else torch.optim.Adam)(
+            tparams, lr=lr, betas=(0.9, 0.999), eps=1e-8, weight_decay=wd)
+
+    ref_p = {k: params[k].astype(np.float64) for k in params}
+    ref_m = {k: np.zeros_like(ref_p[k]) for k in params}
+    ref_v = {k: np.zeros_like(ref_p[k]) for k in params}
+
+    jp = jax.tree_util.tree_map(jnp.asarray, params)
+    jstate = jadam.init(jp)
+    hyper = dict(lr=jnp.float32(lr), beta1=jnp.float32(0.9), beta2=jnp.float32(0.999),
+                 eps=jnp.float32(1e-8), weight_decay=jnp.float32(wd))
+    copt = DeepSpeedCPUAdam(params, adamw=adamw)
+    nopt = DeepSpeedCPUAdam(params, adamw=adamw)
+    nopt._lib = None  # numpy fallback path
+
+    for step in range(1, 6):
+        g = _params(rng)
+        for k in params:
+            ref_p[k], ref_m[k], ref_v[k] = _reference_adam_step(
+                ref_p[k], g[k], ref_m[k], ref_v[k], step, lr, 0.9, 0.999, 1e-8, wd, adamw)
+        if torch is not None:
+            for tp, k in zip(tparams, sorted(params)):
+                tp.grad = torch.from_numpy(g[k].copy())
+            topt.step()
+        jp, jstate = jadam.apply(jax.tree_util.tree_map(jnp.asarray, g), jstate, jp,
+                                 jnp.int32(step), hyper, adamw=adamw)
+        copt.step(copt.flatten_grads(g), step=step, lr=lr, weight_decay=wd)
+        nopt.step(nopt.flatten_grads(g), step=step, lr=lr, weight_decay=wd)
+
+    got_c, got_n = copt.params_tree(), nopt.params_tree()
+    for k in params:
+        np.testing.assert_allclose(np.asarray(jp[k]), ref_p[k], rtol=3e-5, atol=3e-6)
+        np.testing.assert_allclose(got_c[k], ref_p[k], rtol=3e-5, atol=3e-6)
+        np.testing.assert_allclose(got_n[k], ref_p[k], rtol=3e-5, atol=3e-6)
+    if torch is not None:  # the oracle itself agrees with torch
+        for tp, k in zip(tparams, sorted(params)):
+            np.testing.assert_allclose(ref_p[k], tp.detach().numpy(), rtol=3e-5, atol=3e-6)
+
+
 def test_cpu_adam_native_matches_numpy_fallback():
     rng = np.random.default_rng(1)
     params = _params(rng)

@@ -96,13 +96,15 @@ def _dense_reference_softmax(scores, layout, scale, rpe=None, kp=None, am=None,
     mask = dense_mask(layout)[None]                      # [1, H, T, T]
     x = np.asarray(scores, np.float64) * scale
     if rpe is not None:
-        x = x + np.asarray(rpe, np.float64)[None]
+        rpe = np.asarray(rpe, np.float64)
+        x = x + (rpe if rpe.ndim == 4 else rpe[None])
     if am is not None:
+        # "mul" reference-kernel semantics: zero -> -inf, nonzero -> score UNCHANGED
         am = np.asarray(am, np.float64)[None, None]
-        x = np.where(am == 0, -np.inf, x * am) if am_mode == "mul" else x + am
+        x = np.where(am == 0, -np.inf, x) if am_mode == "mul" else x + am
     if kp is not None:
         kp = np.asarray(kp, np.float64)[:, None, None, :]
-        x = np.where(kp == 0, -np.inf, x * kp) if kp_mode == "mul" else x + kp
+        x = np.where(kp == 0, -np.inf, x) if kp_mode == "mul" else x + kp
     x = np.where(mask == 0, -np.inf, x)
     m = np.max(x, -1, keepdims=True)
     e = np.exp(x - np.where(np.isfinite(m), m, 0.0))
@@ -130,7 +132,8 @@ def test_softmax_masks_and_rpe():
     rpe = rng.normal(size=(H, T, T)).astype(np.float32)
     kp = np.zeros((B, T), np.float32)
     kp[:, T // 2:] = -10000.0                    # "add" mode: large negative on padding
-    am = np.tril(np.ones((T, T), np.float32))    # "mul" mode: causal
+    # non-binary "mul" mask: nonzero values must leave scores UNCHANGED (not scale them)
+    am = np.tril(np.ones((T, T), np.float32)) * 3.0
     sm = Softmax(layout, BLOCK)
     got = np.asarray(sparse_to_dense(
         sm(vals, scale=1.0, rpe=rpe, key_padding_mask=kp, attn_mask=am,
@@ -138,6 +141,23 @@ def test_softmax_masks_and_rpe():
     want = _dense_reference_softmax(scores, layout, 1.0, rpe=rpe, kp=kp, am=am,
                                     kp_mode="add", am_mode="mul") * dense_mask(layout)[None]
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_batched_rpe():
+    """Per-batch [B, H, T, T] rpe (reference kernel strides rpe by batch:
+    softmax_fwd.tr pidz * stride_zrpe); [B, 1, T, T] broadcasts over heads."""
+    layout = make_layout()
+    rng = np.random.default_rng(5)
+    scores = rng.normal(size=(B, H, T, T)).astype(np.float32)
+    vals = dense_to_sparse(jnp.asarray(scores), layout, BLOCK)
+    sm = Softmax(layout, BLOCK)
+    for rpe_shape in [(B, H, T, T), (B, 1, T, T)]:
+        rpe = rng.normal(size=rpe_shape).astype(np.float32)
+        got = np.asarray(sparse_to_dense(sm(vals, scale=0.5, rpe=rpe), layout, BLOCK))
+        want = _dense_reference_softmax(
+            scores, layout, 0.5,
+            rpe=np.broadcast_to(rpe, (B, H, T, T))) * dense_mask(layout)[None]
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
 def test_sdd_softmax_dsd_pipeline_matches_dense_attention():
