@@ -68,7 +68,7 @@ def fetch_hostfile(hostfile_path):
     """Parse 'host slots=N' lines into an ordered {host: slot_count} map
     (reference runner.py:115-140). Returns None when the file is absent."""
     if not os.path.isfile(hostfile_path):
-        logger.warning("Unable to find hostfile, will proceed with training with local resources only.")
+        logger.warning("no hostfile found; falling back to the local host's devices")
         return None
     resource_pool = collections.OrderedDict()
     with open(hostfile_path, "r") as fd:
@@ -81,10 +81,10 @@ def fetch_hostfile(hostfile_path):
                 _, slot_count = slots.split("=")
                 slot_count = int(slot_count)
             except ValueError as err:
-                logger.error("Hostfile is not formatted correctly, unable to proceed with training.")
+                logger.error("bad hostfile line (expected '<host> slots=<n>'); aborting launch")
                 raise err
             if hostname in resource_pool:
-                logger.error("Hostfile contains duplicate hosts, unable to proceed with training.")
+                logger.error("hostfile lists the same host twice; aborting launch")
                 raise ValueError(f"host {hostname} is already defined")
             resource_pool[hostname] = slot_count
     return resource_pool
@@ -114,7 +114,7 @@ def parse_resource_filter(host_info, include_str="", exclude_str=""):
             hostname, slots = node_config.split(":")
             slots = [int(x) for x in slots.split(",")]
             if hostname not in host_info:
-                raise ValueError(f"Hostname '{hostname}' not found in hostfile")
+                raise ValueError(f"include/exclude filter references {hostname!r}, which the hostfile does not define")
             for s in slots:
                 if s not in host_info[hostname]:
                     raise ValueError(f"No slot '{s}' specified on host '{hostname}'")
@@ -127,7 +127,7 @@ def parse_resource_filter(host_info, include_str="", exclude_str=""):
         else:
             hostname = node_config
             if hostname not in host_info:
-                raise ValueError(f"Hostname '{hostname}' not found in hostfile")
+                raise ValueError(f"include/exclude filter references {hostname!r}, which the hostfile does not define")
             if include_str:
                 filtered_hosts[hostname] = host_info[hostname]
             else:
@@ -196,7 +196,7 @@ def main(args=None):
         multi_node_exec = False
 
     if not multi_node_exec and args.num_nodes > 1:
-        raise ValueError("Num nodes is >1 but no extra nodes available via hostfile")
+        raise ValueError("--num_nodes > 1 requires a hostfile listing the extra nodes")
 
     active_resources = parse_inclusion_exclusion(resource_pool, args.include, args.exclude)
     env = os.environ.copy()
@@ -205,7 +205,7 @@ def main(args=None):
         first_host = list(active_resources.keys())[0]
         result = subprocess.check_output([f"ssh {first_host} hostname -I"], shell=True)
         args.master_addr = result.decode("utf-8").split()[0]
-        logger.info(f"Using IP address of {args.master_addr} for node {first_host}")
+        logger.info(f"resolved {first_host} -> {args.master_addr} as the coordinator address")
 
     if args.num_nodes > 0:
         active_resources = collections.OrderedDict(

@@ -28,7 +28,7 @@ class SparsityConfig:
 
     def setup_layout(self, seq_len) -> np.ndarray:
         if seq_len % self.block != 0:
-            raise ValueError(f"Sequence length {seq_len} must be divisible by block size {self.block}!")
+            raise ValueError(f"sparse layout: seq_len={seq_len} is not a multiple of block={self.block}")
         num_blocks = seq_len // self.block
         return np.zeros((self.num_heads, num_blocks, num_blocks), dtype=np.int64)
 
@@ -66,22 +66,24 @@ class FixedSparsityConfig(SparsityConfig):
         super().__init__(num_heads, block, different_layout_per_head)
         self.num_local_blocks = num_local_blocks
         if num_local_blocks % num_global_blocks != 0:
-            raise ValueError(f"Number of blocks in a local window, {num_local_blocks}, "
-                             f"must be dividable by number of global blocks, {num_global_blocks}!")
+            raise ValueError(f"sparse layout: num_local_blocks={num_local_blocks} is not a "
+                             f"multiple of num_global_blocks={num_global_blocks}")
         self.num_global_blocks = num_global_blocks
         if attention not in ("unidirectional", "bidirectional"):
-            raise NotImplementedError('only "uni/bi-directional" attentions are supported for now!')
+            raise NotImplementedError(f"sparse layout: unknown attention mode {attention!r} "
+                                      "(expected 'unidirectional' or 'bidirectional')")
         self.attention = attention
         if attention != "bidirectional" and horizontal_global_attention:
-            raise ValueError('only "bi-directional" attentions can support horizontal global attention!')
+            raise ValueError("sparse layout: horizontal_global_attention requires "
+                             "attention='bidirectional'")
         self.horizontal_global_attention = horizontal_global_attention
         if num_different_global_patterns > 1 and not different_layout_per_head:
-            raise ValueError("Number of different layouts cannot be more than one when you have set "
-                             "a single layout for all heads! Set different_layout_per_head to True.")
+            raise ValueError("sparse layout: num_different_global_patterns > 1 requires "
+                             "different_layout_per_head=True")
         if num_different_global_patterns > (num_local_blocks // num_global_blocks):
-            raise ValueError(f"Number of layout versions (num_different_global_patterns), "
-                             f"{num_different_global_patterns}, cannot be larger than "
-                             f"{num_local_blocks // num_global_blocks}!")
+            raise ValueError(f"sparse layout: num_different_global_patterns="
+                             f"{num_different_global_patterns} exceeds the "
+                             f"{num_local_blocks // num_global_blocks} distinct patterns available")
         self.num_different_global_patterns = num_different_global_patterns
 
     def set_local_layout(self, h, layout):
@@ -140,24 +142,26 @@ class VariableSparsityConfig(SparsityConfig):
         self.global_block_indices = global_block_indices if global_block_indices is not None else [0]
         if global_block_end_indices is not None:
             if len(self.global_block_indices) != len(global_block_end_indices):
-                raise ValueError("Global block start/end indices lengths must match!")
+                raise ValueError("sparse layout: global_block_indices and "
+                                 "global_block_end_indices differ in length")
             for start_idx, end_idx in zip(self.global_block_indices, global_block_end_indices):
                 if start_idx >= end_idx:
-                    raise ValueError(f"Global block start index {start_idx} must be smaller "
-                                     f"than end index {end_idx}!")
+                    raise ValueError(f"sparse layout: global block range [{start_idx}, {end_idx}) is empty")
         self.global_block_end_indices = global_block_end_indices
         if attention not in ("unidirectional", "bidirectional"):
-            raise NotImplementedError('only "uni/bi-directional" attentions are supported for now!')
+            raise NotImplementedError(f"sparse layout: unknown attention mode {attention!r} "
+                                      "(expected 'unidirectional' or 'bidirectional')")
         self.attention = attention
         if attention != "bidirectional" and horizontal_global_attention:
-            raise ValueError('only "bi-directional" attentions can support horizontal global attention!')
+            raise ValueError("sparse layout: horizontal_global_attention requires "
+                             "attention='bidirectional'")
         self.horizontal_global_attention = horizontal_global_attention
 
     def set_random_layout(self, h, layout):
         num_blocks = layout.shape[1]
         if num_blocks < self.num_random_blocks:
-            raise ValueError(f"Number of random blocks, {self.num_random_blocks}, must be smaller "
-                             f"than overall number of blocks in a row, {num_blocks}!")
+            raise ValueError(f"sparse layout: num_random_blocks={self.num_random_blocks} "
+                             f"exceeds the {num_blocks} blocks per row")
         for row in range(num_blocks):
             rnd_cols = random.sample(range(num_blocks), self.num_random_blocks)
             layout[h, row, rnd_cols] = 1
@@ -227,8 +231,8 @@ class BigBirdSparsityConfig(SparsityConfig):
     def set_random_layout(self, h, layout):
         num_blocks = layout.shape[1]
         if num_blocks < self.num_random_blocks:
-            raise ValueError(f"Number of random blocks, {self.num_random_blocks}, must be smaller "
-                             f"than overall number of blocks in a row, {num_blocks}!")
+            raise ValueError(f"sparse layout: num_random_blocks={self.num_random_blocks} "
+                             f"exceeds the {num_blocks} blocks per row")
         for row in range(num_blocks):
             rnd_cols = random.sample(range(num_blocks), self.num_random_blocks)
             layout[h, row, rnd_cols] = 1
@@ -237,8 +241,8 @@ class BigBirdSparsityConfig(SparsityConfig):
     def set_sliding_window_layout(self, h, layout):
         num_blocks = layout.shape[1]
         if num_blocks < self.num_sliding_window_blocks:
-            raise ValueError(f"Number of sliding window blocks, {self.num_sliding_window_blocks}, "
-                             f"must be smaller than overall number of blocks in a row, {num_blocks}!")
+            raise ValueError(f"sparse layout: num_sliding_window_blocks={self.num_sliding_window_blocks} "
+                             f"exceeds the {num_blocks} blocks per row")
         w = self.num_sliding_window_blocks // 2
         for row in range(num_blocks):
             layout[h, row, max(0, row - w):min(row + w + 1, num_blocks)] = 1
@@ -247,8 +251,8 @@ class BigBirdSparsityConfig(SparsityConfig):
     def set_global_layout_itc(self, h, layout):
         num_blocks = layout.shape[1]
         if num_blocks < self.num_global_blocks:
-            raise ValueError(f"Number of global blocks, {self.num_global_blocks}, must be smaller "
-                             f"than overall number of blocks in a row, {num_blocks}!")
+            raise ValueError(f"sparse layout: num_global_blocks={self.num_global_blocks} "
+                             f"exceeds the {num_blocks} blocks per row")
         layout[h, 0:self.num_global_blocks, :] = 1
         layout[h, :, 0:self.num_global_blocks] = 1
         return layout
@@ -277,18 +281,18 @@ class BSLongformerSparsityConfig(SparsityConfig):
         self.global_block_indices = global_block_indices if global_block_indices is not None else [0]
         if global_block_end_indices is not None:
             if len(self.global_block_indices) != len(global_block_end_indices):
-                raise ValueError("Global block start/end indices lengths must match!")
+                raise ValueError("sparse layout: global_block_indices and "
+                                 "global_block_end_indices differ in length")
             for start_idx, end_idx in zip(self.global_block_indices, global_block_end_indices):
                 if start_idx >= end_idx:
-                    raise ValueError(f"Global block start index {start_idx} must be smaller "
-                                     f"than end index {end_idx}!")
+                    raise ValueError(f"sparse layout: global block range [{start_idx}, {end_idx}) is empty")
         self.global_block_end_indices = global_block_end_indices
 
     def set_sliding_window_layout(self, h, layout):
         num_blocks = layout.shape[1]
         if num_blocks < self.num_sliding_window_blocks:
-            raise ValueError(f"Number of sliding window blocks, {self.num_sliding_window_blocks}, "
-                             f"must be smaller than overall number of blocks in a row, {num_blocks}!")
+            raise ValueError(f"sparse layout: num_sliding_window_blocks={self.num_sliding_window_blocks} "
+                             f"exceeds the {num_blocks} blocks per row")
         w = self.num_sliding_window_blocks // 2
         for row in range(num_blocks):
             layout[h, row, max(0, row - w):min(row + w + 1, num_blocks)] = 1

@@ -73,6 +73,9 @@ class OptimizerHandle:
 
 
 _OPTIMIZER_APPLY = {
+    # "Adam" is classic L2 Adam: the reference's v0.3.0 kernels fold wd*p into the
+    # gradient before the moments (csrc/adam/cpu_adam.cpp:81-82,122 `grad = param *
+    # _weight_decay + grad`; no adam_w_mode knob existed yet). "AdamW" is decoupled.
     ADAM_OPTIMIZER: (adam_opt.init,
                      functools.partial(adam_opt.apply, adamw=False)),
     ADAMW_OPTIMIZER: (adam_opt.init, adam_opt.apply),
@@ -180,6 +183,11 @@ class DeepSpeedEngine:
         # sparse_grad_paths() (the reference auto-detected nn.Embedding modules; a
         # functional pytree has no module types to sniff).
         self._sparse_grad_flags = None
+        # Optional model hint: sparse_grad_tokens(*batch) -> token positions in the
+        # GLOBAL batch. Without it the engine assumes batch arg 0 is the token-id
+        # tensor, which silently mis-sizes the row capacity for models whose first
+        # positional input is something else.
+        self._sparse_tokens_fn = getattr(model, "sparse_grad_tokens", None)
         if (self.config.sparse_gradients_enabled and not self._use_stacked_grads
                 and param_shardings is None):
             patterns = tuple(getattr(model, "sparse_grad_paths", lambda: ())())
@@ -232,6 +240,9 @@ class DeepSpeedEngine:
             assert jax.process_count() == 1, \
                 "cpu_offload currently requires a single-process (single-host) run"
             from ..ops.cpu_adam import DeepSpeedCPUAdam
+            # non-Adam optimizers are rejected later by _configure_optimizer's
+            # Adam/AdamW assert; absent optimizer block defaults to "adam" (L2),
+            # matching the _OPTIMIZER_APPLY default for the non-offload path
             _offload_name = self.config.optimizer_name or ADAM_OPTIMIZER
             self._offload = DeepSpeedCPUAdam(master_fp32,
                                              adamw=(_offload_name == ADAMW_OPTIMIZER))
@@ -493,12 +504,20 @@ class DeepSpeedEngine:
             # replaces XLA's automatic reduction so we control the per-leaf strategy.
             from .sparse_tensor import row_sparse_allreduce
             sparse_flags = self._sparse_grad_flags
+            sparse_tokens_fn = self._sparse_tokens_fn
+            if sparse_tokens_fn is None:
+                logger.warning(
+                    "[deepspeed_tpu] sparse_gradients: no sparse_grad_tokens() hint on "
+                    "the model; assuming batch arg 0 is the token-id tensor when sizing "
+                    "the sparse row capacity")
             dp = self.dp_size
 
             def reduce_sparse(grads, batch):
                 # A token position contributes at most one nonzero row per table,
                 # so local token count exactly bounds the sparse row capacity.
-                local_tokens = int(np.prod(batch[0].shape)) // dp
+                global_tokens = (int(sparse_tokens_fn(*batch)) if sparse_tokens_fn is not None
+                                 else int(np.prod(batch[0].shape)))
+                local_tokens = global_tokens // dp
                 flat, treedef = jax.tree_util.tree_flatten(grads)
                 flat_flags = jax.tree_util.tree_leaves(sparse_flags)
                 reduced = []

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from ...parallel.pipe.module import PipelineModule, TiedLayerSpec
-from ...utils import log_dist
+from ...utils import log_dist, logger
 from ..engine import DeepSpeedEngine
 from . import schedule
 
@@ -163,12 +163,21 @@ class PipelineEngine(DeepSpeedEngine):
 
             if s == self.num_stages - 1 and loss_fn is not None:
                 def last_bwd(stage_params, x, labels, scale, _fn=fn):
+                    # ``scale`` folds 1/micro_batches AND the fp16 loss scale: grads
+                    # leave every stage loss-scaled (the dx flowing upstream carries
+                    # the factor), and _jit_apply_update unscales by cur_scale with
+                    # the overflow check intact (reference loss_scaler.py:51-53).
                     def f(p, xx):
                         return loss_fn(_fn(p, xx), labels) * scale
                     loss, (dparams, dx) = jax.value_and_grad(f, argnums=(0, 1))(stage_params, x)
                     return loss / scale, dparams, dx
 
                 self._stage_last_bwd = jax.jit(last_bwd)
+
+                def last_eval(stage_params, x, labels, _fn=fn):
+                    return loss_fn(_fn(stage_params, x), labels)
+
+                self._stage_last_eval = jax.jit(last_eval)
 
     # ------------------------------------------------------------- blocked base API
     def forward(self, *args, **kwargs):
@@ -221,7 +230,26 @@ class PipelineEngine(DeepSpeedEngine):
         recv_grad_count = [0] * S
         micro_losses = []
         grads_total: Optional[Dict[str, Any]] = None
+        # fold the fp16 loss scale into the per-micro-batch factor (weak-spot fix:
+        # stage backwards must produce loss-scaled grads for the overflow machinery
+        # in _jit_apply_update to mean anything under fp16)
         scale = jnp.asarray(1.0 / mb, jnp.float32)
+        if self.fp16_enabled():
+            scale = scale * self.scaler_state.cur_scale
+
+        breakdown = self.wall_clock_breakdown()
+        _TIMER_BY_CMD = {
+            schedule.LoadMicroBatch: "batch_input",
+            schedule.ForwardPass: "forward_microstep",
+            schedule.BackwardPass: "backward_microstep",
+            schedule.SendActivation: "pipe_send_output",
+            schedule.RecvActivation: "pipe_recv_input",
+            schedule.SendGrad: "pipe_send_grad",
+            schedule.RecvGrad: "pipe_recv_grad",
+            schedule.OptimizerStep: "step_microstep",
+        }
+        if breakdown:
+            self.timers("train_batch").start()
 
         def merge_grads(total, delta):
             if total is None:
@@ -283,25 +311,48 @@ class PipelineEngine(DeepSpeedEngine):
                 if s == 0:
                     self._pipeline_optimizer_step(grads_total)
 
-        total_steps = len(streams[0])
-        for step_id in range(total_steps):
-            # Phase 1: all sends/loads (their payloads were computed in earlier steps).
-            for s in range(S):
-                for cmd in streams[s][step_id]:
-                    if isinstance(cmd, _SEND_CMDS):
-                        exec_cmd(s, cmd)
-            # Phase 2: recvs + compute + reductions/step.
-            for s in range(S):
-                for cmd in streams[s][step_id]:
-                    if not isinstance(cmd, _SEND_CMDS):
-                        exec_cmd(s, cmd)
+        def timed_exec(s, cmd):
+            name = _TIMER_BY_CMD.get(type(cmd)) if breakdown else None
+            if name is None:
+                exec_cmd(s, cmd)
+                return
+            self.timers(name).start()
+            exec_cmd(s, cmd)
+            self.timers(name).stop()
+
+        self._run_streams(streams, timed_exec)
 
         self.agg_train_loss = jnp.mean(jnp.stack(micro_losses)) if micro_losses else None
         self.global_steps += 1
         self.micro_steps += mb
+        if breakdown:
+            self.timers("train_batch").stop()
+            if self.global_steps % self.steps_per_print() == 0:
+                # per-instruction wall-clock buckets (reference pipe/engine.py:964-984)
+                self.timers.log(["batch_input", "forward_microstep", "backward_microstep",
+                                 "pipe_send_output", "pipe_recv_input", "pipe_send_grad",
+                                 "pipe_recv_grad", "step_microstep", "train_batch"],
+                                reset=True)
         if self.global_steps == 1 or self.global_steps % self.steps_per_print() == 0:
             self._report_progress(self.global_steps)
         return self.agg_train_loss
+
+    @staticmethod
+    def _run_streams(streams, exec_cmd):
+        """Execute per-stage instruction streams merged by step index. Within one
+        merged step all Sends/Loads run before any Recv — the scheduling invariant
+        that lets the reference's blocking p2p broadcasts rendezvous (its even/odd
+        orderings serialize to exactly this)."""
+        S = len(streams)
+        for step_id in range(len(streams[0])):
+            for s in range(S):
+                for cmd in streams[s][step_id]:
+                    if isinstance(cmd, _SEND_CMDS):
+                        exec_cmd(s, cmd)
+            for s in range(S):
+                for cmd in streams[s][step_id]:
+                    if not isinstance(cmd, _SEND_CMDS):
+                        exec_cmd(s, cmd)
 
     def _select_params(self, stage_id):
         return {k: self.params[k] for k in self._stage_param_keys(stage_id)}
@@ -317,15 +368,67 @@ class PipelineEngine(DeepSpeedEngine):
         hyper = self.optimizer.current_hyper()
         step = jnp.asarray(self.global_steps + 1 - self.skipped_steps, jnp.int32)
         (self.master_params, self.opt_state, self.scaler_state, self.params,
-         _overflow, self._last_grad_norm) = self._jit_apply_update(
+         overflow, self._last_grad_norm) = self._jit_apply_update(
             self.master_params, self.opt_state, self.scaler_state, full_grads, step, hyper)
-        if self.lr_scheduler is not None:
+        if self.fp16_enabled() and bool(jax.device_get(overflow)):
+            # jit already skipped the master update and backed off the scale; mirror
+            # the host-side accounting (reference _take_model_step overflow branch)
+            self.skipped_steps += 1
+            logger.info("[deepspeed_tpu] OVERFLOW! Skipping pipeline step.")
+        elif self.lr_scheduler is not None:
             self.lr_scheduler.step()
 
     def eval_batch(self, data_iter):
-        """Forward-only evaluation over micro-batches (reference pipe/engine.py:305-372)."""
-        losses = []
-        for _ in range(self.micro_batches):
-            batch = self._next_micro_batch(data_iter)
-            losses.append(self._whole_model_fn(self.params, *batch))
-        return jnp.mean(jnp.stack(losses))
+        """Forward-only evaluation executing the InferenceSchedule instruction stream
+        through the per-stage jitted forwards (reference pipe/engine.py:305-372 runs
+        InferenceSchedule through _exec_schedule; the two-buffer ring and the even/odd
+        send/recv ordering of schedule.InferenceSchedule are preserved)."""
+        mb = self.micro_batches
+        S = self.num_stages
+        streams = [list(iter(schedule.InferenceSchedule(micro_batches=mb, stages=S,
+                                                        stage_id=s)))
+                   for s in range(S)]
+
+        act_in = [dict() for _ in range(S)]    # stage -> buffer_id -> input activation
+        act_out = [dict() for _ in range(S)]   # stage -> buffer_id -> output activation
+        chan_act = {}                           # (sending stage, mb id) -> payload
+        in_mb = [dict() for _ in range(S)]     # stage -> buffer_id -> micro-batch id
+        labels_by_mb = {}
+        load_count = [0] * S
+        recv_act_count = [0] * S
+        micro_losses = []
+
+        def exec_cmd(s, cmd):
+            if isinstance(cmd, schedule.LoadMicroBatch):
+                mb_id = load_count[s]
+                load_count[s] += 1
+                if s == 0:
+                    batch = self._next_micro_batch(data_iter)
+                    act_in[0][cmd.buffer_id] = batch[0]
+                    in_mb[0][cmd.buffer_id] = mb_id
+                    labels_by_mb[mb_id] = batch[1] if len(batch) > 1 else None
+                # last stage: its LoadMicroBatch picks up the labels stage 0 stashed
+                # (the reference's first/last stages share the data loader)
+            elif isinstance(cmd, schedule.ForwardPass):
+                x = act_in[s].pop(cmd.buffer_id)
+                mb_id = in_mb[s].pop(cmd.buffer_id)
+                if s == S - 1 and self.pipe_module.loss_fn is not None:
+                    micro_losses.append(
+                        self._stage_last_eval(self._select_params(s), x, labels_by_mb[mb_id]))
+                else:
+                    out = self._stage_fwd[s](self._select_params(s), x)
+                    if s == S - 1:
+                        micro_losses.append(out)
+                    else:
+                        act_out[s][cmd.buffer_id] = (mb_id, out)
+            elif isinstance(cmd, schedule.SendActivation):
+                mb_id, payload = act_out[s].pop(cmd.buffer_id)
+                chan_act[(s, mb_id)] = payload
+            elif isinstance(cmd, schedule.RecvActivation):
+                mb_id = recv_act_count[s]
+                recv_act_count[s] += 1
+                act_in[s][cmd.buffer_id] = chan_act.pop((s - 1, mb_id))
+                in_mb[s][cmd.buffer_id] = mb_id
+
+        self._run_streams(streams, exec_cmd)
+        return jnp.mean(jnp.stack(micro_losses))

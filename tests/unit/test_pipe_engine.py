@@ -175,3 +175,77 @@ def test_pipe_activation_checkpoint_interval():
             lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                                     rtol=1e-5, atol=1e-6),
             results[0], other)
+
+
+def test_pipe_eval_batch_inference_schedule_parity():
+    """eval_batch executes the InferenceSchedule stream; its aggregate loss must equal
+    the sequential whole-model loss over the same micro-batches."""
+    module, params = make_pipe(num_layers=4, num_stages=2)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=module, model_parameters=params,
+                                               config_params=pipe_config())
+    it = data_iter(batch=16, seed=21)   # distinct micro-batches so mb routing matters
+    batches = [next(it) for _ in range(engine.micro_batches)]
+    got = float(jax.device_get(engine.eval_batch(iter(batches))))
+    want = np.mean([float(jax.device_get(
+        engine._whole_model_fn(engine.params, jnp.asarray(x), jnp.asarray(y))))
+        for x, y in batches])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_pipe_fp16_loss_scale_parity():
+    """fp16 pipeline grads are loss-scaled in the stage backward and unscaled in the
+    update: the first-step weights must match an fp32 run to fp16 resolution."""
+    results = {}
+    for prec in ["fp32", "fp16"]:
+        module, params = make_pipe(num_layers=4, num_stages=2, seed=9)
+        cfg = pipe_config()
+        if prec == "fp16":
+            cfg["fp16"] = {"enabled": True, "loss_scale": 1024.0}
+        engine, _, _, _ = deepspeed_tpu.initialize(model=module, model_parameters=params,
+                                                   config_params=cfg)
+        it = data_iter(batch=16, seed=13)
+        for _ in range(2):
+            loss = engine.train_batch(it)
+        results[prec] = (float(jax.device_get(loss)),
+                         jax.device_get(engine.master_params))
+    np.testing.assert_allclose(results["fp16"][0], results["fp32"][0], rtol=2e-2)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=3e-2, atol=2e-3),
+        results["fp16"][1], results["fp32"][1])
+
+
+def test_pipe_fp16_overflow_skips_step():
+    module, params = make_pipe(num_layers=4, num_stages=2)
+    cfg = pipe_config()
+    cfg["fp16"] = {"enabled": True, "loss_scale": 0, "initial_scale_power": 4,
+                   "hysteresis": 1}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=module, model_parameters=params,
+                                               config_params=cfg)
+    s0 = float(engine.loss_scale())
+    before = jax.device_get(engine.master_params)
+
+    def bad_iter():
+        while True:
+            yield (np.ones((16, HIDDEN), np.float32),
+                   np.full((16, HIDDEN), 1e30, np.float32))  # cotangents overflow fp16
+
+    engine.train_batch(bad_iter())
+    assert engine.skipped_steps == 1
+    assert float(engine.loss_scale()) == s0 / 2
+    jax.tree_util.tree_map(lambda a, b: np.testing.assert_array_equal(a, b),
+                           jax.device_get(engine.master_params), before)
+
+
+def test_pipe_wall_clock_breakdown_timers():
+    module, params = make_pipe(num_layers=4, num_stages=2)
+    cfg = pipe_config()
+    cfg["wall_clock_breakdown"] = True
+    engine, _, _, _ = deepspeed_tpu.initialize(model=module, model_parameters=params,
+                                               config_params=cfg)
+    engine.train_batch(data_iter(batch=16))
+    for name in ["batch_input", "forward_microstep", "backward_microstep",
+                 "pipe_send_output", "pipe_recv_input", "pipe_send_grad",
+                 "pipe_recv_grad", "step_microstep", "train_batch"]:
+        assert name in engine.timers.timers, f"missing timer {name}"
+        assert engine.timers.timers[name].elapsed_ > 0 or name in (
+            "pipe_send_output", "pipe_recv_input", "pipe_send_grad", "pipe_recv_grad")

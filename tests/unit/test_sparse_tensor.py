@@ -146,3 +146,47 @@ def test_engine_sparse_gradients_parity(zero_stage):
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4),
         results[False], results[True])
+
+
+class _LabelsFirstModel(_UntiedEmbedModel):
+    """Token ids are the SECOND positional input: without the sparse_grad_tokens()
+    hint the engine would size the sparse row capacity from the labels tensor."""
+
+    def __init__(self):
+        super().__init__(vocab=512)  # big table so the sparse gather path is taken
+
+    def apply(self, params, labels, tokens):
+        return super().apply(params, tokens, labels)
+
+    def sparse_grad_tokens(self, labels, tokens):
+        return int(np.prod(tokens.shape))
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs multi-device mesh")
+def test_engine_sparse_gradients_tokens_hint():
+    """Capacity comes from the model's sparse_grad_tokens() hint, not batch arg 0."""
+    model = _LabelsFirstModel()
+    rng = np.random.default_rng(0)
+    # labels-first batch: arg 0 has 8 elements, the token tensor has 8*12
+    batch = (jnp.asarray(rng.integers(0, 4, (8,))), jnp.asarray(rng.integers(0, 512, (8, 12))))
+
+    results = {}
+    for sparse in (False, True):
+        cfg = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 8 // len(jax.devices()),
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+               "sparse_gradients": sparse,
+               "zero_optimization": {"stage": 0}}
+        params = model.init(jax.random.PRNGKey(0))
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                                   config_params=cfg)
+        for _ in range(3):
+            loss = engine.forward(*batch)
+            engine.backward(loss)
+            engine.step()
+        if sparse:  # the hint sizes capacity below the table height -> sparse gather
+            assert engine._sparse_tokens_fn is not None
+        results[sparse] = jax.device_get(engine.master_params)
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-3, atol=5e-4),
+        results[False], results[True])
