@@ -45,11 +45,60 @@ def _env_identity():
         if os.environ.get(size_key) is not None:
             nprocs = int(os.environ[size_key])
             pid = int(os.environ[rank_key])
-            if coord is None:
-                raise RuntimeError("MPI launch detected but DS_COORDINATOR_ADDRESS is unset; "
-                                   "export it (rank-0 host:port) or use the deepspeed_tpu launcher")
+            if nprocs <= 1:
+                # single-rank mpirun: no world to join, no coordinator needed
+                return coord or "", nprocs, pid
+            # ALL ranks run the bcast even when some have the env set locally —
+            # OpenMPI does not forward user env by default, so a conditional
+            # collective would deadlock the ranks that lack it. Rank 0's view
+            # (env if set, else derived) wins everywhere.
+            coord = _mpi_negotiate_coordinator(coord)
             return coord, nprocs, pid
     return None
+
+
+def _routable_host_address() -> str:
+    """First address of `hostname -I` (the launcher's inference, runner.py) with a
+    UDP-connect fallback: socket.gethostbyname(hostname) resolves to 127.0.1.1 on
+    stock Debian/Ubuntu /etc/hosts, which remote ranks cannot reach."""
+    import socket
+    import subprocess
+    try:
+        out = subprocess.run(["hostname", "-I"], capture_output=True, text=True,
+                             timeout=5).stdout.split()
+        if out:
+            return out[0]
+    except (OSError, subprocess.SubprocessError):
+        pass
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        s.connect(("8.8.8.8", 80))  # no packet sent; just picks the egress interface
+        return s.getsockname()[0]
+
+
+def _mpi_negotiate_coordinator(local_coord):
+    """Rank 0 broadcasts the coordinator address over MPI, like the reference's
+    _mpi_check (engine.py:198-235 bcast's master_addr from rank 0). Every rank
+    must call this (it is a collective). Needs mpi4py; without it the caller must
+    export DS_COORDINATOR_ADDRESS on every rank."""
+    from ..launcher.constants import DEFAULT_COORDINATOR_PORT
+    try:
+        from mpi4py import MPI
+    except ImportError as e:
+        if local_coord:
+            return local_coord  # best effort: hope every rank has it exported
+        raise RuntimeError(
+            "MPI launch detected but DS_COORDINATOR_ADDRESS is unset and mpi4py is "
+            "unavailable to negotiate one; export DS_COORDINATOR_ADDRESS=<rank0-host:port> "
+            "on every rank (mpirun -x DS_COORDINATOR_ADDRESS) or launch via the "
+            "deepspeed_tpu runner") from e
+    comm = MPI.COMM_WORLD
+    if comm.Get_rank() == 0:
+        coord = local_coord or f"{_routable_host_address()}:{DEFAULT_COORDINATOR_PORT}"
+    else:
+        coord = None
+    coord = comm.bcast(coord, root=0)
+    logger.info(f"coordinator address negotiated over MPI: {coord}")
+    return coord
 
 
 def init_distributed(coordinator_address: Optional[str] = None,

@@ -1,0 +1,64 @@
+"""Training script spawned by the real multi-process launcher test.
+
+Joins the jax.distributed world from the DS_* env that ``launcher/launch.py``
+exports (or runs single-process when none is set), trains SimpleModel for a few
+steps on deterministic data, and has process 0 write the loss trajectory to
+``--out``. The parent test asserts loss parity between a 2-process world and a
+single-process run over the same 2-device mesh (reference test strategy:
+tests/unit/common.py:14-100 forks real ranks on one host).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, _HERE)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # before any backend/distributed init
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--local_rank", type=int, default=0)
+    parser.add_argument("--out", type=str, required=True)
+    parser.add_argument("--steps", type=int, default=3)
+    args = parser.parse_args()
+
+    import deepspeed_tpu
+    from deepspeed_tpu.runtime import dist as ds_dist
+
+    ds_dist.init_distributed()  # no-op single-process; joins the world under the launcher
+
+    from simple_model import SimpleModel, random_dataset, simple_config
+
+    model = SimpleModel(hidden_dim=16)
+    params = model.init(jax.random.PRNGKey(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                               config_params=simple_config(batch=8))
+    data = random_dataset(8 * args.steps, 16, seed=42)
+    losses = []
+    for i in range(args.steps):
+        xs = np.stack([data[i * 8 + j][0] for j in range(8)])
+        ys = np.stack([data[i * 8 + j][1] for j in range(8)])
+        loss = engine(xs, ys)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+
+    if jax.process_index() == 0:
+        with open(args.out, "w") as f:
+            json.dump({"losses": losses,
+                       "world": jax.process_count(),
+                       "devices": jax.device_count()}, f)
+
+
+if __name__ == "__main__":
+    main()
