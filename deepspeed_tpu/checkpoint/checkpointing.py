@@ -79,6 +79,14 @@ def optim_states_name(dp_rank: int, mp_rank: int = 0) -> str:
 
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_state: Dict = {},
                     save_latest: bool = True):
+    if getattr(engine, "_offload", None) is not None and jax.process_count() > 1:
+        # Multi-host offload trains with per-process host partitions; assembling the
+        # full master/moment trees for the single-writer layout below would need the
+        # other hosts' regions. Fail loud at save time rather than crash mid-assembly.
+        raise NotImplementedError(
+            "checkpoint save under multi-host ZeRO-Offload is not implemented yet: "
+            "each host holds only its own master/moment regions. Save from a "
+            "single-host run, or disable cpu_offload for checkpointed training.")
     if tag is None:
         tag = f"global_step{engine.global_steps}"
     ckpt_dir = _ckpt_dir(save_dir, tag)

@@ -23,6 +23,8 @@ extern "C" {
 //   adamw == 0    -> classic L2 Adam (torch.optim.Adam): wd*p is folded into the
 //                    gradient BEFORE the moment updates, no separate decay term
 //   bias_correction != 0 -> m_hat = m/(1-b1^t), v_hat = v/(1-b2^t)
+//   grad_scale    -> g[i] is multiplied by this before use (fuses loss-scale
+//                    unscaling + gradient clipping into the update pass)
 void ds_adam_step(float* __restrict__ p,
                   const float* __restrict__ g,
                   float* __restrict__ m,
@@ -34,6 +36,7 @@ void ds_adam_step(float* __restrict__ p,
                   float beta2,
                   float eps,
                   float weight_decay,
+                  float grad_scale,
                   int32_t adamw,
                   int32_t bias_correction) {
   float bc1 = 1.0f, bc2 = 1.0f;
@@ -51,7 +54,7 @@ void ds_adam_step(float* __restrict__ p,
 
 #pragma omp parallel for simd schedule(static)
   for (int64_t i = 0; i < n; ++i) {
-    const float grad = g[i] + l2_factor * p[i];
+    const float grad = grad_scale * g[i] + l2_factor * p[i];
     const float mi = beta1 * m[i] + omb1 * grad;
     const float vi = beta2 * v[i] + omb2 * grad * grad;
     m[i] = mi;
@@ -87,6 +90,7 @@ void ds_adam_step_copy(float* __restrict__ p,
                        float beta2,
                        float eps,
                        float weight_decay,
+                       float grad_scale,
                        int32_t adamw,
                        int32_t bias_correction) {
   float bc1 = 1.0f, bc2 = 1.0f;
@@ -103,7 +107,7 @@ void ds_adam_step_copy(float* __restrict__ p,
 
 #pragma omp parallel for simd schedule(static)
   for (int64_t i = 0; i < n; ++i) {
-    const float grad = g[i] + l2_factor * p[i];
+    const float grad = grad_scale * g[i] + l2_factor * p[i];
     const float mi = beta1 * m[i] + omb1 * grad;
     const float vi = beta2 * v[i] + omb2 * grad * grad;
     m[i] = mi;

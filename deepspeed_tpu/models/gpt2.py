@@ -178,7 +178,14 @@ class GPT2Model:
 
         if c.use_flash_attention:
             from ..ops.pallas.flash_attention import flash_attention
-            y = flash_attention(q, k, v, True)
+            rate, seed = 0.0, None
+            if dropout_rng is not None and c.dropout > 0:
+                # in-kernel attention dropout: the seed is a traced operand so remat
+                # replays identical masks
+                seed = jax.random.randint(dropout_rng, (), 0,
+                                          jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+                rate = float(c.dropout)
+            y = flash_attention(q, k, v, True, dropout_rate=rate, dropout_seed=seed)
         else:
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                                 preferred_element_type=jnp.float32) / math.sqrt(c.head_dim)
@@ -186,8 +193,7 @@ class GPT2Model:
             scores = jnp.where(mask, scores, jnp.float32(-1e9))
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             if dropout_rng is not None and c.dropout > 0:
-                # attention-probability dropout (dense path only; the flash kernel has
-                # no in-kernel dropout — residual/embedding dropout still apply there)
+                # attention-probability dropout
                 probs = self._dropout(probs, dropout_rng)
             y = jnp.einsum("bhqk,bhkd->bhqd", probs, v,
                            preferred_element_type=jnp.float32).astype(x.dtype)

@@ -4,16 +4,31 @@ TPU-native re-design of ``deepspeed/ops/adam/cpu_adam.py`` (DeepSpeedCPUAdam l.8
 the native kernel in ``deepspeed_tpu/csrc/cpu_adam.cpp`` (analog of
 ``csrc/adam/cpu_adam.cpp``). The fp32 master weights and both Adam moments live in host
 DRAM as one contiguous flat buffer each (the reference keeps them in pinned host memory,
-stage2.py:333-349); ``step`` runs the OpenMP+SIMD native kernel in place, and
-``step_and_cast_bf16`` fuses the fp32 -> bf16 conversion of the updated parameters into
-the same pass — the analog of ``adam_update_copy`` fusing the fp16 device copy
-(cpu_adam.py:69, cpu_adam.cpp:592).
+stage2.py:333-349).
+
+Partitioned (multi-rank) offload: when constructed with a ``shardings`` tree (the
+engine's ZeRO master layout), the host buffers hold only the regions whose devices are
+addressable from THIS process — the analog of the reference stepping each DP rank's own
+``single_partition_of_fp32_groups`` (stage2.py:333-349, 750-907). Each distinct shard
+index of a leaf is stored exactly once (replicated leaves are stepped once per host, not
+once per device), so the per-host work and DRAM scale as 1/dp of the model under ZeRO-2.
+
+Overlapped stepping (the reference's async D2H grad copies + ``ds_adam_step_plus_copy``
+H2D param push, stage2.py:750-907, csrc/adam/custom_cuda_kernel.cu): ``begin_grad_fetch``
+initiates ``copy_to_host_async`` on every local grad shard up front, then
+``step_regions`` walks the regions in order — waiting only for that region's transfer,
+stepping it with the native kernel (loss-scale/clip factor fused in via ``grad_scale``),
+and immediately dispatching the async H2D ``device_put`` of the updated compute-dtype
+slice. Transfers of later regions and device pushes of earlier ones proceed concurrently
+with the host Adam of the current one, so wall-clock ≈ max(transfer, host-Adam) instead
+of their sum.
 
 If the native toolchain is unavailable the same math runs as vectorized numpy
 (~3-10x slower but bit-compatible modulo fma ordering).
 """
 
-from typing import Optional
+import time
+from typing import List, Optional
 
 import numpy as np
 
@@ -33,103 +48,221 @@ def _ptr(arr, ctype=None):
     return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float if ctype is None else ctype))
 
 
-class DeepSpeedCPUAdam:
-    """Adam over a flat host-resident fp32 parameter buffer with pytree views.
+class _Region:
+    """One distinct shard of one leaf: a host-buffer segment plus the devices holding it."""
 
-    Usage::
+    __slots__ = ("leaf", "slices", "shape", "size", "offset", "devices")
+
+    def __init__(self, leaf, slices, shape, size, offset, devices):
+        self.leaf = leaf          # leaf index in tree_flatten order
+        self.slices = slices      # tuple of python slices into the full leaf
+        self.shape = shape        # region shape
+        self.size = size          # region element count
+        self.offset = offset      # start offset in the flat host buffers
+        self.devices = devices    # addressable devices holding this shard (None -> host-only)
+
+
+def _normalize_index(idx, shape):
+    """Sharding index (tuple of slices) -> ((start, stop), ...) covering every dim."""
+    out = []
+    for s, d in zip(idx, shape):
+        start, stop, step = s.indices(d)
+        assert step == 1, "strided shardings are not supported by the offload tier"
+        out.append((start, stop))
+    # shardings may omit trailing dims
+    for d in shape[len(idx):]:
+        out.append((0, d))
+    return tuple(out)
+
+
+class DeepSpeedCPUAdam:
+    """Adam over flat host-resident fp32 buffers with pytree views.
+
+    Usage (whole-tree mode, ``shardings=None``)::
 
         opt = DeepSpeedCPUAdam(params_tree)          # copies params to host fp32
-        opt.step(grads_flat, step=1, lr=1e-3, ...)   # in-place master update
-        tree = opt.params_tree()                     # fp32 numpy views, zero-copy
+        opt.step(opt.flatten_grads(g), step=1, lr=1e-3)
+        tree = opt.params_tree()                     # fp32 numpy leaves
+
+    Engine mode passes ``shardings`` (the ZeRO master layout) and uses
+    ``begin_grad_fetch`` + ``step_regions`` for the partitioned, overlapped step.
     """
 
-    def __init__(self, params_tree, adamw: bool = True, bias_correction: bool = True):
+    def __init__(self, params_tree, adamw: bool = True, bias_correction: bool = True,
+                 shardings=None):
         leaves, self._treedef = jax.tree_util.tree_flatten(params_tree)
+        shard_leaves = (jax.tree_util.tree_leaves(shardings) if shardings is not None
+                        else [None] * len(leaves))
+        assert len(shard_leaves) == len(leaves), "shardings tree must mirror the param tree"
         host = [np.asarray(jax.device_get(l), dtype=np.float32) for l in leaves]
         self._shapes = [h.shape for h in host]
-        self._sizes = [h.size for h in host]
-        self._offsets = np.cumsum([0] + self._sizes)
-        self.numel = int(self._offsets[-1])
-        self.fp32 = np.ascontiguousarray(np.concatenate([h.reshape(-1) for h in host])
-                                         if host else np.zeros(0, np.float32))
+        self._shardings = shard_leaves
+
+        # ---- region table: each distinct local shard of each leaf, in deterministic order
+        self._regions: List[_Region] = []
+        self._leaf_regions: List[List[_Region]] = []
+        offset = 0
+        for li, (h, sh) in enumerate(zip(host, shard_leaves)):
+            regions = []
+            if sh is None:
+                r = _Region(li, tuple(slice(0, d) for d in h.shape), h.shape, h.size,
+                            offset, None)
+                offset += h.size
+                regions.append(r)
+            else:
+                dmap = sh.addressable_devices_indices_map(tuple(h.shape))
+                groups = {}
+                for dev, idx in dmap.items():
+                    key = _normalize_index(idx if idx is not None else (), h.shape)
+                    groups.setdefault(key, []).append(dev)
+                for key in sorted(groups):
+                    slices = tuple(slice(a, b) for a, b in key)
+                    shape = tuple(b - a for a, b in key)
+                    size = int(np.prod(shape)) if shape else 1
+                    devices = sorted(groups[key], key=lambda d: d.id)
+                    regions.append(_Region(li, slices, shape, size, offset, devices))
+                    offset += size
+            self._leaf_regions.append(regions)
+            self._regions.extend(regions)
+        self.numel = offset  # local partition element count
+
+        # leaf is a zero-copy view of the flat buffer iff its regions tile it
+        # contiguously in row-major order (single full region, or axis-0 blocks in order)
+        self._leaf_viewable = []
+        for li, regions in enumerate(self._leaf_regions):
+            shape = self._shapes[li]
+            if not shape:  # scalar leaf: single one-element region
+                self._leaf_viewable.append(True)
+                continue
+            ok = True
+            expect_row = 0
+            for r in regions:  # sorted by start offsets at construction
+                if any(sl.start != 0 or sl.stop != d
+                       for sl, d in zip(r.slices[1:], shape[1:])):
+                    ok = False  # not a full block over the trailing dims
+                    break
+                if r.slices[0].start != expect_row:
+                    ok = False
+                    break
+                expect_row = r.slices[0].stop
+            self._leaf_viewable.append(bool(ok and expect_row == shape[0]))
+
+        # ---- flat host buffers over the local partition
+        self.fp32 = np.empty(self.numel, np.float32)
+        for r in self._regions:
+            self.fp32[r.offset:r.offset + r.size] = host[r.leaf][r.slices].reshape(-1)
         self.exp_avg = np.zeros(self.numel, np.float32)
         self.exp_avg_sq = np.zeros(self.numel, np.float32)
-        self._bf16 = None  # staging buffer (2 B/param), allocated on first bf16 step
-        self._fp16 = None  # staging buffer for the fp16 compute-dtype path
         self._grad_buf = np.empty(self.numel, np.float32)  # D2H landing buffer
+        self._bf16 = None  # staging buffer for the bf16 path (flat mode)
         self.adamw = adamw
         self.bias_correction = bias_correction
         self._lib = load_cpu_adam()
+        self.last_step_timing = None  # {"fetch_wait": s, "host_adam": s, "push": s, "total": s}
 
-    # ------------------------------------------------------------- tree views (zero-copy)
-    def tree_of(self, flat):
-        return jax.tree_util.tree_unflatten(
-            self._treedef,
-            [flat[self._offsets[i]:self._offsets[i + 1]].reshape(self._shapes[i])
-             for i in range(len(self._sizes))])
+    # ------------------------------------------------------------- tree views
+    def _assemble(self, flat):
+        """Leaves from the flat buffer: zero-copy views where the layout allows, else
+        copies. Raises if this process doesn't hold every region of some leaf."""
+        out = []
+        for li, regions in enumerate(self._leaf_regions):
+            shape = self._shapes[li]
+            covered = sum(r.size for r in regions)
+            if covered != int(np.prod(shape) if shape else 1):
+                raise ValueError(
+                    "host offload partition does not cover the full parameter tree on "
+                    "this process (multi-host run); full-tree assembly is unavailable")
+            if self._leaf_viewable[li]:
+                start = regions[0].offset
+                out.append(flat[start:start + covered].reshape(shape))
+            else:
+                arr = np.empty(shape, flat.dtype)
+                for r in regions:
+                    arr[r.slices] = flat[r.offset:r.offset + r.size].reshape(r.shape)
+                out.append(arr)
+        return jax.tree_util.tree_unflatten(self._treedef, out)
 
     def params_tree(self):
-        return self.tree_of(self.fp32)
+        return self._assemble(self.fp32)
 
     def exp_avg_tree(self):
-        return self.tree_of(self.exp_avg)
+        return self._assemble(self.exp_avg)
 
     def exp_avg_sq_tree(self):
-        return self.tree_of(self.exp_avg_sq)
+        return self._assemble(self.exp_avg_sq)
 
     def flatten_grads(self, grads_tree) -> np.ndarray:
-        # One batched D2H transfer for all leaves, copied into a persistent flat
-        # buffer: avoids per-leaf blocking transfers and a fresh numel-sized
-        # allocation every step (this D2H is the hot cost of the offload path).
+        """Synchronous whole-tree D2H into the persistent flat grad buffer."""
         leaves = jax.device_get(jax.tree_util.tree_leaves(grads_tree))
-        offset = 0
-        for l in leaves:
-            flat = np.asarray(l, np.float32).reshape(-1)
-            self._grad_buf[offset:offset + flat.size] = flat
-            offset += flat.size
-        assert offset == self.numel
+        for li, regions in enumerate(self._leaf_regions):
+            g = np.asarray(leaves[li], np.float32)
+            for r in regions:
+                self._grad_buf[r.offset:r.offset + r.size] = g[r.slices].reshape(-1)
         return self._grad_buf
 
-    # ------------------------------------------------------------- update
+    # ------------------------------------------------------------- flat-buffer update
+    def _kernel_step(self, lo: int, hi: int, grads_flat, step, lr, beta1, beta2, eps,
+                     weight_decay, grad_scale=1.0, out_bf16=None):
+        """One Adam step over buffer range [lo, hi) (native kernel or numpy)."""
+        n = hi - lo
+        if n <= 0:
+            return
+        if self._lib is not None:
+            p = self.fp32[lo:hi]
+            g = grads_flat[lo:hi] if grads_flat.size != n else grads_flat
+            m = self.exp_avg[lo:hi]
+            v = self.exp_avg_sq[lo:hi]
+            if out_bf16 is not None:
+                import ctypes
+                self._lib.ds_adam_step_copy(
+                    _ptr(p), _ptr(g), _ptr(m), _ptr(v),
+                    out_bf16.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+                    n, int(step), float(lr), float(beta1), float(beta2), float(eps),
+                    float(weight_decay), float(grad_scale), int(self.adamw),
+                    int(self.bias_correction))
+            else:
+                self._lib.ds_adam_step(_ptr(p), _ptr(g), _ptr(m), _ptr(v), n, int(step),
+                                       float(lr), float(beta1), float(beta2), float(eps),
+                                       float(weight_decay), float(grad_scale),
+                                       int(self.adamw), int(self.bias_correction))
+        else:
+            g = grads_flat[lo:hi] if grads_flat.size != n else grads_flat
+            self._numpy_step(lo, hi, g, step, lr, beta1, beta2, eps, weight_decay,
+                             grad_scale)
+            if out_bf16 is not None:
+                np.copyto(out_bf16.view(_BF16), self.fp32[lo:hi], casting="unsafe")
+
     def step(self, grads_flat: np.ndarray, step: int, lr: float, beta1: float = 0.9,
-             beta2: float = 0.999, eps: float = 1e-8, weight_decay: float = 0.0):
-        """One in-place Adam step over the flat master buffer."""
+             beta2: float = 0.999, eps: float = 1e-8, weight_decay: float = 0.0,
+             grad_scale: float = 1.0):
+        """One in-place Adam step over the whole flat master buffer."""
         assert grads_flat.size == self.numel
         grads_flat = np.ascontiguousarray(grads_flat, np.float32)
-        if self._lib is not None:
-            self._lib.ds_adam_step(_ptr(self.fp32), _ptr(grads_flat), _ptr(self.exp_avg),
-                                   _ptr(self.exp_avg_sq), self.numel, int(step), float(lr),
-                                   float(beta1), float(beta2), float(eps), float(weight_decay),
-                                   int(self.adamw), int(self.bias_correction))
-        else:
-            self._numpy_step(grads_flat, step, lr, beta1, beta2, eps, weight_decay)
+        self._kernel_step(0, self.numel, grads_flat, step, lr, beta1, beta2, eps,
+                          weight_decay, grad_scale)
 
     def step_and_cast_bf16(self, grads_flat: np.ndarray, step: int, lr: float,
                            beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-8,
-                           weight_decay: float = 0.0) -> np.ndarray:
+                           weight_decay: float = 0.0, grad_scale: float = 1.0) -> np.ndarray:
         """Fused step + bf16 cast; returns the (numel,) bf16 staging buffer (a view)."""
         assert grads_flat.size == self.numel
         if _BF16 is None:  # jax depends on ml_dtypes, so this is effectively unreachable
             raise RuntimeError("bf16 offload push requires ml_dtypes")
         grads_flat = np.ascontiguousarray(grads_flat, np.float32)
-        if self._lib is not None:
-            import ctypes
-            if self._bf16 is None:
-                self._bf16 = np.empty(self.numel, np.uint16)
-            self._lib.ds_adam_step_copy(_ptr(self.fp32), _ptr(grads_flat), _ptr(self.exp_avg),
-                                        _ptr(self.exp_avg_sq),
-                                        self._bf16.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
-                                        self.numel, int(step), float(lr), float(beta1),
-                                        float(beta2), float(eps), float(weight_decay),
-                                        int(self.adamw), int(self.bias_correction))
-            return self._bf16.view(_BF16)
-        self._numpy_step(grads_flat, step, lr, beta1, beta2, eps, weight_decay)
-        return self.fp32.astype(_BF16)
+        if self._bf16 is None:
+            self._bf16 = np.empty(self.numel, np.uint16)
+        self._kernel_step(0, self.numel, grads_flat, step, lr, beta1, beta2, eps,
+                          weight_decay, grad_scale, out_bf16=self._bf16)
+        return self._bf16.view(_BF16)
 
-    def _numpy_step(self, g, step, lr, beta1, beta2, eps, weight_decay):
+    def _numpy_step(self, lo, hi, g, step, lr, beta1, beta2, eps, weight_decay,
+                    grad_scale=1.0):
         bc1 = 1.0 - beta1 ** step if self.bias_correction else 1.0
         bc2 = 1.0 - beta2 ** step if self.bias_correction else 1.0
-        m, v, p = self.exp_avg, self.exp_avg_sq, self.fp32
+        m, v, p = self.exp_avg[lo:hi], self.exp_avg_sq[lo:hi], self.fp32[lo:hi]
+        g = np.asarray(g, np.float32)
+        if grad_scale != 1.0:
+            g = g * grad_scale
         if not self.adamw:
             # classic L2 Adam: decay enters the gradient before the moments
             g = g + weight_decay * p
@@ -143,6 +276,90 @@ class DeepSpeedCPUAdam:
         else:
             p -= lr * update
 
+    # ------------------------------------------------------------- overlapped engine path
+    def begin_grad_fetch(self, grads_tree):
+        """Initiate async D2H of every local grad region; returns opaque handles for
+        ``step_regions``. Transfers overlap whatever runs next (device compute, the
+        norm/overflow stats jit, earlier regions' host Adam)."""
+        gleaves = jax.tree_util.tree_leaves(grads_tree)
+        handles = []
+        for li, regions in enumerate(self._leaf_regions):
+            g = gleaves[li]
+            shard_by_dev = None
+            if isinstance(g, jax.Array) and regions[0].devices is not None:
+                shard_by_dev = {s.device: s for s in g.addressable_shards}
+            for r in regions:
+                if shard_by_dev is not None:
+                    s = shard_by_dev.get(r.devices[0])
+                    if s is not None and tuple(s.data.shape) == r.shape:
+                        s.data.copy_to_host_async()
+                        handles.append(("shard", s.data, r))
+                        continue
+                # layout mismatch (e.g. XLA-chosen grad layouts under cpu-checkpointing):
+                # fall back to a host slice of the full leaf
+                if isinstance(g, jax.Array):
+                    g.copy_to_host_async()
+                handles.append(("leaf", g, r))
+        return handles
+
+    def step_regions(self, handles, step: int, lr: float, beta1: float = 0.9,
+                     beta2: float = 0.999, eps: float = 1e-8, weight_decay: float = 0.0,
+                     grad_scale: float = 1.0, out_dtype=np.float32):
+        """Partitioned, overlapped step: wait-per-region D2H -> native Adam -> async H2D
+        push of the updated compute-dtype slice. Returns the tree of GLOBAL jax arrays
+        (one per leaf, carrying the construction sharding) in ``out_dtype``."""
+        out_np = np.dtype(out_dtype)
+        use_fused_bf16 = (_BF16 is not None and out_np == np.dtype(_BF16))
+        t_fetch = t_adam = t_push = 0.0
+        t0 = time.perf_counter()
+        pieces = [dict() for _ in self._leaf_regions]  # leaf -> {device: jax.Array}
+        host_leaves = [None] * len(self._leaf_regions)
+        for kind, data, r in handles:
+            t = time.perf_counter()
+            if kind == "shard":
+                h = np.asarray(data)  # blocks until this region's copy lands
+            else:
+                if host_leaves[r.leaf] is None:
+                    host_leaves[r.leaf] = np.asarray(jax.device_get(data), np.float32)
+                h = host_leaves[r.leaf][r.slices]
+            lo, hi = r.offset, r.offset + r.size
+            self._grad_buf[lo:hi] = np.asarray(h, np.float32).reshape(-1)
+            t_fetch += time.perf_counter() - t
+
+            t = time.perf_counter()
+            if use_fused_bf16:
+                out_seg = np.empty(r.size, np.uint16)
+                self._kernel_step(lo, hi, self._grad_buf, step, lr, beta1, beta2, eps,
+                                  weight_decay, grad_scale, out_bf16=out_seg)
+                out_host = out_seg.view(_BF16).reshape(r.shape)
+            else:
+                self._kernel_step(lo, hi, self._grad_buf, step, lr, beta1, beta2, eps,
+                                  weight_decay, grad_scale)
+                out_host = self.fp32[lo:hi].astype(out_np).reshape(r.shape)
+            t_adam += time.perf_counter() - t
+
+            t = time.perf_counter()
+            if r.devices is None:
+                pieces[r.leaf][None] = out_host
+            else:
+                for dev in r.devices:
+                    pieces[r.leaf][dev] = jax.device_put(out_host, dev)  # async H2D
+            t_push += time.perf_counter() - t
+
+        t = time.perf_counter()
+        out = []
+        for li, (shape, sh) in enumerate(zip(self._shapes, self._shardings)):
+            if sh is None:
+                out.append(pieces[li][None])
+                continue
+            dmap = sh.addressable_devices_indices_map(tuple(shape))
+            arrs = [pieces[li][d] for d in dmap]
+            out.append(jax.make_array_from_single_device_arrays(shape, sh, arrs))
+        t_push += time.perf_counter() - t
+        self.last_step_timing = {"fetch_wait": t_fetch, "host_adam": t_adam,
+                                 "push": t_push, "total": time.perf_counter() - t0}
+        return jax.tree_util.tree_unflatten(self._treedef, out)
+
     # ------------------------------------------------------------- checkpoint plumbing
     def load_flat(self, fp32: Optional[np.ndarray] = None, exp_avg: Optional[np.ndarray] = None,
                   exp_avg_sq: Optional[np.ndarray] = None):
@@ -150,18 +367,14 @@ class DeepSpeedCPUAdam:
             if src is not None:
                 np.copyto(dst, np.asarray(src, np.float32).reshape(-1))
 
-    def cast_fp16(self) -> np.ndarray:
-        """fp32 master → persistent fp16 staging buffer (no per-step allocation)."""
-        if self._fp16 is None:
-            self._fp16 = np.empty(self.numel, np.float16)
-        np.copyto(self._fp16, self.fp32, casting="unsafe")
-        return self._fp16
-
     def load_trees(self, master_tree=None, exp_avg_tree=None, exp_avg_sq_tree=None):
-        def cat(tree):
+        """Scatter full trees into the local flat buffers (region-wise)."""
+        for buf, tree in ((self.fp32, master_tree), (self.exp_avg, exp_avg_tree),
+                          (self.exp_avg_sq, exp_avg_sq_tree)):
             if tree is None:
-                return None
-            # one batched D2H for trees that still hold device arrays
+                continue
             leaves = jax.device_get(jax.tree_util.tree_leaves(tree))
-            return np.concatenate([np.asarray(l, np.float32).reshape(-1) for l in leaves])
-        self.load_flat(cat(master_tree), cat(exp_avg_tree), cat(exp_avg_sq_tree))
+            for li, regions in enumerate(self._leaf_regions):
+                full = np.asarray(leaves[li], np.float32)
+                for r in regions:
+                    buf[r.offset:r.offset + r.size] = full[r.slices].reshape(-1)

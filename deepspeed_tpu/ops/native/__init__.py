@@ -83,8 +83,8 @@ def load_cpu_adam():
                 f32p = ctypes.POINTER(ctypes.c_float)
                 u16p = ctypes.POINTER(ctypes.c_uint16)
                 common = [ctypes.c_int64, ctypes.c_int32, ctypes.c_float, ctypes.c_float,
-                          ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int32,
-                          ctypes.c_int32]
+                          ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                          ctypes.c_int32, ctypes.c_int32]
                 lib.ds_adam_step.argtypes = [f32p, f32p, f32p, f32p] + common
                 lib.ds_adam_step.restype = None
                 lib.ds_adam_step_copy.argtypes = [f32p, f32p, f32p, f32p, u16p] + common

@@ -22,6 +22,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 try:  # pltpu is importable on CPU too (interpret mode), but guard anyway
@@ -33,31 +34,94 @@ except ImportError:  # pragma: no cover
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
-def dense_attention(q, k, v, causal=False, sm_scale=None):
-    """Reference dense attention ([B,H,T,D] inputs), fp32 softmax."""
+def dense_attention(q, k, v, causal=False, sm_scale=None, bias=None, dropout_keep=None):
+    """Reference dense attention ([B,H,T,D] inputs), fp32 softmax.
+
+    ``bias``: additive key bias [B, 1, T_k] (the BERT padding mask).
+    ``dropout_keep``: pre-scaled multiplicative mask on the post-softmax probs
+    (e.g. from ``dropout_keep_reference``) — the numerics oracle for the kernel.
+    """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * sm_scale
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)[:, :, None, :]  # [B,1,1,Tk]
     if causal:
         T = q.shape[2]
         mask = jnp.tril(jnp.ones((T, T), jnp.bool_))
         scores = jnp.where(mask, scores, DEFAULT_MASK_VALUE)
     probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_keep is not None:
+        probs = probs * dropout_keep.astype(probs.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v,
                       preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# in-kernel attention dropout
+# ---------------------------------------------------------------------------
+# Stateless counter-based dropout: a lowbias32-style integer avalanche over the
+# ABSOLUTE coordinate (batch*head, q position, k position) plus the step seed. Because
+# the bits depend only on coordinates — never on block shapes or grid order — the
+# forward kernel and both backward kernels regenerate bit-identical masks, remat
+# replays them exactly (the seed is a traced operand), and a pure-jnp oracle
+# (``dropout_keep_reference``) exists for parity tests. This replaces the reference's
+# CUDA RNG state tracker + curand path (csrc/transformer/dropout_kernels.cu).
+
+def _dropout_bits(seed_u32, bh_u32, q_pos, k_pos):
+    """uint32 hash; inputs broadcast, q_pos/k_pos int32 arrays."""
+    x = (q_pos.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+         + k_pos.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+         + bh_u32 * jnp.uint32(0xC2B2AE3D)
+         + seed_u32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _keep_threshold(rate: float) -> int:
+    return min(int(rate * 4294967296.0), 4294967295)
+
+
+def dropout_keep_reference(seed, B, H, T_q, T_k, rate: float):
+    """[B, H, T_q, T_k] pre-scaled keep mask identical to the in-kernel stream."""
+    seed_u32 = jnp.asarray(seed, jnp.int32).reshape(()).astype(jnp.uint32)
+    bh = jnp.arange(B * H, dtype=jnp.uint32)[:, None, None]
+    qp = jnp.arange(T_q, dtype=jnp.int32)[None, :, None]
+    kp = jnp.arange(T_k, dtype=jnp.int32)[None, None, :]
+    bits = _dropout_bits(seed_u32, bh, qp, kp)
+    keep = (bits >= jnp.uint32(_keep_threshold(rate))).astype(jnp.float32)
+    return (keep / (1.0 - rate)).reshape(B, H, T_q, T_k)
 
 
 # ---------------------------------------------------------------------------
 # forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_k, seq_len):
+def _fwd_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, threshold):
+    i = 0
+    seed_ref = None
+    bias_ref = None
+    if rate > 0:
+        seed_ref = refs[i]
+        i += 1
+    if has_bias:
+        bias_ref = refs[i]
+        i += 1
+    q_ref, k_ref, v_ref, o_ref, lse_ref = refs[i:]
     bq = q_ref.shape[0]
     d = q_ref.shape[1]
     q_blk_idx = pl.program_id(1)
     # keep MXU operands in the input dtype (bf16): bf16-in/fp32-accumulate is the MXU's
     # native mode — upcasting to fp32 before the dot ran the matmuls many times slower
     q = q_ref[...]
+    if rate > 0:
+        seed_u32 = seed_ref[0].astype(jnp.uint32)
+        bh_u32 = pl.program_id(0).astype(jnp.uint32)
+        inv_keep = 1.0 / (1.0 - rate)
 
     num_k_blocks = pl.cdiv(seq_len, block_k)
     if causal:
@@ -75,15 +139,25 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
         k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
         v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
-        if causal:
+        if has_bias:
+            s = s + bias_ref[:, pl.ds(kb * block_k, block_k)]  # [1, bk] broadcast
+        if causal or rate > 0:
             q_pos = q_blk_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        if causal:
             s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
+        # the normalizer uses the UNdropped probabilities (torch dropout(softmax(s)))
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.dot(p.astype(v_blk.dtype), v_blk,
+        if rate > 0:
+            bits = _dropout_bits(seed_u32, bh_u32, q_pos, k_pos)
+            keep = (bits >= jnp.uint32(threshold)).astype(jnp.float32) * inv_keep
+            p_eff = p * keep
+        else:
+            p_eff = p
+        acc_new = acc * alpha + jnp.dot(p_eff.astype(v_blk.dtype), v_blk,
                                         preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -93,7 +167,27 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
     lse_ref[...] = (m + jnp.log(l)).reshape(1, bq)
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+def _aux_operands(seed, bias, B, H, T, rate, block_k_map=None):
+    """(operands, in_specs) for the optional seed/bias inputs shared by all kernels.
+
+    ``block_k_map``: None -> each grid cell sees the full [1, T] bias row; otherwise a
+    (block, index_map) pair for k-blocked bias tiles.
+    """
+    operands, specs = [], []
+    if rate > 0:
+        operands.append(jnp.asarray(seed, jnp.int32).reshape(1))
+        specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    if bias is not None:
+        operands.append(jnp.asarray(bias, jnp.float32).reshape(B, 1, T))
+        if block_k_map is None:
+            specs.append(pl.BlockSpec((None, 1, T), lambda b, i, H=H: (b // H, 0, 0)))
+        else:
+            blk, imap = block_k_map
+            specs.append(pl.BlockSpec((None, 1, blk), imap))
+    return operands, specs
+
+
+def _flash_fwd(q, k, v, seed, bias, sm_scale, causal, rate, block_q, block_k, interpret):
     B, H, T, D = q.shape
     grid = (B * H, pl.cdiv(T, block_q))
     q3 = q.reshape(B * H, T, D)
@@ -101,11 +195,13 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     v3 = v.reshape(B * H, T, D)
 
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                               block_k=block_k, seq_len=T)
+                               block_k=block_k, seq_len=T, has_bias=bias is not None,
+                               rate=rate, threshold=_keep_threshold(rate))
+    aux, aux_specs = _aux_operands(seed, bias, B, H, T, rate)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
+        in_specs=aux_specs + [
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
@@ -121,7 +217,7 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((B * H, 1, T), jnp.float32),
         ],
         interpret=interpret,
-    )(q3, k3, v3)
+    )(*aux, q3, k3, v3)
     return out.reshape(B, H, T, D), lse.reshape(B, H, T)
 
 
@@ -129,14 +225,26 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 # backward kernels
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   sm_scale, causal, block_k, seq_len):
+def _bwd_dq_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, threshold):
+    i = 0
+    seed_ref = bias_ref = None
+    if rate > 0:
+        seed_ref = refs[i]
+        i += 1
+    if has_bias:
+        bias_ref = refs[i]
+        i += 1
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref = refs[i:]
     bq, d = q_ref.shape
     q_blk_idx = pl.program_id(1)
     q = q_ref[...]      # input dtype: bf16-in/fp32-out MXU dots (see _fwd_kernel note)
     do = do_ref[...]
     lse = lse_ref[...].reshape(bq, 1)
     delta = delta_ref[...].reshape(bq, 1)
+    if rate > 0:
+        seed_u32 = seed_ref[0].astype(jnp.uint32)
+        bh_u32 = pl.program_id(0).astype(jnp.uint32)
+        inv_keep = 1.0 / (1.0 - rate)
 
     num_k_blocks = pl.cdiv(seq_len, block_k)
     if causal:
@@ -148,12 +256,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
         v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
-        if causal:
+        if has_bias:
+            s = s + bias_ref[:, pl.ds(kb * block_k, block_k)]
+        if causal or rate > 0:
             q_pos = q_blk_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        if causal:
             s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        if rate > 0:
+            bits = _dropout_bits(seed_u32, bh_u32, q_pos, k_pos)
+            dp = dp * ((bits >= jnp.uint32(threshold)).astype(jnp.float32) * inv_keep)
         ds = p * (dp - delta)
         return dq + jnp.dot(ds.astype(k_blk.dtype), k_blk, preferred_element_type=jnp.float32)
 
@@ -161,12 +275,24 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[...] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
-                    sm_scale, causal, block_q, seq_len):
+def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, seq_len, has_bias, rate, threshold):
+    i = 0
+    seed_ref = bias_ref = None
+    if rate > 0:
+        seed_ref = refs[i]
+        i += 1
+    if has_bias:
+        bias_ref = refs[i]
+        i += 1
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref = refs[i:]
     bk, d = k_ref.shape
     k_blk_idx = pl.program_id(1)
     k = k_ref[...]      # input dtype: bf16-in/fp32-out MXU dots (see _fwd_kernel note)
     v = v_ref[...]
+    if rate > 0:
+        seed_u32 = seed_ref[0].astype(jnp.uint32)
+        bh_u32 = pl.program_id(0).astype(jnp.uint32)
+        inv_keep = 1.0 / (1.0 - rate)
 
     num_q_blocks = pl.cdiv(seq_len, block_q)
     if causal:
@@ -181,14 +307,25 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q)].reshape(block_q, 1)
         delta_blk = delta_ref[0, pl.ds(qb * block_q, block_q)].reshape(block_q, 1)
         s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
-        if causal:
+        if has_bias:
+            s = s + bias_ref[...]  # [1, bk]: this k-block's bias tile
+        if causal or rate > 0:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
             k_pos = k_blk_idx * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+        if causal:
             s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse_blk)
-        dv_new = dv + jnp.dot(p.T.astype(do_blk.dtype), do_blk,
+        if rate > 0:
+            bits = _dropout_bits(seed_u32, bh_u32, q_pos, k_pos)
+            keep = (bits >= jnp.uint32(threshold)).astype(jnp.float32) * inv_keep
+            p_drop = p * keep
+        else:
+            p_drop = p
+        dv_new = dv + jnp.dot(p_drop.T.astype(do_blk.dtype), do_blk,
                               preferred_element_type=jnp.float32)
         dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+        if rate > 0:
+            dp = dp * keep
         ds = p * (dp - delta_blk)
         dk_new = dk + jnp.dot(ds.T.astype(q_blk.dtype), q_blk,
                               preferred_element_type=jnp.float32)
@@ -200,11 +337,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
+def _flash_bwd(res, g, seed, bias, sm_scale, causal, rate, block_q, block_k, interpret):
     q, k, v, out, lse = res
     B, H, T, D = q.shape
     do = g
-    # delta = rowsum(do * o): the softmax-normalization correction term
+    # delta = rowsum(do * o): the softmax-normalization correction term (valid under
+    # dropout too: do.o = sum_j probs_j * keep_j * (do.v_j) = sum_j probs_j * dprobs_j)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [B,H,T]
 
     q3 = q.reshape(B * H, T, D)
@@ -213,12 +351,15 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
     do3 = do.reshape(B * H, T, D)
     lse3 = lse.reshape(B * H, 1, T)
     delta3 = delta.reshape(B * H, 1, T)
+    has_bias = bias is not None
 
+    aux, aux_specs = _aux_operands(seed, bias, B, H, T, rate)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_k=block_k, seq_len=T),
+                          block_k=block_k, seq_len=T, has_bias=has_bias, rate=rate,
+                          threshold=_keep_threshold(rate)),
         grid=(B * H, pl.cdiv(T, block_q)),
-        in_specs=[
+        in_specs=aux_specs + [
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
@@ -229,13 +370,18 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
         out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
         interpret=interpret,
-    )(q3, k3, v3, do3, lse3, delta3)
+    )(*aux, q3, k3, v3, do3, lse3, delta3)
 
+    # the dkv grid iterates k-blocks, so its bias operand is tiled per k-block
+    aux2, aux2_specs = _aux_operands(
+        seed, bias, B, H, T, rate,
+        block_k_map=(block_k, lambda b, i, H=H: (b // H, 0, i)))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, seq_len=T),
+                          block_q=block_q, seq_len=T, has_bias=has_bias, rate=rate,
+                          threshold=_keep_threshold(rate)),
         grid=(B * H, pl.cdiv(T, block_k)),
-        in_specs=[
+        in_specs=aux2_specs + [
             pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
@@ -252,7 +398,7 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
         ],
         interpret=interpret,
-    )(q3, k3, v3, do3, lse3, delta3)
+    )(*aux2, q3, k3, v3, do3, lse3, delta3)
 
     return dq.reshape(B, H, T, D), dk.reshape(B, H, T, D), dv.reshape(B, H, T, D)
 
@@ -261,11 +407,11 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def flash_attention(q, k, v, causal: bool = False, sm_scale: Optional[float] = None,
-                    block_q: int = 256, block_k: int = 512, interpret: Optional[bool] = None):
-    """Blocked flash attention on [B, H, T, D] tensors. Differentiable."""
-    out, _ = _flash_attention_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_attention_core(q, k, v, bias, seed, causal, sm_scale, rate, block_q, block_k,
+                          interpret):
+    out, _ = _core_fwd_rule(q, k, v, bias, seed, causal, sm_scale, rate, block_q, block_k,
+                            interpret)
     return out
 
 
@@ -288,19 +434,51 @@ def _resolve(q, sm_scale, block_q, block_k, interpret):
     return sm_scale, block_q, block_k, interpret
 
 
-def _flash_attention_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _core_fwd_rule(q, k, v, bias, seed, causal, sm_scale, rate, block_q, block_k,
+                   interpret):
     sm_scale_, bq, bk, interp = _resolve(q, sm_scale, block_q, block_k, interpret)
     assert q.shape[2] % bq == 0 and q.shape[2] % bk == 0, \
         f"seq_len {q.shape[2]} must be divisible by block sizes ({bq}, {bk})"
-    out, lse = _flash_fwd(q, k, v, sm_scale_, causal, bq, bk, interp)
-    return out, (q, k, v, out, lse)
+    out, lse = _flash_fwd(q, k, v, seed, bias, sm_scale_, causal, rate, bq, bk, interp)
+    return out, (q, k, v, out, lse, bias, seed)
 
 
-def _flash_attention_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, g):
-    q = res[0]
+def _core_bwd_rule(causal, sm_scale, rate, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse, bias, seed = res
     sm_scale_, bq, bk, interp = _resolve(q, sm_scale, block_q, block_k, interpret)
-    dq, dk, dv = _flash_bwd(res, g, sm_scale_, causal, bq, bk, interp)
-    return dq, dk, dv
+    dq, dk, dv = _flash_bwd((q, k, v, out, lse), g, seed, bias, sm_scale_, causal, rate,
+                            bq, bk, interp)
+    # bias is the (non-trainable) padding mask: cotangent is zero by contract; seed is
+    # integer-valued, whose tangent space is float0
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    dseed = None if seed is None else np.zeros(np.shape(seed), jax.dtypes.float0)
+    return dq, dk, dv, dbias, dseed
 
 
-flash_attention.defvjp(_flash_attention_fwd_rule, _flash_attention_bwd_rule)
+_flash_attention_core.defvjp(_core_fwd_rule, _core_bwd_rule)
+
+
+def flash_attention(q, k, v, causal: bool = False, sm_scale: Optional[float] = None,
+                    block_q: int = 256, block_k: int = 512, interpret: Optional[bool] = None,
+                    bias=None, dropout_rate: float = 0.0, dropout_seed=None):
+    """Blocked flash attention on [B, H, T, D] tensors. Differentiable in q/k/v.
+
+    ``bias``: optional additive key bias, any shape squeezable to [B, T_k] (the BERT
+    padding mask [B,1,1,T] included) — fused into the in-kernel softmax, replacing the
+    reference's scale+mask softmax kernel (csrc/transformer/softmax_kernels.cu).
+    ``dropout_rate``/``dropout_seed``: in-kernel attention dropout over the post-softmax
+    probabilities (csrc/transformer/dropout_kernels.cu); the seed is a traced operand so
+    remat replays identical masks. ``dropout_keep_reference`` reproduces the exact mask
+    for parity tests.
+    """
+    rate = float(dropout_rate)
+    if rate > 0:
+        assert dropout_seed is not None, "dropout_rate > 0 requires a dropout_seed"
+        seed = jnp.asarray(dropout_seed, jnp.int32).reshape(())
+    else:
+        seed = None
+    if bias is not None:
+        B, T_k = q.shape[0], k.shape[2]
+        bias = jnp.asarray(bias, jnp.float32).reshape(B, 1, T_k)
+    return _flash_attention_core(q, k, v, bias, seed, bool(causal), sm_scale, rate,
+                                 block_q, block_k, interpret)

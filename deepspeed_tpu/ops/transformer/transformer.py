@@ -199,11 +199,30 @@ class DeepSpeedTransformerLayer:
         k = k.reshape(B, T, heads, d).transpose(0, 2, 1, 3)
         v = v.reshape(B, T, heads, d).transpose(0, 2, 1, 3)
 
-        use_flash = (c.use_flash_attention and attention_mask is None
-                     and (c.attn_dropout_ratio <= 0 or deterministic))
+        # flash handles the BERT-style additive key mask ([B,1,1,T] / [B,T]) and
+        # train-mode attention dropout in-kernel; only a full [B,·,Tq,Tk] mask (rare:
+        # per-query masking) falls back to the dense path.
+        mask_ok = attention_mask is None or (
+            (attention_mask.ndim == 2 and attention_mask.shape == (B, T)) or
+            (attention_mask.ndim == 4 and attention_mask.shape[0] == B
+             and attention_mask.shape[1] == 1 and attention_mask.shape[2] == 1
+             and attention_mask.shape[3] == T))
+        dropout_active = (not deterministic and c.attn_dropout_ratio > 0
+                          and rng is not None)
+        use_flash = c.use_flash_attention and mask_ok
         if use_flash:
             from ..pallas.flash_attention import flash_attention
-            ctx = flash_attention(q, k, v, False)
+            bias = None
+            if attention_mask is not None:
+                bias = attention_mask.astype(jnp.float32).reshape(B, 1, T)
+            rate, seed = 0.0, None
+            if dropout_active:
+                rng, sub = jax.random.split(rng)
+                seed = jax.random.randint(sub, (), 0, jnp.iinfo(jnp.int32).max,
+                                          dtype=jnp.int32)
+                rate = float(c.attn_dropout_ratio)
+            ctx = flash_attention(q, k, v, False, bias=bias, dropout_rate=rate,
+                                  dropout_seed=seed)
         else:
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                                 preferred_element_type=jnp.float32) / math.sqrt(d)

@@ -231,22 +231,21 @@ class DeepSpeedEngine:
 
         # ---- ZeRO-Offload: master weights + optimizer state live in host DRAM ----
         # (reference stage2.py:333-349 keeps fp32 master/grads pinned on host and steps
-        # DeepSpeedCPUAdam there; on a TPU-VM "host" is the VM's DRAM tier)
+        # DeepSpeedCPUAdam there; on a TPU-VM "host" is the VM's DRAM tier). The host
+        # buffers are PARTITIONED by the ZeRO master layout: each process stores and
+        # steps only the regions its addressable devices own (the reference's
+        # per-DP-rank single_partition_of_fp32_groups, stage2.py:750-907), so offload
+        # composes with multi-host runs and per-host DRAM/compute scale as 1/dp.
         self._offload = None
         if self.zero_optimization() and self.zero_cpu_offload():
-            # The host-tier path device_gets sharded grads and steps a full master
-            # copy on this host; under a multi-process world those arrays span
-            # non-addressable devices. Fail fast rather than at the first step.
-            assert jax.process_count() == 1, \
-                "cpu_offload currently requires a single-process (single-host) run"
             from ..ops.cpu_adam import DeepSpeedCPUAdam
             # non-Adam optimizers are rejected later by _configure_optimizer's
             # Adam/AdamW assert; absent optimizer block defaults to "adam" (L2),
             # matching the _OPTIMIZER_APPLY default for the non-offload path
             _offload_name = self.config.optimizer_name or ADAM_OPTIMIZER
             self._offload = DeepSpeedCPUAdam(master_fp32,
-                                             adamw=(_offload_name == ADAMW_OPTIMIZER))
-            self.master_params = self._offload.params_tree()  # zero-copy host views
+                                             adamw=(_offload_name == ADAMW_OPTIMIZER),
+                                             shardings=self._master_shardings)
         else:
             self.master_params = jax.device_put(master_fp32, self._master_shardings)
         self.params = jax.device_put(
@@ -309,6 +308,33 @@ class DeepSpeedEngine:
         if self.config.dump_state:
             self.config.print("DeepSpeedEngine configuration")
 
+    # ------------------------------------------------------------------ state views
+    # Under ZeRO-Offload the fp32 master and Adam moments live in the host-tier flat
+    # buffers; these properties materialize fresh tree views on access so checkpointing
+    # always sees the current state (leaf views alias the flat buffers where the region
+    # layout is contiguous, and are assembled copies otherwise).
+    @property
+    def master_params(self):
+        if getattr(self, "_offload", None) is not None:
+            return self._offload.params_tree()
+        return self._master_params_store
+
+    @master_params.setter
+    def master_params(self, value):
+        self._master_params_store = value
+
+    @property
+    def opt_state(self):
+        if getattr(self, "_offload", None) is not None:
+            from ..ops.adam import AdamState
+            return AdamState(exp_avg=self._offload.exp_avg_tree(),
+                             exp_avg_sq=self._offload.exp_avg_sq_tree())
+        return self._opt_state_store
+
+    @opt_state.setter
+    def opt_state(self, value):
+        self._opt_state_store = value
+
     # ------------------------------------------------------------------ config accessors
     def train_batch_size(self):
         return self.config.train_batch_size
@@ -369,12 +395,9 @@ class DeepSpeedEngine:
             assert client_optimizer is None or isinstance(client_optimizer, str), \
                 "ZeRO-Offload steps the host-side DeepSpeedCPUAdam; client optimizers unsupported"
             self.optimizer = OptimizerHandle(name, self.config.optimizer_params or {})
-            from ..ops.adam import AdamState
-            self.opt_state = AdamState(exp_avg=self._offload.exp_avg_tree(),
-                                       exp_avg_sq=self._offload.exp_avg_sq_tree())
             log_dist("Using ZeRO-Offload: host-tier DeepSpeedCPUAdam "
                      f"({'native' if self._offload._lib is not None else 'numpy'} kernel, "
-                     f"{self._offload.numel} master elements)", ranks=[0])
+                     f"{self._offload.numel} local master elements)", ranks=[0])
             return
         if client_optimizer is not None and not isinstance(client_optimizer, str):
             # client-provided (init, apply) pair or OptimizerHandle-compatible object
@@ -587,7 +610,28 @@ class DeepSpeedEngine:
             return new_master, new_opt, new_scaler, new_params, overflow, norm
 
         if self._offload is not None:
-            return  # step happens on host (_take_model_step_offload); no jitted update
+            # Host-tier step: the only device work is (a) one cheap stats pass for the
+            # global grad norm + fp16 overflow flag (replicated scalars — XLA inserts
+            # the cross-host psum the reference did with allreduce, stage2.py:1399-1415)
+            # and (b) the all-gather that turns the pushed master-sharded compute-dtype
+            # partitions back into the replicated/caller param layout (the reference's
+            # all_gather of updated fp16 partitions, stage2.py:1441-1472).
+            scalar = NamedSharding(self.mesh, P())
+
+            def grad_stats(grads):
+                overflow = (has_inf_or_nan_tree(grads) if fp16
+                            else jnp.zeros((), jnp.bool_))
+                return global_norm(grads), overflow
+
+            self._jit_grad_stats = jax.jit(grad_stats, out_shardings=(scalar, scalar))
+            same_layout = all(
+                m.is_equivalent_to(p, l.ndim)
+                for m, p, l in zip(jax.tree_util.tree_leaves(self._master_shardings),
+                                   jax.tree_util.tree_leaves(self._param_shardings),
+                                   jax.tree_util.tree_leaves(self.params)))
+            self._jit_offload_push = (None if same_layout else jax.jit(
+                lambda t: t, out_shardings=self._param_shardings))
+            return  # no jitted optimizer update; Adam runs on the host tier
 
         scalar_shard = NamedSharding(self.mesh, P())
         self._jit_apply_update = jax.jit(
@@ -707,36 +751,48 @@ class DeepSpeedEngine:
         self._finish_step(self.fp16_enabled() and bool(jax.device_get(overflow)))
 
     def _offload_step(self) -> bool:
-        """Host-tier optimizer step (ZeRO-Offload): D2H grads, native CPU Adam over the
-        flat fp32 master buffer, H2D push of compute-dtype params (reference
-        stage2.py:1417-1424 + cpu_adam.cpp ds_adam_step_plus_copy)."""
-        grads_flat = self._offload.flatten_grads(self._grad_acc)  # D2H, fp32
+        """Host-tier optimizer step (ZeRO-Offload), partitioned and overlapped.
+
+        Order of operations (reference stage2.py:750-907 + cpu_adam.cpp
+        ds_adam_step_plus_copy):
+          1. initiate async D2H of every LOCAL grad region (overlaps the stats jit and
+             any still-running device work),
+          2. one device stats pass -> global grad norm + fp16 overflow (replicated
+             scalars; XLA emits the cross-host reduction),
+          3. region-pipelined host step: wait for that region's transfer, run the native
+             Adam kernel with loss-scale/clip fused in, async-push the updated
+             compute-dtype slice back to its devices,
+          4. one all-gather jit re-materializes the replicated/caller param layout from
+             the pushed master-sharded partitions.
+        Wall-clock ≈ max(D2H, host Adam) + all-gather instead of their sum.
+        """
+        handles = self._offload.begin_grad_fetch(self._grad_acc)
+        norm_dev, overflow_dev = self._jit_grad_stats(self._grad_acc)
         scale = float(jax.device_get(self.scaler_state.cur_scale))
-        overflow = bool(not np.all(np.isfinite(grads_flat))) if self.fp16_enabled() else False
+        overflow = bool(jax.device_get(overflow_dev)) if self.fp16_enabled() else False
+
+        factor = 1.0
         if scale != 1.0 and scale > 0:
-            grads_flat *= 1.0 / scale
+            factor = 1.0 / scale
         predivide = float(self.config.gradient_predivide_factor or 1.0)
         if self.config.prescale_gradients and predivide != 1.0:
-            grads_flat *= predivide
-        norm = float(np.linalg.norm(grads_flat))
+            factor *= predivide
+        norm = float(jax.device_get(norm_dev)) * factor
         self._last_grad_norm = norm
         clip = float(self.gradient_clipping() or 0.0)
         if clip > 0 and norm > clip:
-            grads_flat *= clip / (norm + 1e-6)
+            factor *= clip / (norm + 1e-6)
 
         if not overflow:
             g = self.optimizer.param_groups[0]
             step_count = self.global_steps + 1 - self.skipped_steps
-            kw = dict(lr=g["lr"], beta1=g["betas"][0], beta2=g["betas"][1], eps=g["eps"],
-                      weight_decay=g["weight_decay"])
-            if self.compute_dtype == jnp.bfloat16:
-                flat_out = self._offload.step_and_cast_bf16(grads_flat, step_count, **kw)
-            else:
-                self._offload.step(grads_flat, step_count, **kw)
-                flat_out = self._offload.fp32
-                if self.compute_dtype != jnp.float32:
-                    flat_out = self._offload.cast_fp16()
-            self.params = jax.device_put(self._offload.tree_of(flat_out), self._param_shardings)
+            out_dtype = np.dtype(self.compute_dtype)
+            pushed = self._offload.step_regions(
+                handles, step_count, lr=g["lr"], beta1=g["betas"][0], beta2=g["betas"][1],
+                eps=g["eps"], weight_decay=g["weight_decay"], grad_scale=factor,
+                out_dtype=out_dtype)
+            self.params = (pushed if self._jit_offload_push is None
+                           else self._jit_offload_push(pushed))
         self.scaler_state = ls.update(
             self.scaler_state, jnp.asarray(overflow), dynamic=self._dynamic_scale,
             scale_window=self.config.loss_scale_window, min_scale=self.config.min_loss_scale,

@@ -225,3 +225,76 @@ def test_engine_zero_offload_fp16_overflow_skips_step():
     # and a sane batch afterwards still trains
     losses = _train(engine, steps=3)
     assert np.isfinite(losses).all()
+
+
+def test_offload_partitioned_matches_device_engine():
+    """Partitioned offload (real ZeRO regions over the 8-device mesh) must track the
+    fully on-device ZeRO-2 engine: hidden_dim=64 makes the weight leaves big enough for
+    zero_spec to shard them, so the host tier steps 8 distinct regions per leaf."""
+    model = SimpleModel(hidden_dim=64)
+
+    def make(offload):
+        params = model.init(jax.random.PRNGKey(0))
+        cfg = simple_config(batch=8)
+        cfg["optimizer"] = {"type": "AdamW", "params": {"lr": 1e-2, "weight_decay": 0.01}}
+        cfg["zero_optimization"] = {"stage": 2, "cpu_offload": offload}
+        eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                                config_params=cfg)
+        return eng
+
+    e_host, e_dev = make(True), make(False)
+    if jax.device_count() > 1:
+        # the host tier really is partitioned: >1 region for the sharded weight leaves
+        assert any(len(r) > 1 for r in e_host._offload._leaf_regions)
+    data = random_dataset(8 * 10, 64)
+    for i in range(10):
+        xs = np.stack([data[i * 8 + j][0] for j in range(8)])
+        ys = np.stack([data[i * 8 + j][1] for j in range(8)])
+        for eng in (e_host, e_dev):
+            loss = eng(xs, ys)
+            eng.backward(loss)
+            eng.step()
+    host_params = jax.device_get(e_host.params)
+    dev_params = jax.device_get(e_dev.params)
+    for k in host_params:
+        # host (fma-ordered SIMD) vs XLA fused Adam drift compounds over 10 steps
+        np.testing.assert_allclose(np.asarray(host_params[k], np.float32),
+                                   np.asarray(dev_params[k], np.float32),
+                                   rtol=1e-2, atol=1e-4)
+    # master assembly agrees with the device master too
+    host_master = e_host.master_params
+    dev_master = jax.device_get(e_dev.master_params)
+    for k in host_master:
+        np.testing.assert_allclose(host_master[k], np.asarray(dev_master[k]),
+                                   rtol=1e-2, atol=1e-4)
+    t = e_host._offload.last_step_timing
+    assert t is not None and t["total"] > 0
+
+
+def test_region_layout_non_contiguous_assembly():
+    """A leaf sharded on a non-leading axis stores non-contiguous regions; assembly and
+    load_trees must still round-trip exactly."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >=2 devices")
+    mesh = Mesh(np.array(devs).reshape(len(devs), 1), ("data", "model"))
+    rng = np.random.default_rng(3)
+    params = {"w": rng.normal(size=(24, 8 * len(devs))).astype(np.float32)}
+    shard = {"w": NamedSharding(mesh, P(None, "data"))}  # axis-1: non-contiguous regions
+    opt = DeepSpeedCPUAdam(params, shardings=shard)
+    assert not opt._leaf_viewable[0]
+    got = opt.params_tree()
+    np.testing.assert_array_equal(got["w"], params["w"])
+    # round-trip through load_trees
+    new = {"w": rng.normal(size=params["w"].shape).astype(np.float32)}
+    opt.load_trees(master_tree=new)
+    np.testing.assert_array_equal(opt.params_tree()["w"], new["w"])
+    # a flat-buffer step over regions equals a whole-tree step
+    ref = DeepSpeedCPUAdam(params)
+    g = {"w": rng.normal(size=params["w"].shape).astype(np.float32)}
+    opt.load_trees(master_tree=params)
+    opt.step(opt.flatten_grads(g), step=1, lr=1e-2, weight_decay=0.01)
+    ref.step(ref.flatten_grads(g), step=1, lr=1e-2, weight_decay=0.01)
+    np.testing.assert_allclose(opt.params_tree()["w"], ref.params_tree()["w"],
+                               rtol=1e-6, atol=1e-7)
