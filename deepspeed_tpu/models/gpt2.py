@@ -184,6 +184,12 @@ class GPT2Model:
                 # replays identical masks
                 seed = jax.random.randint(dropout_rng, (), 0,
                                           jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+                if self.tp_axis is not None:
+                    # the kernel hashes the LOCAL head index; decorrelate the
+                    # model-parallel ranks (which see the same program_ids) by
+                    # folding the tp rank into the seed (int32 wraparound is fine)
+                    seed = seed + (jax.lax.axis_index(self.tp_axis) + 1) \
+                        * jnp.int32(-1640531527)  # 2654435761 as int32
                 rate = float(c.dropout)
             y = flash_attention(q, k, v, True, dropout_rate=rate, dropout_seed=seed)
         else:

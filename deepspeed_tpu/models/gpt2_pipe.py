@@ -43,6 +43,9 @@ class GPT2Pipe:
 
     def __init__(self, config: GPT2Config, num_stages: int, tp: int = 1):
         assert config.n_layer % num_stages == 0, "n_layer must divide evenly into stages"
+        # the tied vocab table shards over pipe: pad it to a stage multiple internally
+        # (padded logit columns are masked out of the vocab-parallel softmax)
+        self.vocab_pad = (config.vocab_size + num_stages - 1) // num_stages * num_stages
         self.config = config
         self.num_stages = num_stages
         self.layers_per_stage = config.n_layer // num_stages
@@ -50,6 +53,10 @@ class GPT2Pipe:
         self._dense = GPT2Model(config) if tp == 1 else GPT2Model(config).with_tp(MODEL_AXIS, tp)
 
     def _stack(self, flat) -> Dict[str, Any]:
+        flat = dict(flat)
+        if self.vocab_pad != self.config.vocab_size:
+            pad = self.vocab_pad - flat["wte"].shape[0]
+            flat["wte"] = jnp.pad(flat["wte"], ((0, pad), (0, 0)))
         blocks = flat.pop("blocks")
         if self.tp > 1:
             perm = qkv_tp_permutation(self.config.n_embd, self.tp)
@@ -85,7 +92,12 @@ class GPT2Pipe:
 
     def param_shardings(self, mesh, params):
         from jax.sharding import NamedSharding, PartitionSpec as P
+        # the tied vocab table is SHARDED over pipe (vocab-parallel embedding + head):
+        # per-rank param bytes ∝ 1/S including the embedding, and the tie costs nothing
+        # (reference TiedLayerSpec replicated it on first+last stage and all-reduced
+        # tied grads, runtime/pipe/module.py)
         io_sh = jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), params["io"])
+        io_sh["wte"] = NamedSharding(mesh, P(PIPE_AXIS, None))
         stage_specs = self._stacked_specs(params["stages"])
         stages_sh = jax.tree_util.tree_map(lambda spec: NamedSharding(mesh, spec), stage_specs,
                                            is_leaf=lambda x: isinstance(x, P))
@@ -104,39 +116,80 @@ class GPT2Pipe:
         x, _ = jax.lax.scan(lambda xx, lp: body(xx, lp), x, stage_params)
         return x
 
-    def _embed(self, tokens, io_params):
-        c = self.config
-        T = tokens.shape[-1]
-        pos = jnp.arange(T)
-        return (io_params["wte"][tokens].astype(c.compute_dtype) +
-                io_params["wpe"][pos].astype(c.compute_dtype))
+    def _vp_embed(self, tokens, io_params):
+        """Vocab-parallel embedding over the pipe axis (runs inside shard_map).
 
-    def _head_loss(self, y, io_params, labels_mb, mb):
+        ``io_params['wte']`` is this rank's [V/S, E] vocab slice: look up the ids that
+        land in the local range, zero the rest, psum over pipe (Megatron
+        VocabParallelEmbedding's structure, applied to the PIPE axis)."""
+        c = self.config
+        wte = io_params["wte"]
+        v_local = wte.shape[0]
+        s = jax.lax.axis_index(PIPE_AXIS)
+        local = tokens - s * v_local
+        ok = jnp.logical_and(local >= 0, local < v_local)
+        emb = wte[jnp.clip(local, 0, v_local - 1)].astype(c.compute_dtype)
+        emb = jnp.where(ok[..., None], emb, 0)
+        emb = jax.lax.psum(emb, PIPE_AXIS)
+        T = tokens.shape[-1]
+        return emb + io_params["wpe"][jnp.arange(T)].astype(c.compute_dtype)
+
+    def _vp_head_loss(self, y, io_params, labels_mb, mb):
+        """Vocab-parallel tied head + cross-entropy over the pipe axis, one micro-batch.
+
+        Runs on EVERY pipe rank against the psum-broadcast final activation
+        (``last_stage_collective=True``): each rank computes logits only for its
+        [V/S, E] vocab slice; softmax statistics and the correct-class logit combine
+        with pipe collectives (Megatron vocab-parallel cross-entropy, ported to the
+        pipe axis). Padded vocab columns (table padded to a stage multiple) are
+        masked out of the softmax."""
         c = self.config
         dense = self._dense
-        y = dense._layer_norm(y, io_params["ln_f"], c.layer_norm_epsilon)
-        logits = jnp.dot(y, io_params["wte"].T.astype(y.dtype), preferred_element_type=jnp.float32)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        wte = io_params["wte"]
+        v_local = wte.shape[0]
+        s = jax.lax.axis_index(PIPE_AXIS)
         labels = labels_mb[mb]
-        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-        return -jnp.mean(ll)
+        y = dense._layer_norm(y, io_params["ln_f"], c.layer_norm_epsilon)
+        logits = jnp.dot(y, wte.T.astype(y.dtype),
+                         preferred_element_type=jnp.float32)        # [B, T, V/S] fp32
+        if self.vocab_pad != c.vocab_size:
+            col = s * v_local + jnp.arange(v_local)
+            logits = jnp.where(col < c.vocab_size, logits, -1e30)
+        # stability shift only — cut the tangent BEFORE the collective (pmax has no
+        # JVP rule; the softmax max-subtraction cancels in the gradient anyway)
+        m = jax.lax.pmax(jax.lax.stop_gradient(jnp.max(logits, axis=-1)),
+                         PIPE_AXIS)                                 # [B, T]
+        sumexp = jax.lax.psum(jnp.sum(jnp.exp(logits - m[..., None]), axis=-1),
+                              PIPE_AXIS)
+        local_label = labels - s * v_local
+        ok = jnp.logical_and(local_label >= 0, local_label < v_local)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(local_label, 0, v_local - 1)[..., None], axis=-1)[..., 0]
+        ll = jax.lax.psum(jnp.where(ok, picked, 0.0), PIPE_AXIS)    # [B, T]
+        return jnp.mean(m + jnp.log(sumexp) - ll)
 
     # ---- training loss over micro-batches ----
     def loss(self, params, tokens_mb, labels_mb, *, mesh):
         """Mean LM loss over [M, B, T] micro-batches through the pipe-axis pipeline."""
+        from jax.sharding import PartitionSpec as P
         if self.tp > 1:
             tp_in_mesh = mesh.shape.get(MODEL_AXIS, 1)
             assert tp_in_mesh == self.tp, \
                 f"model constructed with tp={self.tp} but mesh model axis is {tp_in_mesh}"
         io = params["io"]
+        io_specs = {k: (P(PIPE_AXIS, None) if k == "wte" else P()) for k in io}
         return pipeline_apply(
             self._stage_fn,
             params["stages"],
             tokens_mb,
             mesh=mesh,
-            first_stage_fn=lambda toks, io_p: self._embed(toks, io_p),
+            first_stage_fn=lambda toks, io_p: self._vp_embed(toks, io_p),
             first_stage_args=(io,),
-            last_stage_fn=lambda y, io_p, labels, mb: self._head_loss(y, io_p, labels, mb),
+            first_stage_args_specs=(io_specs,),
+            last_stage_fn=lambda y, io_p, labels, mb: self._vp_head_loss(y, io_p, labels, mb),
+            last_stage_collective=True,
             last_stage_args=(io, labels_mb),
+            last_stage_args_specs=(
+                io_specs, P(None, "data") if labels_mb.ndim >= 2 else P()),
             stacked_param_specs=self._stacked_specs(params["stages"]),
         )

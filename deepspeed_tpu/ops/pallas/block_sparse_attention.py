@@ -62,9 +62,15 @@ def build_luts(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray, 
 # ---------------------------------------------------------------------------
 
 def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
-                   kbuf, vbuf, sems, *, sm_scale, causal, block, num_heads, nb):
-    """K/V stay in HBM; only the layout's active blocks are DMA'd in, double-buffered —
-    HBM traffic scales with density, not seq_len^2 (splash-attention structure)."""
+                   kbuf, vbuf, sems, *, sm_scale, causal, block, num_heads, nb, kwidth):
+    """K/V stay in HBM; only the layout's active blocks are DMA'd in — HBM traffic
+    scales with density, not seq_len^2 (splash-attention structure).
+
+    Blocks land LANE-CONCATENATED in VMEM ([D, A_pad*block] scratch), so the compute
+    loop consumes ``kwidth`` blocks per iteration as one [bq, kwidth*block] score tile:
+    MXU-shaped matmuls and 1/kwidth the loop/softmax-bookkeeping overhead — this is
+    what closed the round-1 gap where per-iteration fixed cost made 17%-density time
+    like dense."""
     b = pl.program_id(0)
     i = pl.program_id(1)
     h = b % num_heads
@@ -75,61 +81,74 @@ def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
     q = q_ref[...]
 
     n_active = counts_ref[row]
+    n_slots = ((n_active + kwidth - 1) // kwidth) * kwidth  # padded slots DMA block 0
 
-    # K/V arrive as [BH, nb, block, D]: DMA slices index only leading dims so the
-    # trailing (block, D) tile stays whole (Mosaic requires lane-aligned slices)
-    def start_dma(j, slot):
+    def start_dma(j):
         kb = cols_ref[row, j]
-        pltpu.make_async_copy(k_hbm.at[b, kb], kbuf.at[slot], sems.at[0, slot]).start()
-        pltpu.make_async_copy(v_hbm.at[b, kb], vbuf.at[slot], sems.at[1, slot]).start()
+        dst = pl.ds(j * block, block)
+        pltpu.make_async_copy(k_hbm.at[b, kb], kbuf.at[:, dst], sems.at[0, j]).start()
+        pltpu.make_async_copy(v_hbm.at[b, kb], vbuf.at[:, dst], sems.at[1, j]).start()
 
-    def wait_dma(j, slot):
+    def wait_dma(j):
         kb = cols_ref[row, j]
-        pltpu.make_async_copy(k_hbm.at[b, kb], kbuf.at[slot], sems.at[0, slot]).wait()
-        pltpu.make_async_copy(v_hbm.at[b, kb], vbuf.at[slot], sems.at[1, slot]).wait()
+        dst = pl.ds(j * block, block)
+        pltpu.make_async_copy(k_hbm.at[b, kb], kbuf.at[:, dst], sems.at[0, j]).wait()
+        pltpu.make_async_copy(v_hbm.at[b, kb], vbuf.at[:, dst], sems.at[1, j]).wait()
 
-    # Launch EVERY active block's DMA up front (one VMEM slot per LUT entry) so the
-    # per-copy latencies overlap; the compute loop drains them in order. This keeps
-    # low-density layouts compute-bound instead of serial-DMA-latency-bound.
-    jax.lax.fori_loop(0, n_active, lambda j, c: (start_dma(j, j), c)[1], 0)
+    # Launch EVERY slot's DMA up front (one VMEM region per LUT entry) so the
+    # per-copy latencies overlap; the compute loop drains them tile by tile.
+    jax.lax.fori_loop(0, n_slots, lambda j, c: (start_dma(j), c)[1], 0)
 
     m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
 
-    def body(j, carry):
+    def body(t, carry):
         m, l, acc = carry
-        slot = j
-
-        wait_dma(j, slot)
-        kb = cols_ref[row, j]
-        # buffers hold K/V blocks TRANSPOSED [D, block] (lane dim = block, 128-aligned)
-        kt_blk = kbuf[slot]
-        vt_blk = vbuf[slot]
-        s = jnp.dot(q, kt_blk, preferred_element_type=jnp.float32) * sm_scale  # [bq, block]
+        jax.lax.fori_loop(t * kwidth, (t + 1) * kwidth,
+                          lambda j, c: (wait_dma(j), c)[1], 0)
+        tile = pl.ds(t * (kwidth * block), kwidth * block)
+        kt = kbuf[:, tile]               # [D, kwidth*block]
+        vt = vbuf[:, tile]
+        s = jnp.dot(q, kt, preferred_element_type=jnp.float32) * sm_scale  # [bq, W*blk]
+        # per-sub-block k positions + validity (padded slots hold garbage block 0)
+        parts_pos, parts_ok = [], []
+        for w in range(kwidth):
+            j = t * kwidth + w
+            kb = cols_ref[row, jnp.minimum(j, cols_ref.shape[1] - 1)]
+            iota = jax.lax.broadcasted_iota(jnp.int32, (bq, block), 1)
+            parts_pos.append(kb * block + iota)
+            parts_ok.append(jnp.full((bq, block), True) & (j < n_active))
+        k_pos = jnp.concatenate(parts_pos, axis=1)
+        ok = jnp.concatenate(parts_ok, axis=1)
         if causal:
-            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block), 0)
-            k_pos = kb * block + jax.lax.broadcasted_iota(jnp.int32, (bq, block), 1)
-            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, kwidth * block), 0)
+            ok = jnp.logical_and(ok, q_pos >= k_pos)
+        s = jnp.where(ok, s, DEFAULT_MASK_VALUE)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
+        p = jnp.where(ok, p, 0.0)  # exact zero for padded lanes
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        # p @ v with v stored [D, block]: contract p's block dim with vt's block dim
-        pv = jax.lax.dot_general(p.astype(vt_blk.dtype), vt_blk,
+        # p @ v with v stored [D, W*block]: contract the lane dims
+        pv = jax.lax.dot_general(p.astype(vt.dtype), vt,
                                  dimension_numbers=(((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         acc_new = acc * alpha + pv
         return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, n_active, body, (m0, l0, acc0))
+    n_tiles = (n_active + kwidth - 1) // kwidth
+    m, l, acc = jax.lax.fori_loop(0, n_tiles, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[...] = jnp.where(n_active > 0, acc / l, 0.0).astype(o_ref.dtype)
     lse_ref[...] = (m + jnp.log(l)).reshape(1, bq)
 
 
-def _bs_dq_kernel(counts_ref, cols_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                  dq_ref, *, sm_scale, causal, block, num_heads, nb):
+def _bs_dq_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, do_ref, lse_ref, delta_ref,
+                  dq_ref, kbuf, vbuf, sems, *, sm_scale, causal, block, num_heads, nb,
+                  kwidth):
+    """dq over this q-row's active k-blocks, kwidth blocks per iteration (same
+    HBM-resident K/V + lane-concatenated VMEM scratch structure as the forward)."""
     b = pl.program_id(0)
     i = pl.program_id(1)
     h = b % num_heads
@@ -140,26 +159,66 @@ def _bs_dq_kernel(counts_ref, cols_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, de
     lse = lse_ref[...].reshape(bq, 1)
     delta = delta_ref[...].reshape(bq, 1)
 
-    def body(j, dq):
-        kb = cols_ref[row, j]
-        k_blk = k_ref[pl.ds(kb * block, block), :]
-        v_blk = v_ref[pl.ds(kb * block, block), :]
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block), 0)
-            k_pos = kb * block + jax.lax.broadcasted_iota(jnp.int32, (bq, block), 1)
-            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
-        p = jnp.exp(s - lse)
-        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        return dq + jnp.dot(ds.astype(k_blk.dtype), k_blk, preferred_element_type=jnp.float32)
+    n_active = counts_ref[row]
+    n_slots = ((n_active + kwidth - 1) // kwidth) * kwidth
 
-    dq = jax.lax.fori_loop(0, counts_ref[row], body, jnp.zeros((bq, d), jnp.float32))
+    def start_dma(j):
+        kb = cols_ref[row, j]
+        dst = pl.ds(j * block, block)
+        pltpu.make_async_copy(k_hbm.at[b, kb], kbuf.at[:, dst], sems.at[0, j]).start()
+        pltpu.make_async_copy(v_hbm.at[b, kb], vbuf.at[:, dst], sems.at[1, j]).start()
+
+    def wait_dma(j):
+        kb = cols_ref[row, j]
+        dst = pl.ds(j * block, block)
+        pltpu.make_async_copy(k_hbm.at[b, kb], kbuf.at[:, dst], sems.at[0, j]).wait()
+        pltpu.make_async_copy(v_hbm.at[b, kb], vbuf.at[:, dst], sems.at[1, j]).wait()
+
+    jax.lax.fori_loop(0, n_slots, lambda j, c: (start_dma(j), c)[1], 0)
+
+    def body(t, dq):
+        jax.lax.fori_loop(t * kwidth, (t + 1) * kwidth,
+                          lambda j, c: (wait_dma(j), c)[1], 0)
+        tile = pl.ds(t * (kwidth * block), kwidth * block)
+        kt = kbuf[:, tile]               # [D, W*block]
+        vt = vbuf[:, tile]
+        s = jnp.dot(q, kt, preferred_element_type=jnp.float32) * sm_scale
+        parts_pos, parts_ok = [], []
+        for w in range(kwidth):
+            j = t * kwidth + w
+            kb = cols_ref[row, jnp.minimum(j, cols_ref.shape[1] - 1)]
+            iota = jax.lax.broadcasted_iota(jnp.int32, (bq, block), 1)
+            parts_pos.append(kb * block + iota)
+            parts_ok.append(jnp.full((bq, block), True) & (j < n_active))
+        k_pos = jnp.concatenate(parts_pos, axis=1)
+        ok = jnp.concatenate(parts_ok, axis=1)
+        if causal:
+            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, kwidth * block), 0)
+            ok = jnp.logical_and(ok, q_pos >= k_pos)
+        s = jnp.where(ok, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse)
+        p = jnp.where(ok, p, 0.0)
+        dp = jax.lax.dot_general(do, vt, dimension_numbers=(((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)  # [bq, W*block]
+        ds = p * (dp - delta)
+        # ds @ K with K stored [D, W*block]: contract the lane dims
+        return dq + jax.lax.dot_general(ds.astype(kt.dtype), kt,
+                                        dimension_numbers=(((1,), (1,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    n_tiles = (n_active + kwidth - 1) // kwidth
+    dq = jax.lax.fori_loop(0, n_tiles, body, jnp.zeros((bq, d), jnp.float32))
     dq_ref[...] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
-def _bs_dkv_kernel(counts_t_ref, rows_t_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dk_ref, dv_ref, *, sm_scale, causal, block, num_heads, nb):
+def _bs_dkv_kernel(counts_t_ref, rows_t_ref, q_hbm, k_ref, v_ref, do_hbm, lse_ref,
+                   delta_ref, dk_ref, dv_ref, qbuf, dobuf, sems, *, sm_scale, causal,
+                   block, num_heads, nb, kwidth):
+    """dk/dv over this k-column's active q-blocks, kwidth blocks per iteration.
+    Q/dO stay in HBM stored TRANSPOSED [BH, nb, D, block] (lane dim = block, so HBM
+    slices are 128-lane aligned — [block, D<128] tiles trip Mosaic's memref_slice);
+    active q-blocks are DMA'd lane-concatenated into [D, A_pad*block] scratch and all
+    matmuls contract via dimension_numbers instead of VMEM transposes."""
     b = pl.program_id(0)
     i = pl.program_id(1)  # k-block index
     h = b % num_heads
@@ -168,40 +227,91 @@ def _bs_dkv_kernel(counts_t_ref, rows_t_ref, q_ref, k_ref, v_ref, do_ref, lse_re
     k = k_ref[...]
     v = v_ref[...]
 
-    def body(j, carry):
-        dk, dv = carry
+    n_active = counts_t_ref[col]
+    n_slots = ((n_active + kwidth - 1) // kwidth) * kwidth
+
+    def start_dma(j):
         qb = rows_t_ref[col, j]
-        q_blk = q_ref[pl.ds(qb * block, block), :]
-        do_blk = do_ref[pl.ds(qb * block, block), :]
-        lse_blk = lse_ref[0, pl.ds(qb * block, block)].reshape(block, 1)
-        delta_blk = delta_ref[0, pl.ds(qb * block, block)].reshape(block, 1)
-        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * sm_scale
+        dst = pl.ds(j * block, block)
+        pltpu.make_async_copy(q_hbm.at[b, qb], qbuf.at[:, dst], sems.at[0, j]).start()
+        pltpu.make_async_copy(do_hbm.at[b, qb], dobuf.at[:, dst], sems.at[1, j]).start()
+
+    def wait_dma(j):
+        qb = rows_t_ref[col, j]
+        dst = pl.ds(j * block, block)
+        pltpu.make_async_copy(q_hbm.at[b, qb], qbuf.at[:, dst], sems.at[0, j]).wait()
+        pltpu.make_async_copy(do_hbm.at[b, qb], dobuf.at[:, dst], sems.at[1, j]).wait()
+
+    jax.lax.fori_loop(0, n_slots, lambda j, c: (start_dma(j), c)[1], 0)
+
+    def body(t, carry):
+        dk, dv = carry
+        jax.lax.fori_loop(t * kwidth, (t + 1) * kwidth,
+                          lambda j, c: (wait_dma(j), c)[1], 0)
+        tile = pl.ds(t * (kwidth * block), kwidth * block)
+        qt = qbuf[:, tile]               # [D, W*block]
+        dot = dobuf[:, tile]             # [D, W*block]
+        parts_pos, parts_ok, parts_lse, parts_delta = [], [], [], []
+        for w in range(kwidth):
+            j = t * kwidth + w
+            qb = rows_t_ref[col, jnp.minimum(j, rows_t_ref.shape[1] - 1)]
+            qs = pl.ds(qb * block, block)
+            iota = jax.lax.broadcasted_iota(jnp.int32, (block, bk), 0)
+            parts_pos.append(qb * block + iota)
+            parts_ok.append(jnp.full((block, bk), True) & (j < n_active))
+            parts_lse.append(lse_ref[0, qs].reshape(block, 1))
+            parts_delta.append(delta_ref[0, qs].reshape(block, 1))
+        q_pos = jnp.concatenate(parts_pos, axis=0)
+        ok = jnp.concatenate(parts_ok, axis=0)
+        lse_tile = jnp.concatenate(parts_lse, axis=0)
+        delta_tile = jnp.concatenate(parts_delta, axis=0)
+        # s[Wb, bk] = (q @ k^T) with q stored [D, Wb]: contract the D dims
+        s = jax.lax.dot_general(qt, k, dimension_numbers=(((0,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            q_pos = qb * block + jax.lax.broadcasted_iota(jnp.int32, (block, bk), 0)
-            k_pos = i * bk + jax.lax.broadcasted_iota(jnp.int32, (block, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
-        p = jnp.exp(s - lse_blk)
-        dv_new = dv + jnp.dot(p.T.astype(do_blk.dtype), do_blk,
-                              preferred_element_type=jnp.float32)
-        dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_blk)
-        dk_new = dk + jnp.dot(ds.T.astype(q_blk.dtype), q_blk,
-                              preferred_element_type=jnp.float32)
+            k_pos = i * bk + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (kwidth * block, bk), 1)
+            ok = jnp.logical_and(ok, q_pos >= k_pos)
+        s = jnp.where(ok, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse_tile)
+        p = jnp.where(ok, p, 0.0)
+        # dv[bk, D] += p^T @ do with do stored [D, Wb]: contract the Wb dims
+        dv_new = dv + jax.lax.dot_general(p.astype(dot.dtype), dot,
+                                          dimension_numbers=(((0,), (1,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+        # dp[Wb, bk] = do^T @ v^T: contract the D dims
+        dp = jax.lax.dot_general(dot, v, dimension_numbers=(((0,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_tile)
+        # dk[bk, D] += ds^T @ q^T: contract the Wb dims
+        dk_new = dk + jax.lax.dot_general(ds.astype(qt.dtype), qt,
+                                          dimension_numbers=(((0,), (1,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
-    dk, dv = jax.lax.fori_loop(0, counts_t_ref[col], body,
+    n_tiles = (n_active + kwidth - 1) // kwidth
+    dk, dv = jax.lax.fori_loop(0, n_tiles, body,
                                (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
-    dk_ref[...] = (dk * sm_scale).astype(dk_ref.dtype)
-    dv_ref[...] = dv.astype(dv_ref.dtype)
+    dk_ref[...] = jnp.where(n_active > 0, dk * sm_scale, 0.0).astype(dk_ref.dtype)
+    dv_ref[...] = jnp.where(n_active > 0, dv, 0.0).astype(dv_ref.dtype)
 
 
 # ---------------------------------------------------------------------------
 # pallas_call plumbing
 # ---------------------------------------------------------------------------
 
-def _grid_spec(num_prefetch, grid, in_specs, out_specs):
-    return pltpu.PrefetchScalarGridSpec(num_scalar_prefetch=num_prefetch, grid=grid,
-                                        in_specs=in_specs, out_specs=out_specs)
+_KWIDTH = 4  # k-blocks consumed per compute iteration (one [bq, KW*block] score tile)
+
+
+def _pad_lut(lut, max_width=_KWIDTH):
+    """Clamp the tile width to the LUT and pad the LUT width to a tile multiple
+    (padded slots DMA block 0; their lanes are masked in-kernel).
+    Returns (padded_lut, padded_width, kwidth)."""
+    kwidth = max(1, min(max_width, int(lut.shape[1])))
+    a_pad = (int(lut.shape[1]) + kwidth - 1) // kwidth * kwidth
+    if a_pad != lut.shape[1]:
+        lut = jnp.pad(lut, ((0, 0), (0, a_pad - lut.shape[1])))
+    return lut, a_pad, kwidth
 
 
 def _bs_fwd(q, k, v, counts, cols, sm_scale, causal, block, interpret):
@@ -215,13 +325,13 @@ def _bs_fwd(q, k, v, counts, cols, sm_scale, causal, block, interpret):
                                  f"(smaller layouts: use interpret mode or a bigger block)"
     k3 = k.reshape(B * H, nb, block, D).transpose(0, 1, 3, 2)
     v3 = v.reshape(B * H, nb, block, D).transpose(0, 1, 3, 2)
-    max_active = int(cols.shape[1])
-    # VMEM budget: 2 buffers x max_active x D x block x itemsize must fit ~16MB
-    vmem_need = 2 * max_active * D * block * q.dtype.itemsize
+    cols, a_pad, kwidth = _pad_lut(cols)
+    # VMEM budget: 2 buffers x a_pad x D x block x itemsize must fit ~16MB
+    vmem_need = 2 * a_pad * D * block * q.dtype.itemsize
     assert vmem_need < 12 * 1024 * 1024, \
         f"layout too dense for all-upfront DMA ({vmem_need} B of VMEM); reduce max row density"
     kernel = functools.partial(_bs_fwd_kernel, sm_scale=sm_scale, causal=causal, block=block,
-                               num_heads=H, nb=nb)
+                               num_heads=H, nb=nb, kwidth=kwidth)
     out, lse = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -237,9 +347,9 @@ def _bs_fwd(q, k, v, counts, cols, sm_scale, causal, block, interpret):
                 pl.BlockSpec((None, 1, block), lambda b, i, c0, c1: (b, 0, i)),
             ],
             scratch_shapes=[
-                pltpu.VMEM((max_active, D, block), q.dtype),
-                pltpu.VMEM((max_active, D, block), q.dtype),
-                pltpu.SemaphoreType.DMA((2, max_active)),
+                pltpu.VMEM((D, a_pad * block), q.dtype),
+                pltpu.VMEM((D, a_pad * block), q.dtype),
+                pltpu.SemaphoreType.DMA((2, a_pad)),
             ]),
         out_shape=[
             jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
@@ -256,51 +366,73 @@ def _bs_bwd(res, g, sm_scale, causal, block, interpret):
     nb = T // block
     do = g
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-    q3, k3, v3, do3 = (x.reshape(B * H, T, D) for x in (q, k, v, do))
     lse3 = lse.reshape(B * H, 1, T)
     delta3 = delta.reshape(B * H, 1, T)
+    q3, do3 = (x.reshape(B * H, T, D) for x in (q, do))
 
+    cols_p, a_pad, kwidth = _pad_lut(cols)
+    # K/V blocked + transposed [BH, nb, D, block] for the lane-concat DMA (as in fwd)
+    k3 = k.reshape(B * H, nb, block, D).transpose(0, 1, 3, 2)
+    v3 = v.reshape(B * H, nb, block, D).transpose(0, 1, 3, 2)
     dq = pl.pallas_call(
         functools.partial(_bs_dq_kernel, sm_scale=sm_scale, causal=causal, block=block,
-                          num_heads=H, nb=nb),
-        grid_spec=_grid_spec(
-            2, (B * H, nb),
+                          num_heads=H, nb=nb, kwidth=kwidth),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B * H, nb),
             in_specs=[
                 pl.BlockSpec((None, block, D), lambda b, i, c0, c1: (b, i, 0)),
-                pl.BlockSpec((None, T, D), lambda b, i, c0, c1: (b, 0, 0)),
-                pl.BlockSpec((None, T, D), lambda b, i, c0, c1: (b, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),  # K stays in HBM
+                pl.BlockSpec(memory_space=pl.ANY),  # V stays in HBM
                 pl.BlockSpec((None, block, D), lambda b, i, c0, c1: (b, i, 0)),
                 pl.BlockSpec((None, 1, block), lambda b, i, c0, c1: (b, 0, i)),
                 pl.BlockSpec((None, 1, block), lambda b, i, c0, c1: (b, 0, i)),
             ],
-            out_specs=pl.BlockSpec((None, block, D), lambda b, i, c0, c1: (b, i, 0))),
+            out_specs=pl.BlockSpec((None, block, D), lambda b, i, c0, c1: (b, i, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((D, a_pad * block), q.dtype),
+                pltpu.VMEM((D, a_pad * block), q.dtype),
+                pltpu.SemaphoreType.DMA((2, a_pad)),
+            ]),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
         interpret=interpret,
-    )(counts, cols, q3, k3, v3, do3, lse3, delta3)
+    )(counts, cols_p, q3, k3, v3, do3, lse3, delta3)
 
+    rows_p, at_pad, kwidth_t = _pad_lut(rows_t)
+    # Q/dO blocked + transposed [BH, nb, D, block] for the lane-concat DMA
+    q4 = q.reshape(B * H, nb, block, D).transpose(0, 1, 3, 2)
+    do4 = do.reshape(B * H, nb, block, D).transpose(0, 1, 3, 2)
+    k3f = k.reshape(B * H, T, D)
+    v3f = v.reshape(B * H, T, D)
     dk, dv = pl.pallas_call(
         functools.partial(_bs_dkv_kernel, sm_scale=sm_scale, causal=causal, block=block,
-                          num_heads=H, nb=nb),
-        grid_spec=_grid_spec(
-            2, (B * H, nb),
+                          num_heads=H, nb=nb, kwidth=kwidth_t),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B * H, nb),
             in_specs=[
-                pl.BlockSpec((None, T, D), lambda b, i, c0, c1: (b, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),  # Q stays in HBM
                 pl.BlockSpec((None, block, D), lambda b, i, c0, c1: (b, i, 0)),
                 pl.BlockSpec((None, block, D), lambda b, i, c0, c1: (b, i, 0)),
-                pl.BlockSpec((None, T, D), lambda b, i, c0, c1: (b, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),  # dO stays in HBM
                 pl.BlockSpec((None, 1, T), lambda b, i, c0, c1: (b, 0, 0)),
                 pl.BlockSpec((None, 1, T), lambda b, i, c0, c1: (b, 0, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((None, block, D), lambda b, i, c0, c1: (b, i, 0)),
                 pl.BlockSpec((None, block, D), lambda b, i, c0, c1: (b, i, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((D, at_pad * block), q.dtype),
+                pltpu.VMEM((D, at_pad * block), q.dtype),
+                pltpu.SemaphoreType.DMA((2, at_pad)),
             ]),
         out_shape=[
             jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
             jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
         ],
         interpret=interpret,
-    )(counts_t, rows_t, q3, k3, v3, do3, lse3, delta3)
+    )(counts_t, rows_p, q4, k3f, v3f, do4, lse3, delta3)
     return dq.reshape(B, H, T, D), dk.reshape(B, H, T, D), dv.reshape(B, H, T, D)
 
 

@@ -101,6 +101,10 @@ def dropout_keep_reference(seed, B, H, T_q, T_k, rate: float):
 # forward kernel
 # ---------------------------------------------------------------------------
 
+LOG2E = 1.4426950408889634  # 1/ln(2): softmax runs in base 2 (exp2 is the cheaper
+# VPU transcendental, and folding sm_scale*log2e into q kills a per-tile scale pass)
+
+
 def _fwd_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, threshold):
     i = 0
     seed_ref = None
@@ -116,8 +120,9 @@ def _fwd_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, thres
     d = q_ref.shape[1]
     q_blk_idx = pl.program_id(1)
     # keep MXU operands in the input dtype (bf16): bf16-in/fp32-accumulate is the MXU's
-    # native mode — upcasting to fp32 before the dot ran the matmuls many times slower
-    q = q_ref[...]
+    # native mode — upcasting to fp32 before the dot ran the matmuls many times slower.
+    # sm_scale*log2e is pre-folded into q: scores come out of the MXU in base-2 units.
+    q = (q_ref[...].astype(jnp.float32) * (sm_scale * LOG2E)).astype(q_ref.dtype)
     if rate > 0:
         seed_u32 = seed_ref[0].astype(jnp.uint32)
         bh_u32 = pl.program_id(0).astype(jnp.uint32)
@@ -127,44 +132,53 @@ def _fwd_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, thres
     if causal:
         # process k blocks up to and including the diagonal block
         last_blk = jnp.minimum(num_k_blocks, (q_blk_idx * bq + bq + block_k - 1) // block_k)
+        # blocks strictly below the diagonal need no mask: max k_pos <= min q_pos
+        n_full = jnp.minimum(last_blk, (q_blk_idx * bq + 1) // block_k)
     else:
         last_blk = num_k_blocks
+        n_full = num_k_blocks
 
     m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
-        v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
-        if has_bias:
-            s = s + bias_ref[:, pl.ds(kb * block_k, block_k)]  # [1, bk] broadcast
-        if causal or rate > 0:
-            q_pos = q_blk_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-        if causal:
-            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        # the normalizer uses the UNdropped probabilities (torch dropout(softmax(s)))
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        if rate > 0:
-            bits = _dropout_bits(seed_u32, bh_u32, q_pos, k_pos)
-            keep = (bits >= jnp.uint32(threshold)).astype(jnp.float32) * inv_keep
-            p_eff = p * keep
-        else:
-            p_eff = p
-        acc_new = acc * alpha + jnp.dot(p_eff.astype(v_blk.dtype), v_blk,
-                                        preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+    def make_body(masked):
+        def body(kb, carry):
+            m, l, acc = carry
+            k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
+            v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
+            s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)  # [bq, bk] base-2
+            if has_bias:
+                s = s + bias_ref[:, pl.ds(kb * block_k, block_k)] * LOG2E
+            if masked or rate > 0:
+                q_pos = q_blk_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+                k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            if masked:
+                s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp2(s - m_new)
+            alpha = jnp.exp2(m - m_new)
+            # the normalizer uses the UNdropped probabilities (torch dropout(softmax(s)))
+            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            if rate > 0:
+                bits = _dropout_bits(seed_u32, bh_u32, q_pos, k_pos)
+                keep = (bits >= jnp.uint32(threshold)).astype(jnp.float32) * inv_keep
+                p_eff = p * keep
+            else:
+                p_eff = p
+            acc_new = acc * alpha + jnp.dot(p_eff.astype(v_blk.dtype), v_blk,
+                                            preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+        return body
 
-    m, l, acc = jax.lax.fori_loop(0, last_blk, body, (m0, l0, acc0))
+    carry = jax.lax.fori_loop(0, n_full, make_body(False), (m0, l0, acc0))
+    if causal:
+        carry = jax.lax.fori_loop(n_full, last_blk, make_body(True), carry)
+    m, l, acc = carry
     l = jnp.maximum(l, 1e-30)
     o_ref[...] = (acc / l).astype(o_ref.dtype)
-    lse_ref[...] = (m + jnp.log(l)).reshape(1, bq)
+    # stored LSE stays in natural-log units (m is base-2)
+    lse_ref[...] = (m / LOG2E + jnp.log(l)).reshape(1, bq)
 
 
 def _aux_operands(seed, bias, B, H, T, rate, block_k_map=None):
@@ -237,9 +251,10 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, th
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref = refs[i:]
     bq, d = q_ref.shape
     q_blk_idx = pl.program_id(1)
-    q = q_ref[...]      # input dtype: bf16-in/fp32-out MXU dots (see _fwd_kernel note)
+    # base-2 softmax with sm_scale*log2e folded into q (see _fwd_kernel)
+    q = (q_ref[...].astype(jnp.float32) * (sm_scale * LOG2E)).astype(q_ref.dtype)
     do = do_ref[...]
-    lse = lse_ref[...].reshape(bq, 1)
+    lse2 = lse_ref[...].reshape(bq, 1) * LOG2E  # natural -> base-2
     delta = delta_ref[...].reshape(bq, 1)
     if rate > 0:
         seed_u32 = seed_ref[0].astype(jnp.uint32)
@@ -249,29 +264,35 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, th
     num_k_blocks = pl.cdiv(seq_len, block_k)
     if causal:
         last_blk = jnp.minimum(num_k_blocks, (q_blk_idx * bq + bq + block_k - 1) // block_k)
+        n_full = jnp.minimum(last_blk, (q_blk_idx * bq + 1) // block_k)
     else:
         last_blk = num_k_blocks
+        n_full = num_k_blocks
 
-    def body(kb, dq):
-        k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
-        v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
-        if has_bias:
-            s = s + bias_ref[:, pl.ds(kb * block_k, block_k)]
-        if causal or rate > 0:
-            q_pos = q_blk_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-        if causal:
-            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
-        p = jnp.exp(s - lse)
-        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-        if rate > 0:
-            bits = _dropout_bits(seed_u32, bh_u32, q_pos, k_pos)
-            dp = dp * ((bits >= jnp.uint32(threshold)).astype(jnp.float32) * inv_keep)
-        ds = p * (dp - delta)
-        return dq + jnp.dot(ds.astype(k_blk.dtype), k_blk, preferred_element_type=jnp.float32)
+    def make_body(masked):
+        def body(kb, dq):
+            k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
+            v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
+            s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+            if has_bias:
+                s = s + bias_ref[:, pl.ds(kb * block_k, block_k)] * LOG2E
+            if masked or rate > 0:
+                q_pos = q_blk_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+                k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            if masked:
+                s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+            p = jnp.exp2(s - lse2)
+            dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+            if rate > 0:
+                bits = _dropout_bits(seed_u32, bh_u32, q_pos, k_pos)
+                dp = dp * ((bits >= jnp.uint32(threshold)).astype(jnp.float32) * inv_keep)
+            ds = p * (dp - delta)
+            return dq + jnp.dot(ds.astype(k_blk.dtype), k_blk, preferred_element_type=jnp.float32)
+        return body
 
-    dq = jax.lax.fori_loop(0, last_blk, body, jnp.zeros((bq, d), jnp.float32))
+    dq = jax.lax.fori_loop(0, n_full, make_body(False), jnp.zeros((bq, d), jnp.float32))
+    if causal:
+        dq = jax.lax.fori_loop(n_full, last_blk, make_body(True), dq)
     dq_ref[...] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
@@ -287,7 +308,8 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, seq_len, has_bias, rate, t
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref = refs[i:]
     bk, d = k_ref.shape
     k_blk_idx = pl.program_id(1)
-    k = k_ref[...]      # input dtype: bf16-in/fp32-out MXU dots (see _fwd_kernel note)
+    # base-2 softmax: fold sm_scale*log2e into K here (q stays raw in this kernel)
+    k = (k_ref[...].astype(jnp.float32) * (sm_scale * LOG2E)).astype(k_ref.dtype)
     v = v_ref[...]
     if rate > 0:
         seed_u32 = seed_ref[0].astype(jnp.uint32)
@@ -297,42 +319,52 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, seq_len, has_bias, rate, t
     num_q_blocks = pl.cdiv(seq_len, block_q)
     if causal:
         first_blk = (k_blk_idx * bk) // block_q
+        # q blocks whose min q_pos covers this k block's max k_pos need no mask
+        full_from = jnp.minimum(num_q_blocks,
+                                ((k_blk_idx + 1) * bk - 1 + block_q - 1) // block_q)
     else:
         first_blk = 0
+        full_from = 0
 
-    def body(qb, carry):
-        dk, dv = carry
-        q_blk = q_ref[pl.ds(qb * block_q, block_q), :]
-        do_blk = do_ref[pl.ds(qb * block_q, block_q), :]
-        lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q)].reshape(block_q, 1)
-        delta_blk = delta_ref[0, pl.ds(qb * block_q, block_q)].reshape(block_q, 1)
-        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
-        if has_bias:
-            s = s + bias_ref[...]  # [1, bk]: this k-block's bias tile
-        if causal or rate > 0:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
-            k_pos = k_blk_idx * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
-        if causal:
-            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
-        p = jnp.exp(s - lse_blk)
-        if rate > 0:
-            bits = _dropout_bits(seed_u32, bh_u32, q_pos, k_pos)
-            keep = (bits >= jnp.uint32(threshold)).astype(jnp.float32) * inv_keep
-            p_drop = p * keep
-        else:
-            p_drop = p
-        dv_new = dv + jnp.dot(p_drop.T.astype(do_blk.dtype), do_blk,
-                              preferred_element_type=jnp.float32)
-        dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
-        if rate > 0:
-            dp = dp * keep
-        ds = p * (dp - delta_blk)
-        dk_new = dk + jnp.dot(ds.T.astype(q_blk.dtype), q_blk,
-                              preferred_element_type=jnp.float32)
-        return dk_new, dv_new
+    def make_body(masked):
+        def body(qb, carry):
+            dk, dv = carry
+            q_blk = q_ref[pl.ds(qb * block_q, block_q), :]
+            do_blk = do_ref[pl.ds(qb * block_q, block_q), :]
+            lse2_blk = lse_ref[0, pl.ds(qb * block_q, block_q)].reshape(block_q, 1) * LOG2E
+            delta_blk = delta_ref[0, pl.ds(qb * block_q, block_q)].reshape(block_q, 1)
+            s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32)  # [bq, bk] base-2
+            if has_bias:
+                s = s + bias_ref[...] * LOG2E  # [1, bk]: this k-block's bias tile
+            if masked or rate > 0:
+                q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+                k_pos = k_blk_idx * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+            if masked:
+                s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+            p = jnp.exp2(s - lse2_blk)
+            if rate > 0:
+                bits = _dropout_bits(seed_u32, bh_u32, q_pos, k_pos)
+                keep = (bits >= jnp.uint32(threshold)).astype(jnp.float32) * inv_keep
+                p_drop = p * keep
+            else:
+                p_drop = p
+            dv_new = dv + jnp.dot(p_drop.T.astype(do_blk.dtype), do_blk,
+                                  preferred_element_type=jnp.float32)
+            dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+            if rate > 0:
+                dp = dp * keep
+            ds = p * (dp - delta_blk)
+            dk_new = dk + jnp.dot(ds.T.astype(q_blk.dtype), q_blk,
+                                  preferred_element_type=jnp.float32)
+            return dk_new, dv_new
+        return body
 
-    dk, dv = jax.lax.fori_loop(first_blk, num_q_blocks, body,
-                               (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    init = (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32))
+    if causal:
+        carry = jax.lax.fori_loop(first_blk, full_from, make_body(True), init)
+        dk, dv = jax.lax.fori_loop(full_from, num_q_blocks, make_body(False), carry)
+    else:
+        dk, dv = jax.lax.fori_loop(0, num_q_blocks, make_body(False), init)
     dk_ref[...] = (dk * sm_scale).astype(dk_ref.dtype)
     dv_ref[...] = dv.astype(dv_ref.dtype)
 

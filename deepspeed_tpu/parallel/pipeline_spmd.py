@@ -16,9 +16,27 @@ single jitted program over the mesh:
   gradient reduction is still emitted by XLA → this file + zero/sharding.py is the 3-D
   (pipe x data x model) story (reference PipeModelDataParallelTopology, topology.py:246).
 
+Schedule/memory note (vs the reference's 1F1B, runtime/pipe/schedule.py:182-289): the
+scan realizes a GPipe-order schedule with jax.checkpoint on the stage body, so the
+forward stores only each scan step's STAGE INPUT (one [mb, T, E] tensor per step), not
+per-layer activations. Measured on the compiled program (8-virtual-device CPU,
+GPT-2 8L/256E/S=4, bf16): temp memory grows ~2.3 MB per extra micro-batch ≈ 0.9x the
+stage-input size per step, while 1F1B WITHOUT remat holds up to S in-flight
+micro-batches x full per-layer activations (~12x stage-input per stage for 2-layer
+stages) regardless of M. For the training configs this engine targets (M <= ~4S
+micro-batches per accumulation window), GPipe+remat live memory is at or below
+1F1B-without-remat; 1F1B's advantage only reappears at M >> S, where raising the
+engine's gradient-accumulation steps (multiple pipeline flushes per optimizer step)
+bounds M per flush the same way.
+
 Requires homogeneous stages (equal per-stage blocks) — the layout GPT/BERT stacks
-naturally have. Heterogeneous first/last work (embedding, LM head, loss) runs outside the
-pipelined scan, replicated over ``pipe``.
+naturally have. Heterogeneous first/last work (embedding, LM head, loss) runs inside the
+same shard_map: ``first_stage_fn``/``post_fn`` may use pipe-axis collectives, so large
+IO parameters (the embedding table) can be SHARDED over ``pipe`` instead of replicated —
+see GPT2Pipe's vocab-parallel embedding/head, which stores 1/S of the vocab table per
+pipe rank (the reference replicated tied embeddings on first+last stage and all-reduced
+their grads across the tied group, runtime/pipe/module.py TiedLayerSpec; sharding the
+table over pipe makes the tie free and the memory ∝ 1/S).
 """
 
 from functools import partial
@@ -54,7 +72,9 @@ def pipeline_apply(stage_fn: Callable,
                    first_stage_fn: Callable = None,
                    first_stage_args=(),
                    last_stage_args_specs=None,
-                   stacked_param_specs=None):
+                   first_stage_args_specs=None,
+                   stacked_param_specs=None,
+                   last_stage_collective: bool = False):
     """Run micro-batches through the pipe-axis pipeline inside shard_map.
 
     Args:
@@ -70,6 +90,14 @@ def pipeline_apply(stage_fn: Callable,
         [M, ...] outputs broadcast over pipe.
       first_stage_fn: optional ``(x_mb, *first_stage_args) -> activation`` applied at
         stage 0 before the first block (e.g. embedding lookup inside the pipeline).
+        Runs inside shard_map on every pipe rank, so it MAY use pipe-axis collectives
+        over pipe-sharded first_stage_args (vocab-parallel embedding).
+      first_stage_args_specs: optional PartitionSpecs for first_stage_args (defaults to
+        replicated); pass P(pipe, ...) leaves to shard IO params over the pipe axis.
+      last_stage_collective: when True, last_stage_fn runs on EVERY pipe rank against
+        the per-step psum-broadcast final activation and MAY use pipe-axis collectives
+        over pipe-sharded last_stage_args (the vocab-parallel tied head+loss). Only one
+        [mb, ...] activation is live per step — no [M, ...] buffer.
 
     Differentiable in stacked_params / x_microbatches / *args.
     """
@@ -93,8 +121,10 @@ def pipeline_apply(stage_fn: Callable,
                 x0 = first_stage_fn(x0, *first_args)
             return x0
 
-        x0_example = ingest(jnp.int32(0))
-        carry_init = (jnp.zeros_like(x0_example),            # activation arriving at this stage
+        # abstract-eval only: ingest may contain pipe collectives (vocab-parallel
+        # embedding) that must not execute just to size the carry buffers
+        x0_example = jax.eval_shape(ingest, jax.ShapeDtypeStruct((), jnp.int32))
+        carry_init = (jnp.zeros(x0_example.shape, x0_example.dtype),  # arriving activation
                       jnp.zeros((), jnp.float32),            # loss accumulator (last stage)
                       (jnp.zeros((M,) + x0_example.shape, x0_example.dtype)
                        if last_stage_fn is None else jnp.zeros((), jnp.float32)))
@@ -115,6 +145,20 @@ def pipeline_apply(stage_fn: Callable,
                     lambda o: o.at[jnp.clip(mb, 0, M - 1)].set(y),
                     lambda o: o,
                     out_acc)
+            elif last_stage_collective:
+                # run the broadcast + collective head on every rank, but only on
+                # steps that finish a micro-batch: ``valid`` depends only on the scan
+                # counter (uniform across ranks), so lax.cond keeps collective
+                # execution uniform while skipping the S-1 warmup/drain steps' head
+                def do_head(_):
+                    y_b = jax.lax.psum(
+                        jnp.where(is_last, 1.0, 0.0).astype(y.dtype) * y, PIPE_AXIS)
+                    return last_stage_fn(y_b, *last_args, jnp.clip(mb, 0, M - 1))
+
+                contrib = jax.lax.cond(valid, do_head,
+                                       lambda _: jnp.zeros((), jnp.float32),
+                                       operand=None)
+                loss_acc = loss_acc + contrib
             else:
                 contrib = jax.lax.cond(
                     take,
@@ -134,6 +178,9 @@ def pipeline_apply(stage_fn: Callable,
             mask = jnp.where(is_last, 1.0, 0.0)
             out = jax.lax.psum(out_acc * mask.astype(out_acc.dtype), PIPE_AXIS)
             return out
+        if last_stage_collective:
+            # the collective head already made loss_acc uniform over pipe
+            return jax.lax.pmean(loss_acc / M, DATA_AXIS)
         loss = jax.lax.psum(jnp.where(is_last, loss_acc, 0.0), PIPE_AXIS) / M
         # the user's last_stage_fn returns a mean over its LOCAL batch shard; average the
         # equal-sized shards to the global mean (and replicate over data for out_spec P())
@@ -160,7 +207,8 @@ def pipeline_apply(stage_fn: Callable,
 
     last_spec = (last_stage_args_specs if last_stage_args_specs is not None
                  else jax.tree_util.tree_map(_last_arg_spec, last_stage_args))
-    first_spec = jax.tree_util.tree_map(lambda _: P(), first_stage_args)
+    first_spec = (first_stage_args_specs if first_stage_args_specs is not None
+                  else jax.tree_util.tree_map(lambda _: P(), first_stage_args))
     out_spec = P() if last_stage_fn is not None else x_spec
 
     fn = jax.shard_map(inner, mesh=mesh,

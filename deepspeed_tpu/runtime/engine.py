@@ -479,6 +479,12 @@ class DeepSpeedEngine:
         predivide = float(self.config.gradient_predivide_factor or 1.0)
         prescale = self.config.prescale_gradients
         use_stacked = self._use_stacked_grads
+        # ZeRO-Offload keeps device grads in the compute dtype (the reference keeps
+        # fp16 grads on-GPU and upcasts on the host master, stage2.py:333-349) —
+        # halves the grad HBM footprint, which bounds max trainable params/chip. The
+        # host tier upcasts to fp32 in its landing buffer. On-device optimizers
+        # accumulate/update in fp32 as before.
+        grad_dtype = compute_dtype if self._offload is not None else jnp.float32
 
         def local_loss_and_grad(params, scale, *batch):
             def scaled_loss_fn(p):
@@ -489,7 +495,7 @@ class DeepSpeedEngine:
                     factor = factor / predivide
                 return loss * factor, loss
             (_, loss), grads = jax.value_and_grad(scaled_loss_fn, has_aux=True)(params)
-            grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+            grads = jax.tree_util.tree_map(lambda g: g.astype(grad_dtype), grads)
             return loss, grads
 
         def shard_mapped_loss_and_grad(reduce_grads, grad_out_specs):
@@ -531,15 +537,23 @@ class DeepSpeedEngine:
             if sparse_tokens_fn is None:
                 logger.warning(
                     "[deepspeed_tpu] sparse_gradients: no sparse_grad_tokens() hint on "
-                    "the model; assuming batch arg 0 is the token-id tensor when sizing "
-                    "the sparse row capacity")
+                    "the model; sizing the sparse row capacity from batch arg 0 when it "
+                    "is an integer token-id tensor, else falling back to dense reduction")
             dp = self.dp_size
 
             def reduce_sparse(grads, batch):
                 # A token position contributes at most one nonzero row per table,
                 # so local token count exactly bounds the sparse row capacity.
-                global_tokens = (int(sparse_tokens_fn(*batch)) if sparse_tokens_fn is not None
-                                 else int(np.prod(batch[0].shape)))
+                if sparse_tokens_fn is not None:
+                    global_tokens = int(sparse_tokens_fn(*batch))
+                elif batch and hasattr(batch[0], "dtype") and \
+                        jnp.issubdtype(batch[0].dtype, jnp.integer):
+                    global_tokens = int(np.prod(batch[0].shape))
+                else:
+                    # no hint and arg 0 is not a token-id tensor: a guessed capacity
+                    # could silently DROP gradient rows — use the dense reduction
+                    return jax.tree_util.tree_map(
+                        lambda g: jax.lax.pmean(g, DATA_AXIS), grads)
                 local_tokens = global_tokens // dp
                 flat, treedef = jax.tree_util.tree_flatten(grads)
                 flat_flags = jax.tree_util.tree_leaves(sparse_flags)
