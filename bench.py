@@ -212,11 +212,22 @@ def _probe_subprocess(n_layer):
 
 
 def max_params_offload():
-    """Binary-search the deepest 1600-wide GPT-2 whose offload footprint fits."""
-    lo = 48
-    ok, best = _probe_subprocess(lo)
-    if not ok:
-        return 0
+    """Binary-search the deepest 1600-wide GPT-2 whose offload footprint fits.
+
+    Seeded at the round-2 measured boundary (128 layers fit, 132 did not) so the
+    steady-state cost is two probes; falls back to the full search if the boundary
+    moved (allocator/runtime changes)."""
+    ok128, n128 = _probe_subprocess(128)
+    if ok128:
+        ok132, n132 = _probe_subprocess(132)
+        if not ok132:
+            return n128
+        lo, best = 132, n132
+    else:
+        lo = 48
+        ok, best = _probe_subprocess(lo)
+        if not ok:
+            return 0
     hi = 160  # analytic ceiling ~ (16GB - act) / (4 B/param * 30.7M/layer)
     ok_hi, hi_params = _probe_subprocess(hi)
     if ok_hi:

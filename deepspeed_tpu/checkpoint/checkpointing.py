@@ -24,11 +24,18 @@ import numpy as np
 from ..utils import logger
 
 
+def _path_key(path) -> str:
+    """Canonical '/'-joined key for a tree path — the single definition every
+    save/load layout (tree npz, per-rank shards, offload regions) keys by."""
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p))))
+                    for p in path)
+
+
 def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
     flat = {}
     leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
     for path, leaf in leaves_with_paths:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p)))) for p in path)
+        key = _path_key(path)
         arr = np.asarray(jax.device_get(leaf))
         if arr.dtype not in (np.float32, np.float64, np.int32, np.int64, np.bool_,
                              np.uint32, np.uint8, np.int8, np.float16):
@@ -44,7 +51,7 @@ def _unflatten_like(template, flat: Dict[str, np.ndarray], numpy: bool = False):
     paths, treedef = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
     for path, leaf in paths:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p)))) for p in path)
+        key = _path_key(path)
         if key not in flat:
             raise KeyError(f"checkpoint missing array {key!r}")
         arr = flat[key]
@@ -77,20 +84,101 @@ def optim_states_name(dp_rank: int, mp_rank: int = 0) -> str:
     return f"zero_pp_rank_{dp_rank}_mp_rank_{mp_rank:02d}_optim_states"
 
 
+def offload_states_name(proc: int) -> str:
+    return f"zero_offload_proc_{proc}_optim_states"
+
+
+def _offload_leaf_keys(off):
+    """Leaf path keys in tree_flatten order for the offload class's param tree."""
+    skeleton = jax.tree_util.tree_unflatten(off._treedef,
+                                            [np.zeros(0)] * len(off._shapes))
+    return [_path_key(path)
+            for path, _ in jax.tree_util.tree_flatten_with_path(skeleton)[0]]
+
+
+def _save_offload_regions(engine, ckpt_dir: str):
+    """Per-PROCESS region files for the host-tier state (multi-host safe).
+
+    Each process writes only the master/moment regions its devices own
+    (``zero_offload_proc_N``); a manifest records leaf shapes and every region's
+    slice so any topology can reassemble full leaves on load — the region-wise
+    analog of the reference's per-rank ``zero_pp_rank_N`` files."""
+    off = engine._offload
+    proc = jax.process_index()
+    keys = _offload_leaf_keys(off)
+    shard = {}
+    regions_meta = []
+    for li, regions in enumerate(off._leaf_regions):
+        for r in regions:
+            tag = f"r{li}_{r.offset}"
+            for prefix, buf in (("master", off.fp32), ("exp_avg", off.exp_avg),
+                                ("exp_avg_sq", off.exp_avg_sq)):
+                shard[f"{prefix}/{tag}"] = buf[r.offset:r.offset + r.size]
+            regions_meta.append({"tag": tag, "leaf": li,
+                                 "starts": [sl.start for sl in r.slices],
+                                 "stops": [sl.stop for sl in r.slices]})
+    np.savez(os.path.join(ckpt_dir, offload_states_name(proc) + ".npz"), **shard)
+    # one manifest per process: concurrent writers never touch the same file
+    with open(os.path.join(ckpt_dir, f"offload_manifest_{proc}.json"), "w") as f:
+        json.dump({"n_procs": jax.process_count(), "proc": proc,
+                   "leaves": [{"key": k, "shape": list(shp)}
+                              for k, shp in zip(keys, off._shapes)],
+                   "regions": regions_meta}, f)
+
+
+def _offload_manifests(ckpt_dir: str):
+    import glob
+    return sorted(glob.glob(os.path.join(ckpt_dir, "offload_manifest_*.json")))
+
+
+def _load_offload_regions(ckpt_dir: str):
+    """Reassemble full master/exp_avg/exp_avg_sq flat dicts (key -> full array) from
+    the per-process region files. Topology-agnostic: works for any current dp."""
+    out = None
+    seen_procs = set()
+    n_procs = None
+    for mpath in _offload_manifests(ckpt_dir):
+        with open(mpath) as f:
+            manifest = json.load(f)
+        leaves = manifest["leaves"]
+        seen_procs.add(manifest["proc"])
+        n_procs = manifest["n_procs"]
+        if out is None:
+            out = {prefix: {l["key"]: np.zeros(l["shape"], np.float32) for l in leaves}
+                   for prefix in ("master", "exp_avg", "exp_avg_sq")}
+        path = os.path.join(ckpt_dir, offload_states_name(manifest["proc"]) + ".npz")
+        with np.load(path) as data:
+            for r in manifest["regions"]:
+                leaf = leaves[r["leaf"]]
+                slices = tuple(slice(a, b) for a, b in zip(r["starts"], r["stops"]))
+                shape = tuple(b - a for a, b in zip(r["starts"], r["stops"]))
+                for prefix in ("master", "exp_avg", "exp_avg_sq"):
+                    out[prefix][leaf["key"]][slices] = \
+                        data[f"{prefix}/{r['tag']}"].reshape(shape)
+    assert out is not None, "no offload manifests found"
+    if seen_procs != set(range(n_procs)):
+        # a partial save must fail loud, not restore missing ranks' state as zeros
+        raise RuntimeError(
+            f"offload checkpoint is incomplete: found region files for processes "
+            f"{sorted(seen_procs)} but the save ran with {n_procs} processes")
+    return out["master"], out["exp_avg"], out["exp_avg_sq"]
+
+
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_state: Dict = {},
                     save_latest: bool = True):
-    if getattr(engine, "_offload", None) is not None and jax.process_count() > 1:
-        # Multi-host offload trains with per-process host partitions; assembling the
-        # full master/moment trees for the single-writer layout below would need the
-        # other hosts' regions. Fail loud at save time rather than crash mid-assembly.
-        raise NotImplementedError(
-            "checkpoint save under multi-host ZeRO-Offload is not implemented yet: "
-            "each host holds only its own master/moment regions. Save from a "
-            "single-host run, or disable cpu_offload for checkpointed training.")
     if tag is None:
         tag = f"global_step{engine.global_steps}"
     ckpt_dir = _ckpt_dir(save_dir, tag)
     os.makedirs(ckpt_dir, exist_ok=True)
+    offload = getattr(engine, "_offload", None)
+
+    if offload is not None:
+        # host-tier state: each process writes its own regions (multi-host safe)
+        _save_offload_regions(engine, ckpt_dir)
+        if jax.process_index() != 0:
+            logger.info(f"[deepspeed_tpu] process {jax.process_index()} wrote its "
+                        f"offload regions for checkpoint {tag}")
+            return True
 
     # --- model states (replicated compute params + host-side counters) ---
     _save_tree_npz(os.path.join(ckpt_dir, model_states_name() + ".npz"), engine.params)
@@ -114,22 +202,23 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
     # --- scaler state ---
     _save_tree_npz(os.path.join(ckpt_dir, "loss_scaler.npz"), engine.scaler_state)
 
-    # --- optimizer + master-weight states, one file per DP rank (elastic layout) ---
-    dp = engine.dp_size
-    master_flat = _flatten_with_paths(engine.master_params)
-    opt_flat = _flatten_with_paths(engine.opt_state)
-    for dp_rank in range(dp):
-        shard = {}
-        for prefix, flat in (("master", master_flat), ("opt", opt_flat)):
-            for key, arr in flat.items():
-                parts = np.array_split(arr.reshape(-1), dp)
-                shard[f"{prefix}/{key}"] = parts[dp_rank]
-        np.savez(os.path.join(ckpt_dir, optim_states_name(dp_rank) + ".npz"), **shard)
-    # shape manifest for elastic restore
-    shapes = {f"master/{k}": list(v.shape) for k, v in master_flat.items()}
-    shapes.update({f"opt/{k}": list(v.shape) for k, v in opt_flat.items()})
-    with open(os.path.join(ckpt_dir, "optim_shapes.json"), "w") as f:
-        json.dump({"dp_world_size": dp, "shapes": shapes}, f)
+    if offload is None:
+        # --- optimizer + master states, one file per DP rank (elastic layout) ---
+        dp = engine.dp_size
+        master_flat = _flatten_with_paths(engine.master_params)
+        opt_flat = _flatten_with_paths(engine.opt_state)
+        for dp_rank in range(dp):
+            shard = {}
+            for prefix, flat in (("master", master_flat), ("opt", opt_flat)):
+                for key, arr in flat.items():
+                    parts = np.array_split(arr.reshape(-1), dp)
+                    shard[f"{prefix}/{key}"] = parts[dp_rank]
+            np.savez(os.path.join(ckpt_dir, optim_states_name(dp_rank) + ".npz"), **shard)
+        # shape manifest for elastic restore
+        shapes = {f"master/{k}": list(v.shape) for k, v in master_flat.items()}
+        shapes.update({f"opt/{k}": list(v.shape) for k, v in opt_flat.items()})
+        with open(os.path.join(ckpt_dir, "optim_shapes.json"), "w") as f:
+            json.dump({"dp_world_size": dp, "shapes": shapes}, f)
 
     if save_latest:
         with open(os.path.join(save_dir, "latest"), "w") as f:
@@ -193,24 +282,55 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     engine.scaler_state = _load_tree_npz(os.path.join(ckpt_dir, "loss_scaler.npz"), engine.scaler_state)
 
     if load_optimizer_states:
-        merged = _merge_elastic(ckpt_dir)
-        master_flat = {k[len("master/"):]: v for k, v in merged.items() if k.startswith("master/")}
-        opt_flat = {k[len("opt/"):]: v for k, v in merged.items() if k.startswith("opt/")}
-        if hasattr(engine, "_onebit") and meta["dp_world_size"] != engine.dp_size:
-            # OneBitAdam state sizes are dp-dependent (padded moments, per-worker error
-            # buffers); adapt them instead of failing the reshape below.
-            opt_flat = engine._onebit.elastic_adapt(opt_flat, _flatten_with_paths(engine.opt_state))
-        if getattr(engine, "_offload", None) is not None:
-            # host-tier state: unflatten on the host and copy into the flat offload
-            # buffers (views stay aliased) — never materialize master/moments on device
-            master = _unflatten_like(engine.master_params, master_flat, numpy=True)
-            opt = _unflatten_like(engine.opt_state, opt_flat, numpy=True)
-            engine._offload.load_trees(master, opt.exp_avg, opt.exp_avg_sq)
+        offload = getattr(engine, "_offload", None)
+        has_region_layout = bool(_offload_manifests(ckpt_dir))
+
+        def offload_template():
+            # leaf-shaped numpy skeleton: avoids assembling engine.master_params
+            # (impossible on a multi-host offload engine, whose buffers are partial)
+            return jax.tree_util.tree_unflatten(
+                offload._treedef, [np.zeros(shp, np.float32) for shp in offload._shapes])
+
+        if has_region_layout:
+            # region-wise offload checkpoint: reassemble full flat dicts from the
+            # per-process files (topology-agnostic)
+            master_flat, ea_flat, eas_flat = _load_offload_regions(ckpt_dir)
+            if offload is not None:
+                t = offload_template()
+                offload.load_trees(_unflatten_like(t, master_flat, numpy=True),
+                                   _unflatten_like(t, ea_flat, numpy=True),
+                                   _unflatten_like(t, eas_flat, numpy=True))
+            else:
+                master = _unflatten_like(engine.master_params, master_flat)
+                opt_flat = {f"exp_avg/{k}": v for k, v in ea_flat.items()}
+                opt_flat.update({f"exp_avg_sq/{k}": v for k, v in eas_flat.items()})
+                opt = _unflatten_like(engine.opt_state, opt_flat)
+                engine.master_params = jax.device_put(master, engine._master_shardings)
+                engine.opt_state = jax.device_put(opt, engine._opt_shardings)
         else:
-            master = _unflatten_like(engine.master_params, master_flat)
-            opt = _unflatten_like(engine.opt_state, opt_flat)
-            engine.master_params = jax.device_put(master, engine._master_shardings)
-            engine.opt_state = jax.device_put(opt, engine._opt_shardings)
+            merged = _merge_elastic(ckpt_dir)
+            master_flat = {k[len("master/"):]: v for k, v in merged.items() if k.startswith("master/")}
+            opt_flat = {k[len("opt/"):]: v for k, v in merged.items() if k.startswith("opt/")}
+            if hasattr(engine, "_onebit") and meta["dp_world_size"] != engine.dp_size:
+                # OneBitAdam state sizes are dp-dependent (padded moments, per-worker
+                # error buffers); adapt them instead of failing the reshape below.
+                opt_flat = engine._onebit.elastic_adapt(opt_flat, _flatten_with_paths(engine.opt_state))
+            if offload is not None:
+                # host-tier state: unflatten on the host and copy into the flat offload
+                # buffers — never materialize master/moments on device
+                t = offload_template()
+                ea = {k[len("exp_avg/"):]: v for k, v in opt_flat.items()
+                      if k.startswith("exp_avg/")}
+                eas = {k[len("exp_avg_sq/"):]: v for k, v in opt_flat.items()
+                       if k.startswith("exp_avg_sq/")}
+                offload.load_trees(_unflatten_like(t, master_flat, numpy=True),
+                                   _unflatten_like(t, ea, numpy=True),
+                                   _unflatten_like(t, eas, numpy=True))
+            else:
+                master = _unflatten_like(engine.master_params, master_flat)
+                opt = _unflatten_like(engine.opt_state, opt_flat)
+                engine.master_params = jax.device_put(master, engine._master_shardings)
+                engine.opt_state = jax.device_put(opt, engine._opt_shardings)
     else:
         # re-derive master from loaded params (fp16-derived restore, stage2.py:1781-1836)
         if getattr(engine, "_offload", None) is not None:

@@ -371,6 +371,8 @@ def _bs_bwd(res, g, sm_scale, causal, block, interpret):
     q3, do3 = (x.reshape(B * H, T, D) for x in (q, do))
 
     cols_p, a_pad, kwidth = _pad_lut(cols)
+    assert 2 * a_pad * D * block * q.dtype.itemsize < 12 * 1024 * 1024, \
+        "layout too dense for all-upfront DMA in dq (reduce max row density)"
     # K/V blocked + transposed [BH, nb, D, block] for the lane-concat DMA (as in fwd)
     k3 = k.reshape(B * H, nb, block, D).transpose(0, 1, 3, 2)
     v3 = v.reshape(B * H, nb, block, D).transpose(0, 1, 3, 2)
@@ -399,6 +401,9 @@ def _bs_bwd(res, g, sm_scale, causal, block, interpret):
     )(counts, cols_p, q3, k3, v3, do3, lse3, delta3)
 
     rows_p, at_pad, kwidth_t = _pad_lut(rows_t)
+    assert 2 * at_pad * D * block * q.dtype.itemsize < 12 * 1024 * 1024, \
+        "layout too dense for all-upfront DMA in dkv (a k-column with too many " \
+        "active q-blocks; reduce max column density)"
     # Q/dO blocked + transposed [BH, nb, D, block] for the lane-concat DMA
     q4 = q.reshape(B * H, nb, block, D).transpose(0, 1, 3, 2)
     do4 = do.reshape(B * H, nb, block, D).transpose(0, 1, 3, 2)

@@ -30,6 +30,10 @@ def main():
     parser.add_argument("--local_rank", type=int, default=0)
     parser.add_argument("--out", type=str, required=True)
     parser.add_argument("--steps", type=int, default=3)
+    parser.add_argument("--offload", action="store_true",
+                        help="ZeRO-2 + cpu_offload: each process steps and "
+                             "checkpoints only its own host-tier regions")
+    parser.add_argument("--ckpt_dir", type=str, default=None)
     args = parser.parse_args()
 
     import deepspeed_tpu
@@ -39,11 +43,15 @@ def main():
 
     from simple_model import SimpleModel, random_dataset, simple_config
 
-    model = SimpleModel(hidden_dim=16)
+    hidden = 64 if args.offload else 16  # 64 -> leaves big enough for real ZeRO regions
+    model = SimpleModel(hidden_dim=hidden)
     params = model.init(jax.random.PRNGKey(0))
+    cfg = simple_config(batch=8)
+    if args.offload:
+        cfg["zero_optimization"] = {"stage": 2, "cpu_offload": True}
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
-                                               config_params=simple_config(batch=8))
-    data = random_dataset(8 * args.steps, 16, seed=42)
+                                               config_params=cfg)
+    data = random_dataset(8 * args.steps, hidden, seed=42)
     losses = []
     for i in range(args.steps):
         xs = np.stack([data[i * 8 + j][0] for j in range(8)])
@@ -53,11 +61,28 @@ def main():
         engine.step()
         losses.append(float(jax.device_get(loss)))
 
+    result = {"losses": losses, "world": jax.process_count(),
+              "devices": jax.device_count()}
+    if args.ckpt_dir:
+        # every process writes its offload regions; process 0 writes the rest
+        engine.save_checkpoint(args.ckpt_dir, tag="t0")
+        if args.offload:
+            result["local_numel"] = int(engine._offload.numel)
+            result["n_regions"] = sum(len(r) for r in engine._offload._leaf_regions)
+            # round-trip into a FRESH engine in this same world: the loader reads
+            # every process's region files and scatters back only local regions
+            params2 = model.init(jax.random.PRNGKey(0))
+            engine2, _, _, _ = deepspeed_tpu.initialize(
+                model=model, model_parameters=params2, config_params=cfg)
+            engine2.load_checkpoint(args.ckpt_dir)
+            np.testing.assert_allclose(engine2._offload.fp32, engine._offload.fp32,
+                                       rtol=1e-6)
+            np.testing.assert_allclose(engine2._offload.exp_avg,
+                                       engine._offload.exp_avg, rtol=1e-6)
+            result["roundtrip_ok"] = True
     if jax.process_index() == 0:
         with open(args.out, "w") as f:
-            json.dump({"losses": losses,
-                       "world": jax.process_count(),
-                       "devices": jax.device_count()}, f)
+            json.dump(result, f)
 
 
 if __name__ == "__main__":
