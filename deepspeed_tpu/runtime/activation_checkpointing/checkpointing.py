@@ -164,11 +164,16 @@ def checkpoint_wrapper(fn, policy=None):
             eff_policy = _offload_policy()
         elif policy == "dots":
             eff_policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif policy == "attn":
+            # save only attention OUTPUTS (tagged "attn_out" by the models): backward
+            # skips replaying the flash kernel — the priciest recompute — while the
+            # per-layer residual stays one [B, T, E] tensor
+            eff_policy = jax.checkpoint_policies.save_only_these_names("attn_out")
         elif policy is None or callable(policy):
             eff_policy = policy
         else:
             raise ValueError(f"unknown remat policy {policy!r}: expected None, 'dots', "
-                             f"or a jax.checkpoint_policies callable")
+                             f"'attn', or a jax.checkpoint_policies callable")
         ckpt = jax.checkpoint(placed, policy=eff_policy)
         if _config["profile"]:
             with jax.named_scope("ds_activation_checkpoint"):

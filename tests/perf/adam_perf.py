@@ -31,11 +31,33 @@ def main():
         return dt
 
     t_np = bench(fallback, "numpy")
+    t_nat = None
     if native._lib is not None:
         t_nat = bench(native, "native")
         print(f"native speedup vs numpy: {t_np / t_nat:.1f}x")
     else:
         print("native kernel unavailable")
+
+    # torch.optim.Adam on the same host (the reference claims DeepSpeedCPUAdam is
+    # 5-7x faster than torch Adam, docs/_tutorials/zero-offload.md:9)
+    try:
+        import torch
+    except ImportError:
+        return
+    tp = torch.nn.Parameter(torch.zeros(numel))
+    topt = torch.optim.Adam([tp], lr=1e-3)
+    tg = torch.from_numpy(g)
+    tp.grad = tg
+    topt.step()  # warm (state alloc)
+    t0 = time.perf_counter()
+    iters = 5
+    for _ in range(iters):
+        topt.step()
+    t_torch = (time.perf_counter() - t0) / iters
+    print(f"{'torch':8s}: {t_torch * 1e3:8.2f} ms/step  "
+          f"({numel / t_torch / 1e9:6.2f} Gelem/s)")
+    if t_nat is not None:
+        print(f"native speedup vs torch.optim.Adam: {t_torch / t_nat:.1f}x")
 
 
 if __name__ == "__main__":
