@@ -31,12 +31,23 @@ def _path_key(path) -> str:
                     for p in path)
 
 
+def _leaf_to_host(leaf) -> np.ndarray:
+    """Host copy of a (possibly multi-host sharded) array. Cross-process sharded
+    leaves are gathered collectively — EVERY process must call this on the same
+    leaves in the same order (save_checkpoint guarantees it)."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable \
+            and not leaf.is_fully_replicated:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
+    return np.asarray(jax.device_get(leaf))
+
+
 def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
     flat = {}
     leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
     for path, leaf in leaves_with_paths:
         key = _path_key(path)
-        arr = np.asarray(jax.device_get(leaf))
+        arr = _leaf_to_host(leaf)
         if arr.dtype not in (np.float32, np.float64, np.int32, np.int64, np.bool_,
                              np.uint32, np.uint8, np.int8, np.float16):
             # npz can't natively store ml_dtypes (bfloat16 et al.); widen losslessly.
@@ -60,10 +71,6 @@ def _unflatten_like(template, flat: Dict[str, np.ndarray], numpy: bool = False):
         else:
             leaves.append(jnp.asarray(arr, dtype=leaf.dtype).reshape(leaf.shape))
     return jax.tree_util.tree_unflatten(treedef, leaves)
-
-
-def _save_tree_npz(path: str, tree):
-    np.savez(path, **_flatten_with_paths(tree))
 
 
 def _load_tree_npz(path: str, template):
@@ -234,14 +241,18 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
                     npz = os.path.join(ckpt_dir, offload_states_name(idx) + ".npz")
                     if os.path.isfile(npz):
                         os.remove(npz)
-        if jax.process_index() != 0:
-            logger.info(f"[deepspeed_tpu] process {jax.process_index()} wrote its "
-                        f"offload regions for checkpoint {tag}")
-            _save_barrier()
-            return True
+    # Multi-host: the model-states/scaler/optim-shard/latest files are shared paths —
+    # exactly one WRITER (process 0), or concurrent identical-path np.savez calls
+    # corrupt the archives. But cross-process sharded state (ZeRO masters, a
+    # pipe-sharded wte) needs a collective gather that EVERY process participates in,
+    # so ALL processes run every flatten below (offload included — no early return
+    # before the last flatten) and only the file writes are gated.
+    writer = jax.process_index() == 0
 
     # --- model states (replicated compute params + host-side counters) ---
-    _save_tree_npz(os.path.join(ckpt_dir, model_states_name() + ".npz"), engine.params)
+    params_flat = _flatten_with_paths(engine.params)
+    if writer:
+        np.savez(os.path.join(ckpt_dir, model_states_name() + ".npz"), **params_flat)
     meta = {
         "global_steps": engine.global_steps,
         "micro_steps": engine.micro_steps,
@@ -256,31 +267,36 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
         "lr_scheduler": engine.lr_scheduler.state_dict() if engine.lr_scheduler is not None else None,
         "client_state": client_state,
     }
-    with open(os.path.join(ckpt_dir, model_states_name() + ".json"), "w") as f:
-        json.dump(meta, f)
+    if writer:
+        with open(os.path.join(ckpt_dir, model_states_name() + ".json"), "w") as f:
+            json.dump(meta, f)
 
     # --- scaler state ---
-    _save_tree_npz(os.path.join(ckpt_dir, "loss_scaler.npz"), engine.scaler_state)
+    scaler_flat = _flatten_with_paths(engine.scaler_state)
+    if writer:
+        np.savez(os.path.join(ckpt_dir, "loss_scaler.npz"), **scaler_flat)
 
     if offload is None:
         # --- optimizer + master states, one file per DP rank (elastic layout) ---
         dp = engine.dp_size
         master_flat = _flatten_with_paths(engine.master_params)
         opt_flat = _flatten_with_paths(engine.opt_state)
-        for dp_rank in range(dp):
-            shard = {}
-            for prefix, flat in (("master", master_flat), ("opt", opt_flat)):
-                for key, arr in flat.items():
-                    parts = np.array_split(arr.reshape(-1), dp)
-                    shard[f"{prefix}/{key}"] = parts[dp_rank]
-            np.savez(os.path.join(ckpt_dir, optim_states_name(dp_rank) + ".npz"), **shard)
-        # shape manifest for elastic restore
-        shapes = {f"master/{k}": list(v.shape) for k, v in master_flat.items()}
-        shapes.update({f"opt/{k}": list(v.shape) for k, v in opt_flat.items()})
-        with open(os.path.join(ckpt_dir, "optim_shapes.json"), "w") as f:
-            json.dump({"dp_world_size": dp, "shapes": shapes}, f)
+        if writer:
+            for dp_rank in range(dp):
+                shard = {}
+                for prefix, flat in (("master", master_flat), ("opt", opt_flat)):
+                    for key, arr in flat.items():
+                        parts = np.array_split(arr.reshape(-1), dp)
+                        shard[f"{prefix}/{key}"] = parts[dp_rank]
+                np.savez(os.path.join(ckpt_dir, optim_states_name(dp_rank) + ".npz"),
+                         **shard)
+            # shape manifest for elastic restore
+            shapes = {f"master/{k}": list(v.shape) for k, v in master_flat.items()}
+            shapes.update({f"opt/{k}": list(v.shape) for k, v in opt_flat.items()})
+            with open(os.path.join(ckpt_dir, "optim_shapes.json"), "w") as f:
+                json.dump({"dp_world_size": dp, "shapes": shapes}, f)
 
-    if save_latest:
+    if save_latest and writer:
         with open(os.path.join(save_dir, "latest"), "w") as f:
             f.write(tag)
     _save_barrier()

@@ -37,8 +37,11 @@ class GPT2Pipe:
     (3D = pipe × data × model): the fused qkv columns are stored rank-grouped (see
     ``qkv_tp_permutation``) so each model rank's contiguous shard is a valid local
     (q, k, v), and the stage functions run the Megatron manual-collective forward.
-    Note: checkpoints written with tp>1 store the permuted qkv layout — reload with the
-    same tp, or re-permute through ``from_dense``.
+    Note: checkpoints written with tp>1 store the permuted qkv layout, and the stacked
+    tree's wte carries the stage-multiple vocab padding — both depend on (num_stages,
+    tp). To move a checkpoint across topologies or export to the dense ``GPT2Model``,
+    round-trip through ``to_dense`` (strips the padding, inverts the qkv permutation)
+    and ``from_dense`` on the new topology.
     """
 
     def __init__(self, config: GPT2Config, num_stages: int, tp: int = 1):
@@ -78,6 +81,29 @@ class GPT2Pipe:
 
     def from_dense(self, dense_params) -> Dict[str, Any]:
         return self._stack(dict(dense_params))
+
+    def to_dense(self, params) -> Dict[str, Any]:
+        """Invert ``_stack``: stacked pipe params -> the dense ``GPT2Model`` tree.
+
+        Strips the stage-multiple vocab padding from wte and inverts the tp qkv
+        permutation, so the result is topology-free — load it into ``GPT2Model``
+        directly, or ``from_dense`` it on a different (num_stages, tp)."""
+        io = dict(params["io"])
+        if self.vocab_pad != self.config.vocab_size:
+            io["wte"] = io["wte"][: self.config.vocab_size]
+        S, LpS = self.num_stages, self.layers_per_stage
+        flat_layers = jax.tree_util.tree_map(
+            lambda a: a.reshape((S * LpS,) + a.shape[2:]), params["stages"])
+        blocks = [jax.tree_util.tree_map(lambda a: a[l], flat_layers)
+                  for l in range(S * LpS)]
+        if self.tp > 1:
+            perm = qkv_tp_permutation(self.config.n_embd, self.tp)
+            inv = jnp.argsort(jnp.asarray(perm))
+            blocks = [{**b, "attn": {**b["attn"],
+                                     "c_attn_w": b["attn"]["c_attn_w"][:, inv],
+                                     "c_attn_b": b["attn"]["c_attn_b"][inv]}}
+                      for b in blocks]
+        return {**io, "blocks": blocks}
 
     def _stacked_specs(self, stages):
         """P(pipe, None, *tp_dims) per stacked leaf (tp dims only meaningful for tp>1)."""

@@ -40,6 +40,7 @@ except ImportError:  # pragma: no cover
 
 import jax
 
+from ..utils import logger
 from .native import load_cpu_adam
 
 
@@ -159,6 +160,8 @@ class DeepSpeedCPUAdam:
         self.bias_correction = bias_correction
         self._lib = load_cpu_adam()
         self.last_step_timing = None  # {"fetch_wait": s, "host_adam": s, "push": s, "total": s}
+        self.last_push_elements = 0   # elements crossing the host->device link last step
+        self._warned_fallback = False
 
     # ------------------------------------------------------------- tree views
     def _assemble(self, flat):
@@ -288,19 +291,72 @@ class DeepSpeedCPUAdam:
             shard_by_dev = None
             if isinstance(g, jax.Array) and regions[0].devices is not None:
                 shard_by_dev = {s.device: s for s in g.addressable_shards}
+            leaf_shape = self._shapes[li]
             for r in regions:
                 if shard_by_dev is not None:
                     s = shard_by_dev.get(r.devices[0])
-                    if s is not None and tuple(s.data.shape) == r.shape:
+                    # index match, not just shape: a same-shaped shard of a DIFFERENT
+                    # slice (grads sharded on another axis) must take the assembly path
+                    if s is not None and _normalize_index(
+                            s.index if s.index is not None else (), leaf_shape) == \
+                            tuple((sl.start, sl.stop) for sl in r.slices):
                         s.data.copy_to_host_async()
                         handles.append(("shard", s.data, r))
                         continue
-                # layout mismatch (e.g. XLA-chosen grad layouts under cpu-checkpointing):
-                # fall back to a host slice of the full leaf
+                # Layout mismatch (e.g. XLA-chosen grad layouts under cpu-checkpointing):
+                # reassemble the region from the ADDRESSABLE shards only. Never
+                # device_get the whole leaf — on a multi-host run a cross-process
+                # sharded leaf is not fully addressable and that would crash the step.
                 if isinstance(g, jax.Array):
-                    g.copy_to_host_async()
-                handles.append(("leaf", g, r))
+                    if not self._warned_fallback:
+                        logger.warning(
+                            "[deepspeed_tpu] offload grad fetch: device grad layout does "
+                            "not match the master region layout; assembling regions from "
+                            "addressable shards (slower, per-shard D2H). First leaf "
+                            f"index: {li}")
+                        self._warned_fallback = True
+                    for s in g.addressable_shards:
+                        s.data.copy_to_host_async()
+                    handles.append(("region_shards", g, r))
+                else:
+                    handles.append(("leaf", g, r))
         return handles
+
+    def _region_from_addressable(self, g, r) -> np.ndarray:
+        """Assemble one master region from a jax.Array's addressable shards (the
+        grad layout doesn't tile the region). Raises when the local shards cannot
+        cover the region — e.g. a cross-process sharded leaf on a multi-host run."""
+        shape = self._shapes[r.leaf]
+        out = np.empty(r.shape, np.float32)
+        region_box = [(sl.start, sl.stop) for sl in r.slices]
+        covered = 0
+        seen = set()  # distinct shard boxes only: replicated shards must not double-count
+        for s in g.addressable_shards:
+            box = _normalize_index(s.index if s.index is not None else (), shape)
+            if box in seen:
+                continue
+            inter = []
+            for (a0, a1), (b0, b1) in zip(region_box, box):
+                lo, hi = max(a0, b0), min(a1, b1)
+                if lo >= hi:
+                    inter = None
+                    break
+                inter.append((lo, hi))
+            if inter is None:
+                continue
+            seen.add(box)
+            block = np.asarray(s.data)  # waits for this shard's async copy
+            src = tuple(slice(lo - b0, hi - b0) for (lo, hi), (b0, _) in zip(inter, box))
+            dst = tuple(slice(lo - a0, hi - a0) for (lo, hi), (a0, _) in zip(inter, region_box))
+            out[dst] = np.asarray(block[src], np.float32)
+            covered += int(np.prod([hi - lo for lo, hi in inter]))
+        if covered < r.size:
+            raise ValueError(
+                f"offload grad leaf {r.leaf} (shape {shape}): region {region_box} is not "
+                f"fully addressable from process {jax.process_index()} ({covered}/{r.size} "
+                "elements) — the grad sharding does not match the master layout on a "
+                "multi-host run; give the grads the engine's master/grad shardings")
+        return out
 
     def step_regions(self, handles, step: int, lr: float, beta1: float = 0.9,
                      beta2: float = 0.999, eps: float = 1e-8, weight_decay: float = 0.0,
@@ -312,12 +368,16 @@ class DeepSpeedCPUAdam:
         use_fused_bf16 = (_BF16 is not None and out_np == np.dtype(_BF16))
         t_fetch = t_adam = t_push = 0.0
         t0 = time.perf_counter()
+        pushed_elems = 0
         pieces = [dict() for _ in self._leaf_regions]  # leaf -> {device: jax.Array}
+        repl_single = [None] * len(self._leaf_regions)  # whole-leaf replicated: 1 push/host
         host_leaves = [None] * len(self._leaf_regions)
         for kind, data, r in handles:
             t = time.perf_counter()
             if kind == "shard":
                 h = np.asarray(data)  # blocks until this region's copy lands
+            elif kind == "region_shards":
+                h = self._region_from_addressable(data, r)
             else:
                 if host_leaves[r.leaf] is None:
                     host_leaves[r.leaf] = np.asarray(jax.device_get(data), np.float32)
@@ -341,23 +401,46 @@ class DeepSpeedCPUAdam:
             t = time.perf_counter()
             if r.devices is None:
                 pieces[r.leaf][None] = out_host
+            elif (len(r.devices) > 1 and len(self._leaf_regions[r.leaf]) == 1
+                  and len(self._shardings[r.leaf].device_set) == len(r.devices)):
+                # A leaf ZeRO couldn't shard (replicated whole-leaf region), all of its
+                # devices addressable here: push ONE copy over the host link and let a
+                # jitted reshard broadcast it device-to-device (ICI) below —
+                # host->device bytes stay proportional to the partition, not
+                # x n_devices. (Multi-host replicated leaves keep per-device pushes:
+                # a process-local single-device array cannot enter a cross-process jit.)
+                repl_single[r.leaf] = jax.device_put(out_host, r.devices[0])
+                pushed_elems += r.size
             else:
                 for dev in r.devices:
                     pieces[r.leaf][dev] = jax.device_put(out_host, dev)  # async H2D
+                    pushed_elems += r.size
             t_push += time.perf_counter() - t
 
         t = time.perf_counter()
         out = []
+        reshard_idx = []
         for li, (shape, sh) in enumerate(zip(self._shapes, self._shardings)):
             if sh is None:
                 out.append(pieces[li][None])
-                continue
-            dmap = sh.addressable_devices_indices_map(tuple(shape))
-            arrs = [pieces[li][d] for d in dmap]
-            out.append(jax.make_array_from_single_device_arrays(shape, sh, arrs))
+            elif repl_single[li] is not None:
+                out.append(repl_single[li])  # placeholder; replaced by the reshard jit
+                reshard_idx.append(li)
+            else:
+                dmap = sh.addressable_devices_indices_map(tuple(shape))
+                arrs = [pieces[li][d] for d in dmap]
+                out.append(jax.make_array_from_single_device_arrays(shape, sh, arrs))
+        if reshard_idx:
+            # device_put from a committed on-device array reshards device-to-device
+            # (the broadcast rides ICI, not the host link)
+            resharded = jax.device_put([out[li] for li in reshard_idx],
+                                       [self._shardings[li] for li in reshard_idx])
+            for li, arr in zip(reshard_idx, resharded):
+                out[li] = arr
         t_push += time.perf_counter() - t
         self.last_step_timing = {"fetch_wait": t_fetch, "host_adam": t_adam,
                                  "push": t_push, "total": time.perf_counter() - t0}
+        self.last_push_elements = pushed_elems
         return jax.tree_util.tree_unflatten(self._treedef, out)
 
     # ------------------------------------------------------------- checkpoint plumbing

@@ -498,6 +498,9 @@ def flash_attention(q, k, v, causal: bool = False, sm_scale: Optional[float] = N
     ``bias``: optional additive key bias, any shape squeezable to [B, T_k] (the BERT
     padding mask [B,1,1,T] included) — fused into the in-kernel softmax, replacing the
     reference's scale+mask softmax kernel (csrc/transformer/softmax_kernels.cu).
+    ``bias`` receives NO gradient (it is stop_gradient'ed here): it is a padding/attention
+    mask, not a learnable table. Route learnable additive biases (ALiBi slopes, relative
+    position tables) through q/k instead.
     ``dropout_rate``/``dropout_seed``: in-kernel attention dropout over the post-softmax
     probabilities (csrc/transformer/dropout_kernels.cu); the seed is a traced operand so
     remat replays identical masks. ``dropout_keep_reference`` reproduces the exact mask
@@ -511,6 +514,8 @@ def flash_attention(q, k, v, causal: bool = False, sm_scale: Optional[float] = N
         seed = None
     if bias is not None:
         B, T_k = q.shape[0], k.shape[2]
-        bias = jnp.asarray(bias, jnp.float32).reshape(B, 1, T_k)
+        # no-grad contract made explicit in the jaxpr: a learnable bias passed here
+        # would otherwise silently train with zero gradient (see docstring)
+        bias = jax.lax.stop_gradient(jnp.asarray(bias, jnp.float32).reshape(B, 1, T_k))
     return _flash_attention_core(q, k, v, bias, seed, bool(causal), sm_scale, rate,
                                  block_q, block_k, interpret)

@@ -585,15 +585,31 @@ class DeepSpeedEngine:
         # construction still lands on the compatible jit.
         self._loss_and_grad_fn = loss_and_grad
         self._jit_loss_and_grad_cached = None
+        self._jit_eval_cached = None
+
+        # Under offload, per-microbatch grads stay in the compute dtype (halves the
+        # backward HBM footprint) but the ACCUMULATOR is fp32 when the window spans
+        # multiple micro-batches: bf16 a+g loses mantissa bits as the window grows and
+        # loss-scaled fp16 sums can overflow mid-window. The reference accumulates into
+        # fp32 host buffers (stage2.py async CPU grad accumulation) — matching numerics
+        # costs one fp32 accumulator, which the host fetch reads anyway.
+        acc_dtype = (jnp.float32 if (self._offload is not None and grad_acc_steps > 1)
+                     else grad_dtype)
+        self._acc_dtype = acc_dtype
 
         def accumulate(acc, grads):
-            return jax.tree_util.tree_map(lambda a, g: a + g, acc, grads)
+            return jax.tree_util.tree_map(lambda a, g: a + g.astype(acc_dtype), acc, grads)
 
         self._jit_accumulate = jax.jit(
             accumulate,
             in_shardings=(self._grad_shardings, self._grad_shardings),
             out_shardings=self._grad_shardings,
             donate_argnums=(0,))
+        # (no donation: a compute-dtype buffer can't back the wider fp32 output)
+        self._jit_adopt_acc = (None if acc_dtype == grad_dtype else jax.jit(
+            lambda g: jax.tree_util.tree_map(lambda x: x.astype(acc_dtype), g),
+            in_shardings=(self._grad_shardings,),
+            out_shardings=self._grad_shardings))
 
         def apply_update(master, opt_state, scaler_state, acc_grads, step, hyper):
             scale = scaler_state.cur_scale
@@ -673,29 +689,50 @@ class DeepSpeedEngine:
     def __call__(self, *inputs, **kwargs):
         return self.forward(*inputs, **kwargs)
 
+    def _cpu_checkpointing_active(self) -> bool:
+        """Whether host-offloaded remat residuals are in play for this engine's traces.
+        An engine WITH a JSON activation_checkpointing block decides from its own
+        config (another engine's configure() must not strip its grad shardings);
+        an engine WITHOUT one consults the process-global module, since its model's
+        checkpoint_wrapper traces against that same global state."""
+        from .activation_checkpointing import checkpointing as act_ckpt
+        ac = self.config.activation_checkpointing_config
+        if ac.configured_in_json:
+            return bool(ac.cpu_checkpointing)
+        return bool(act_ckpt.cpu_checkpointing_enabled())
+
     @property
     def _jit_loss_and_grad(self):
         """Built lazily at first training forward so the cpu-checkpointing decision sees
         both this engine's JSON config and any later module-level act_ckpt.configure()
         call (a post-first-step reconfigure cannot retroactively change the jit)."""
         if self._jit_loss_and_grad_cached is None:
-            from .activation_checkpointing import checkpointing as act_ckpt
-            ac = self.config.activation_checkpointing_config
-            # An engine WITH a JSON activation_checkpointing block decides from its own
-            # config (another engine's configure() must not strip its grad shardings);
-            # an engine WITHOUT one consults the process-global module, since its model's
-            # checkpoint_wrapper traces against that same global state.
-            if ac.configured_in_json:
-                cpu_ckpt = ac.cpu_checkpointing
-            else:
-                cpu_ckpt = act_ckpt.cpu_checkpointing_enabled()
-            if cpu_ckpt:
+            if self._cpu_checkpointing_active():
                 self._jit_loss_and_grad_cached = jax.jit(self._loss_and_grad_fn)
             else:
                 self._jit_loss_and_grad_cached = jax.jit(
                     self._loss_and_grad_fn,
                     out_shardings=(NamedSharding(self.mesh, P()), self._grad_shardings))
         return self._jit_loss_and_grad_cached
+
+    @property
+    def _jit_eval(self):
+        """Jitted loss-only forward for eval() mode — the train path jits, and an
+        op-by-op eval dispatch on a billion-parameter model is pathologically slow.
+        Mirrors _jit_loss_and_grad's sharding handling (same cpu-checkpointing caveat)."""
+        if self._jit_eval_cached is None:
+            model_fn = self.model_fn
+
+            def eval_loss(params, *batch):
+                out = model_fn(params, *batch)
+                return out[0] if isinstance(out, (tuple, list)) else out
+
+            if self._cpu_checkpointing_active():
+                self._jit_eval_cached = jax.jit(eval_loss)
+            else:
+                self._jit_eval_cached = jax.jit(
+                    eval_loss, out_shardings=NamedSharding(self.mesh, P()))
+        return self._jit_eval_cached
 
     def forward(self, *inputs):
         """Compute the loss (and cache this micro-batch's gradients for backward)."""
@@ -707,8 +744,7 @@ class DeepSpeedEngine:
             self._pending_grads = grads
             self._pending_loss = loss
         else:
-            out = self.model_fn(self.params, *batch)
-            loss = out[0] if isinstance(out, (tuple, list)) else out
+            loss = self._jit_eval(self.params, *batch)
             self._pending_grads = None
         if self.wall_clock_breakdown():
             self.timers("forward_microstep").stop()
@@ -724,7 +760,9 @@ class DeepSpeedEngine:
             # First micro-batch of the window: adopt the grads directly (they already have
             # the right sharding/dtype) instead of paying a zeros+add pass. With
             # gradient_accumulation_steps == 1 this removes the accumulate kernel entirely.
-            self._grad_acc = self._pending_grads
+            # (Offload with accumulation > 1 upcasts to the fp32 accumulator dtype here.)
+            self._grad_acc = (self._pending_grads if self._jit_adopt_acc is None
+                              else self._jit_adopt_acc(self._pending_grads))
         else:
             self._grad_acc = self._jit_accumulate(self._grad_acc, self._pending_grads)
         self._pending_grads = None

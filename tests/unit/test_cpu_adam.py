@@ -362,3 +362,76 @@ def test_offload_checkpoint_cross_layout(tmp_path):
     l3 = _train(e3, steps=1, hidden=64)[0]
     l4 = _train(e4, steps=1, hidden=64)[0]
     assert abs(l3 - l4) < 1e-4
+
+
+def test_offload_push_bytes_proportional_to_partition():
+    """H2D pushes after the host step must total the local PARTITION size, not
+    x n_devices (VERDICT r2 next #9): replicated leaves ride one PCIe push + an
+    on-device broadcast."""
+    model = SimpleModel(hidden_dim=16)  # leaves too small to shard -> replicated on 8 devs
+    eng = _make_engine(model, offload=True)
+    _train(eng, steps=1)
+    off = eng._offload
+    assert off.last_push_elements == off.numel, \
+        (off.last_push_elements, off.numel, jax.device_count())
+    if jax.device_count() > 1:
+        # every region in this config is replicated across all devices
+        assert all(len(r.devices or []) > 1 for rs in off._leaf_regions for r in rs)
+    # the broadcast arrays still carry the construction shardings
+    for leaf, sh in zip(jax.tree_util.tree_leaves(eng.params),
+                        jax.tree_util.tree_leaves(eng._param_shardings)):
+        assert leaf.sharding.is_equivalent_to(sh, leaf.ndim)
+
+
+def test_offload_grad_fetch_fallback_uses_addressable_shards():
+    """A grad layout that doesn't tile the master regions must be assembled from
+    addressable shards (never whole-leaf device_get, which breaks multi-host), and the
+    stepped result must match the matched-layout path (ADVICE r2 medium #2)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >=2 devices")
+    mesh = Mesh(np.array(devs).reshape(len(devs), 1), ("data", "model"))
+    rng = np.random.default_rng(11)
+    params = {"w": rng.normal(size=(8 * len(devs), 16)).astype(np.float32)}
+    master_sh = {"w": NamedSharding(mesh, P("data", None))}
+    opt = DeepSpeedCPUAdam(params, shardings=master_sh)
+    assert len(opt._leaf_regions[0]) == len(devs)
+
+    g_np = {"w": rng.normal(size=params["w"].shape).astype(np.float32)}
+    # grads sharded on the WRONG axis: per-device shard shape != region shape
+    g_dev = {"w": jax.device_put(g_np["w"], NamedSharding(mesh, P(None, "data")))}
+    handles = opt.begin_grad_fetch(g_dev)
+    assert any(kind == "region_shards" for kind, _, _ in handles)
+    assert opt._warned_fallback
+    opt.step_regions(handles, step=1, lr=1e-2, weight_decay=0.01)
+
+    ref = DeepSpeedCPUAdam(params, shardings=master_sh)
+    ref.step_regions(ref.begin_grad_fetch(
+        {"w": jax.device_put(g_np["w"], master_sh["w"])}), step=1, lr=1e-2,
+        weight_decay=0.01)
+    np.testing.assert_allclose(opt.fp32, ref.fp32, rtol=1e-6, atol=1e-7)
+
+
+def test_offload_grad_accumulation_fp32_accumulator():
+    """With accumulation > 1 under offload, the accumulate buffer must be fp32 even
+    though per-microbatch grads stay in the compute dtype (ADVICE r2 medium #1)."""
+    model = SimpleModel(hidden_dim=16)
+    params = model.init(jax.random.PRNGKey(0))
+    cfg = simple_config(batch=16, gradient_accumulation_steps=2)
+    cfg["zero_optimization"] = {"stage": 2, "cpu_offload": True}
+    cfg["bf16"] = {"enabled": True}
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                            config_params=cfg)
+    assert eng._acc_dtype == jnp.float32
+    x = np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32)
+    y = np.zeros((8, 16), np.float32)
+    loss = eng(x, y)
+    eng.backward(loss)
+    for leaf in jax.tree_util.tree_leaves(eng._grad_acc):
+        assert leaf.dtype == jnp.float32
+    loss = eng(x, y)
+    eng.backward(loss)
+    eng.step()
+    assert eng.global_steps == 1
+    assert np.all(np.isfinite(eng._offload.fp32))
