@@ -90,6 +90,160 @@ def bench_420m():
             "gpt2_420m_mfu": round(mfu, 4)}
 
 
+def _shard_optimizer(dp):
+    """Client (init, apply) pair for DeepSpeedEngine doing exactly one v5e-32 ZeRO-2
+    rank's optimizer work: Adam over a 1/dp fp32 shard of the gradient stream (the
+    engine's full fp32 master passes through untouched — a real rank would instead
+    all-gather updated bf16 shards, which needs the other 31 chips)."""
+    import jax
+    import jax.numpy as jnp
+
+    def shard_of(tree):
+        leaves = jax.tree_util.tree_leaves(tree)
+        n = sum(l.size for l in leaves) // dp
+        flat = jnp.concatenate(
+            [l.reshape(-1)[: max(l.size // dp, 1)].astype(jnp.bfloat16) for l in leaves])
+        if flat.shape[0] < n:
+            flat = jnp.pad(flat, (0, n - flat.shape[0]))
+        return flat[:n].astype(jnp.float32), n
+
+    def init(master):
+        n = sum(l.size for l in jax.tree_util.tree_leaves(master)) // dp
+        return {"shard": jnp.zeros((n,), jnp.float32),
+                "m1": jnp.zeros((n,), jnp.float32),
+                "m2": jnp.zeros((n,), jnp.float32)}
+
+    def apply(grads, state, master, step, hyper):
+        gs, _ = shard_of(grads)
+        m1 = hyper["beta1"] * state["m1"] + (1.0 - hyper["beta1"]) * gs
+        m2 = hyper["beta2"] * state["m2"] + (1.0 - hyper["beta2"]) * gs * gs
+        shard = state["shard"] - hyper["lr"] * m1 / (jnp.sqrt(m2) + hyper["eps"])
+        return master, {"shard": shard, "m1": m1, "m2": m2}
+
+    return init, apply
+
+
+def bench_1p5b_engine(remat_policy="dots", batch=8):
+    """The 1.5B metric measured THROUGH DeepSpeedEngine (VERDICT r2 next #1a): the
+    real jitted value_and_grad, grad adoption, apply_update with donated buffers,
+    monitor/report path — with the per-rank optimizer work supplied as a client
+    (init, apply) pair. Differences vs a real v5e-32 rank: the engine's dp=1 fp32
+    master is FULL (6.2 GB; a real rank holds 1/32), which also forces the full
+    params re-cast each step, and cross-chip collectives are excluded."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.parallel.mesh import build_mesh
+
+    cfg = GPT2Config(vocab_size=50304, n_positions=1024, n_embd=1600, n_layer=48,
+                     n_head=25, remat=True,
+                     remat_policy=None if remat_policy == "full" else remat_policy,
+                     use_flash_attention=True)
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    n_params = model.param_count(params)
+    engine = DeepSpeedEngine(
+        model=model, model_parameters=params, mesh=build_mesh(model=1, pipe=1),
+        optimizer=_shard_optimizer(32),
+        config_params={"train_batch_size": batch, "steps_per_print": 1000,
+                       "bf16": {"enabled": True},
+                       "zero_optimization": {"stage": 2}})
+    del params
+    gc.collect()
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(batch, 1024)).astype(np.int32)
+    labels = np.roll(tokens, -1, axis=1)
+
+    def step():
+        loss = engine(tokens, labels)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    step()
+    _fence(step())  # second warmup: donated-buffer layouts recompile
+    steps = 5
+    dt = float("inf")
+    for _ in range(2):
+        t0 = time.time()
+        for _ in range(steps):
+            loss = step()
+        _fence(loss)
+        dt = min(dt, time.time() - t0)
+    tps = batch * 1024 * steps / dt
+    mfu = tps * 6.0 * n_params / 1e12 / PEAK_TFLOPS
+    del engine
+    gc.collect()
+    return tps, mfu
+
+
+def _engine_1p5b_subprocess():
+    """Engine-driven 1.5B in a fresh process (an OOM must not poison the relay for
+    the rest of the bench), falling back through lighter configs."""
+    import subprocess
+    # measured r3: dots/attn at batch 8 and dots at 4 OOM next to the dp=1 fp32
+    # master; attn@4 (0.395 MFU) edges out full@8 (0.388). dots@8 stays first in
+    # case a future round frees HBM (it matches the hand-rolled 0.46-MFU config).
+    for policy, batch in (("dots", 8), ("attn", 4), ("full", 8)):
+        try:
+            r = subprocess.run([sys.executable, os.path.abspath(__file__),
+                                "--engine-1p5b", policy, str(batch)],
+                               capture_output=True, text=True, timeout=1500)
+            for line in r.stdout.splitlines():
+                if line.startswith("ENGINE_OK "):
+                    _, tps, mfu = line.split()
+                    return float(tps), float(mfu), f"remat={policy},batch={batch}"
+            sys.stderr.write(f"[bench] engine 1.5B ({policy}, B={batch}) failed:\n"
+                             + "\n".join(r.stderr.splitlines()[-3:]) + "\n")
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"[bench] engine 1.5B ({policy}, B={batch}) timed out\n")
+    return 0.0, 0.0, "failed"
+
+
+def bench_offload_step_timing():
+    """One REAL ZeRO-Offload engine step with DeepSpeedCPUAdam.last_step_timing
+    (VERDICT r2 next #1b). Sized for the axon tunnel (~3 MB/s D2H): a ~30M-param
+    GPT-2 keeps the transfer minutes-bounded; the fetch/adam/push breakdown (not the
+    absolute wall) is the evidence — on a TPU-VM's PCIe-class host link the same
+    structure holds with transfer ~1000x faster. The max-fit capacity config (3.9B)
+    is footprint-probed separately; a timed step there would be pure tunnel wait."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.parallel.mesh import build_mesh
+
+    cfg = GPT2Config(vocab_size=8192, n_positions=512, n_embd=512, n_layer=8,
+                     n_head=8, remat=True, use_flash_attention=True)
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    n_params = model.param_count(params)
+    engine = DeepSpeedEngine(
+        model=model, model_parameters=params, mesh=build_mesh(model=1, pipe=1),
+        config_params={"train_batch_size": 4, "steps_per_print": 1000,
+                       "bf16": {"enabled": True},
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                       "zero_optimization": {"stage": 2, "cpu_offload": True}})
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(4, 512)).astype(np.int32)
+    labels = np.roll(tokens, -1, axis=1)
+    loss = engine(tokens, labels)
+    engine.backward(loss)
+    engine.step()
+    _fence(loss)
+    t = dict(engine._offload.last_step_timing)
+    out = {"params": int(n_params), "numel_local": int(engine._offload.numel),
+           "fetch_wait_s": round(t["fetch_wait"], 3),
+           "host_adam_s": round(t["host_adam"], 3),
+           "push_s": round(t["push"], 3), "total_s": round(t["total"], 3),
+           "note": ("axon-tunnel transfer dominates (~3 MB/s D2H); breakdown proves "
+                    "the overlapped region pipeline, not production wall-clock")}
+    del engine, params
+    gc.collect()
+    return out
+
+
 def _zero2_step_fn(model, dp_shard):
     """jitted fwd+bwd + the 1/dp fp32 Adam-shard update of one ZeRO-2 rank."""
     import jax
@@ -248,12 +402,56 @@ def max_params_offload():
     return best
 
 
+def collect_workload_evidence():
+    """Driver-visible workload/parity evidence (VERDICT r2 next #8): run the
+    tests/model functional suite (8-virtual-device CPU mesh) and tests/tpu_parity.py
+    (compiled-TPU kernel numerics) as subprocesses and fold pass/fail into the bench
+    JSON, so rounds can't silently regress them. DS_BENCH_SKIP_WORKLOADS=1 skips."""
+    import re
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = {}
+
+    try:
+        r = subprocess.run([sys.executable, os.path.join(here, "tests", "tpu_parity.py")],
+                           capture_output=True, text=True, timeout=900, cwd=here)
+        passed = r.returncode == 0 and "all TPU parity checks passed" in r.stdout
+        out["tpu_parity"] = {"passed": bool(passed), "returncode": r.returncode,
+                             "checks": r.stdout.count("PASS "),
+                             "failures": r.stdout.count("FAIL ")}
+    except subprocess.TimeoutExpired:
+        out["tpu_parity"] = {"passed": False, "error": "timeout"}
+
+    try:
+        r = subprocess.run([sys.executable, "-m", "pytest", "tests/model", "-q"],
+                           capture_output=True, text=True, timeout=1500, cwd=here)
+        m = re.search(r"(\d+) passed", r.stdout)
+        f = re.search(r"(\d+) failed", r.stdout)
+        out["model_suite"] = {"passed": int(m.group(1)) if m else 0,
+                              "failed": int(f.group(1)) if f else
+                              (0 if r.returncode == 0 else -1),
+                              "returncode": r.returncode}
+    except subprocess.TimeoutExpired:
+        out["model_suite"] = {"passed": 0, "failed": -1, "error": "timeout"}
+
+    try:
+        with open(os.path.join(here, "WORKLOADS.json"), "w") as fh:
+            json.dump(out, fh)
+    except OSError:
+        pass
+    return out
+
+
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)) or ".")
     if len(sys.argv) >= 3 and sys.argv[1] == "--probe":
         ok, n = probe_offload_footprint(int(sys.argv[2]))
         if ok:
             print(f"PROBE_OK {n}")
+        return
+    if len(sys.argv) >= 4 and sys.argv[1] == "--engine-1p5b":
+        tps, mfu = bench_1p5b_engine(remat_policy=sys.argv[2], batch=int(sys.argv[3]))
+        print(f"ENGINE_OK {tps:.1f} {mfu:.4f}")
         return
     import jax
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -301,8 +499,20 @@ def main():
                   "gpt2_1p5b_note": ("fwd+bwd on full 1.5B bf16 params + 1/32 fp32 "
                                      "optimizer-shard update (one v5e-32 ZeRO-2 rank's "
                                      "per-chip work; cross-chip collectives excluded)")})
+    # the same metric measured THROUGH DeepSpeedEngine (jitted engine paths +
+    # donated-buffer update; full dp=1 fp32 master is the engine's extra burden)
+    e_tps, e_mfu, e_cfg = _engine_1p5b_subprocess()
+    extra.update({"gpt2_1p5b_engine_tokens_per_sec": round(e_tps, 1),
+                  "gpt2_1p5b_engine_mfu": round(e_mfu, 4),
+                  "gpt2_1p5b_engine_config": e_cfg})
+    try:
+        extra["offload_step_timing"] = bench_offload_step_timing()
+    except Exception as e:
+        extra["offload_step_timing"] = {"error": f"{type(e).__name__}: {e}"}
     mp = max_params_offload()
     extra["max_trainable_params_per_chip_zero_offload"] = int(mp)
+    if os.environ.get("DS_BENCH_SKIP_WORKLOADS", "0") != "1":
+        extra["workloads"] = collect_workload_evidence()
     print(json.dumps({"metric": "gpt2_1p5b_zero2_tokens_per_sec_per_chip",
                       "value": round(tps, 1), "unit": "tokens/s",
                       "vs_baseline": round(mfu / 0.40, 4),

@@ -42,11 +42,19 @@ def _leaf_to_host(leaf) -> np.ndarray:
     return np.asarray(jax.device_get(leaf))
 
 
-def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
+def _flatten_with_paths(tree, materialize: bool = True) -> Dict[str, np.ndarray]:
+    """``materialize=False`` (non-writer processes): join only the collective
+    gathers that cross-process sharded leaves require — skip the redundant D2H of
+    every addressable/replicated leaf (N-1 wasted full-model copies otherwise)."""
     flat = {}
     leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
     for path, leaf in leaves_with_paths:
         key = _path_key(path)
+        if not materialize:
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable \
+                    and not leaf.is_fully_replicated:
+                _leaf_to_host(leaf)  # collective participation only
+            continue
         arr = _leaf_to_host(leaf)
         if arr.dtype not in (np.float32, np.float64, np.int32, np.int64, np.bool_,
                              np.uint32, np.uint8, np.int8, np.float16):
@@ -250,7 +258,7 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
     writer = jax.process_index() == 0
 
     # --- model states (replicated compute params + host-side counters) ---
-    params_flat = _flatten_with_paths(engine.params)
+    params_flat = _flatten_with_paths(engine.params, materialize=writer)
     if writer:
         np.savez(os.path.join(ckpt_dir, model_states_name() + ".npz"), **params_flat)
     meta = {
@@ -272,15 +280,15 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
             json.dump(meta, f)
 
     # --- scaler state ---
-    scaler_flat = _flatten_with_paths(engine.scaler_state)
+    scaler_flat = _flatten_with_paths(engine.scaler_state, materialize=writer)
     if writer:
         np.savez(os.path.join(ckpt_dir, "loss_scaler.npz"), **scaler_flat)
 
     if offload is None:
         # --- optimizer + master states, one file per DP rank (elastic layout) ---
         dp = engine.dp_size
-        master_flat = _flatten_with_paths(engine.master_params)
-        opt_flat = _flatten_with_paths(engine.opt_state)
+        master_flat = _flatten_with_paths(engine.master_params, materialize=writer)
+        opt_flat = _flatten_with_paths(engine.opt_state, materialize=writer)
         if writer:
             for dp_rank in range(dp):
                 shard = {}

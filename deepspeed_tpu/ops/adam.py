@@ -11,6 +11,11 @@ The functional contract (init/apply) is shared by all optimizers in this package
   apply(grads, opt_state, master_params, step, hyper) -> (new_master_params, new_opt_state)
 where ``hyper`` is a dict of *device scalars* {lr, beta1, beta2, eps, weight_decay} so
 schedule changes never recompile.
+
+Per-group hyperparameters (the reference's torch param_groups with per-group
+lr/weight_decay, engine.py:503-650): pass ``groups`` — a pytree of STATIC ints mirroring
+the params — and make each ``hyper`` value a [n_groups] device array; every leaf then
+indexes its group's scalars at trace time (no gather in the compiled update).
 """
 
 from typing import NamedTuple
@@ -30,19 +35,35 @@ def init(master_params) -> AdamState:
     return AdamState(exp_avg=zeros, exp_avg_sq=zeros2)
 
 
-def apply(grads, state: AdamState, master_params, step, hyper, adamw: bool = True):
+def hyper_for_group(hyper: dict, gi: int) -> dict:
+    """Per-leaf view of ``hyper``: index [n_groups] arrays by the leaf's static group
+    id; pass 0-d scalars through (single-group mode)."""
+    out = {}
+    for k, h in hyper.items():
+        arr = jnp.asarray(h)
+        out[k] = arr[gi] if arr.ndim else arr
+    return out
+
+
+def flat_group_ids(groups, n_leaves: int):
+    """[static int per leaf] from a groups pytree (all-zeros when groups is None)."""
+    if groups is None:
+        return [0] * n_leaves
+    ids = [int(g) for g in jax.tree_util.tree_leaves(groups)]
+    assert len(ids) == n_leaves, f"groups tree has {len(ids)} leaves, params {n_leaves}"
+    return ids
+
+
+def apply(grads, state: AdamState, master_params, step, hyper, adamw: bool = True,
+          groups=None):
     """One Adam step. ``step`` is the 1-based update count (device int32)."""
-    lr = hyper["lr"]
-    b1 = hyper["beta1"]
-    b2 = hyper["beta2"]
-    eps = hyper["eps"]
-    wd = hyper["weight_decay"]
-
     stepf = step.astype(jnp.float32)
-    bc1 = 1.0 - jnp.power(b1, stepf)
-    bc2 = 1.0 - jnp.power(b2, stepf)
 
-    def leaf(g, m, v, p):
+    def leaf(g, m, v, p, gi):
+        h = hyper_for_group(hyper, gi)
+        lr, b1, b2, eps, wd = h["lr"], h["beta1"], h["beta2"], h["eps"], h["weight_decay"]
+        bc1 = 1.0 - jnp.power(b1, stepf)
+        bc2 = 1.0 - jnp.power(b2, stepf)
         g = g.astype(jnp.float32)
         if not adamw:
             # classic L2 Adam (torch.optim.Adam / reference apex FusedAdam): the decay
@@ -63,9 +84,10 @@ def apply(grads, state: AdamState, master_params, step, hyper, adamw: bool = Tru
     flat_m = jax.tree_util.tree_leaves(state.exp_avg)
     flat_v = jax.tree_util.tree_leaves(state.exp_avg_sq)
     flat_p = jax.tree_util.tree_leaves(master_params)
+    flat_gi = flat_group_ids(groups, len(flat_g))
     new_p, new_m, new_v = [], [], []
-    for g, m, v, p in zip(flat_g, flat_m, flat_v, flat_p):
-        np_, nm, nv = leaf(g, m, v, p)
+    for g, m, v, p, gi in zip(flat_g, flat_m, flat_v, flat_p, flat_gi):
+        np_, nm, nv = leaf(g, m, v, p, gi)
         new_p.append(np_)
         new_m.append(nm)
         new_v.append(nv)

@@ -360,10 +360,14 @@ class DeepSpeedCPUAdam:
 
     def step_regions(self, handles, step: int, lr: float, beta1: float = 0.9,
                      beta2: float = 0.999, eps: float = 1e-8, weight_decay: float = 0.0,
-                     grad_scale: float = 1.0, out_dtype=np.float32):
+                     grad_scale: float = 1.0, out_dtype=np.float32, leaf_hypers=None):
         """Partitioned, overlapped step: wait-per-region D2H -> native Adam -> async H2D
         push of the updated compute-dtype slice. Returns the tree of GLOBAL jax arrays
-        (one per leaf, carrying the construction sharding) in ``out_dtype``."""
+        (one per leaf, carrying the construction sharding) in ``out_dtype``.
+
+        ``leaf_hypers``: optional per-leaf {lr, beta1, beta2, eps, weight_decay} dicts
+        (tree_flatten order) overriding the scalar args — the engine's per-group
+        hyperparameters applied on the host tier."""
         out_np = np.dtype(out_dtype)
         use_fused_bf16 = (_BF16 is not None and out_np == np.dtype(_BF16))
         t_fetch = t_adam = t_push = 0.0
@@ -387,14 +391,20 @@ class DeepSpeedCPUAdam:
             t_fetch += time.perf_counter() - t
 
             t = time.perf_counter()
+            if leaf_hypers is not None:
+                hy = leaf_hypers[r.leaf]
+                r_lr, r_b1, r_b2 = hy["lr"], hy["beta1"], hy["beta2"]
+                r_eps, r_wd = hy["eps"], hy["weight_decay"]
+            else:
+                r_lr, r_b1, r_b2, r_eps, r_wd = lr, beta1, beta2, eps, weight_decay
             if use_fused_bf16:
                 out_seg = np.empty(r.size, np.uint16)
-                self._kernel_step(lo, hi, self._grad_buf, step, lr, beta1, beta2, eps,
-                                  weight_decay, grad_scale, out_bf16=out_seg)
+                self._kernel_step(lo, hi, self._grad_buf, step, r_lr, r_b1, r_b2, r_eps,
+                                  r_wd, grad_scale, out_bf16=out_seg)
                 out_host = out_seg.view(_BF16).reshape(r.shape)
             else:
-                self._kernel_step(lo, hi, self._grad_buf, step, lr, beta1, beta2, eps,
-                                  weight_decay, grad_scale)
+                self._kernel_step(lo, hi, self._grad_buf, step, r_lr, r_b1, r_b2, r_eps,
+                                  r_wd, grad_scale)
                 out_host = self.fp32[lo:hi].astype(out_np).reshape(r.shape)
             t_adam += time.perf_counter() - t
 

@@ -23,14 +23,12 @@ def init(master_params) -> LambState:
 
 
 def apply(grads, state: LambState, master_params, step, hyper,
-          max_coeff: float = 10.0, min_coeff: float = 0.01):
-    lr = hyper["lr"]
-    b1 = hyper["beta1"]
-    b2 = hyper["beta2"]
-    eps = hyper["eps"]
-    wd = hyper["weight_decay"]
+          max_coeff: float = 10.0, min_coeff: float = 0.01, groups=None):
+    from .adam import flat_group_ids, hyper_for_group
 
-    def leaf(g, m, v, p):
+    def leaf(g, m, v, p, gi):
+        h = hyper_for_group(hyper, gi)
+        lr, b1, b2, eps, wd = h["lr"], h["beta1"], h["beta2"], h["eps"], h["weight_decay"]
         g = g.astype(jnp.float32)
         m = b1 * m + (1.0 - b1) * g
         v = b2 * v + (1.0 - b2) * jnp.square(g)
@@ -46,9 +44,10 @@ def apply(grads, state: LambState, master_params, step, hyper,
     flat_m = jax.tree_util.tree_leaves(state.exp_avg)
     flat_v = jax.tree_util.tree_leaves(state.exp_avg_sq)
     flat_p = jax.tree_util.tree_leaves(master_params)
+    flat_gi = flat_group_ids(groups, len(flat_g))
     new_p, new_m, new_v = [], [], []
-    for g, m, v, p in zip(flat_g, flat_m, flat_v, flat_p):
-        np_, nm, nv = leaf(g, m, v, p)
+    for g, m, v, p, gi in zip(flat_g, flat_m, flat_v, flat_p, flat_gi):
+        np_, nm, nv = leaf(g, m, v, p, gi)
         new_p.append(np_)
         new_m.append(nm)
         new_v.append(nv)

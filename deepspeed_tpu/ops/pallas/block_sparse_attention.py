@@ -27,9 +27,33 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
+def _row_band_masks(rows, block, group):
+    """Per-sub-band boolean predicates ([rows, block] each), precomputed once per
+    kernel so the per-slot membership mask is scalar selects, not a per-element
+    variable shift (which measurably regressed the VPU path)."""
+    if group == 1:
+        return None
+    iota = jax.lax.broadcasted_iota(jnp.int32, (rows, block), 0) // block
+    return [iota == g for g in range(group)]
+
+
+def _memb_mask(bits, band, group, rows, block):
+    """[rows, block] membership mask from a slot's bitmask scalar: band predicates
+    AND'd with their scalar bit. group == 1 degenerates to one scalar broadcast."""
+    if group == 1:
+        return jnp.broadcast_to(bits > 0, (rows, block))
+    ok = band[0] & (bits & 1 == 1)
+    for g in range(1, group):
+        ok = ok | (band[g] & ((bits >> g) & 1 == 1))
+    return ok
+
+
 # ---------------------------------------------------------------------------
 # LUT construction (host-side, static per layout)
 # ---------------------------------------------------------------------------
+
+_MEMB_SHIFT = 24  # block index in bits 0..23, membership bitmask in bits 24..30
+
 
 def build_luts(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """From [H, nb, nb] layout build forward and transposed LUTs.
@@ -38,23 +62,47 @@ def build_luts(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray, 
     cols[h*nb+i, :counts[...]] are the active k-block indices of q-row i (sorted);
     rows_t the active q-block indices of k-column j.
     """
+    counts, packed = build_grouped_luts(layout, 1)
+    counts_t, packed_t = build_grouped_luts(np.transpose(np.asarray(layout), (0, 2, 1)), 1)
+    return counts, packed & ((1 << _MEMB_SHIFT) - 1), counts_t, \
+        packed_t & ((1 << _MEMB_SHIFT) - 1)
+
+
+def build_grouped_luts(layout: np.ndarray, group: int):
+    """LUT over GROUPS of ``group`` consecutive q-rows: each group's list is the
+    UNION of its rows' active k-blocks, with a per-slot membership bitmask (bit g
+    set iff sub-row g of the group attends that k-block) PACKED into the entry's
+    high bits — one prefetch array, because the LUTs live in scoped SMEM and a
+    BigBird global row makes the LUT width = nb (a second array blew the SMEM
+    budget at T=8192). Grouping packs several low-count layout rows into one
+    [group*block, ...] grid cell — bigger MXU tiles and 1/group the per-row fixed
+    cost, the lever that closes the gap to the density-ideal speedup.
+
+    Returns (counts [H*ng], packed [H*ng, A]) with packed = kb | memb << 24; padded
+    slots have memb == 0 so their lanes mask to zero regardless of the count check.
+    """
     layout = np.asarray(layout) != 0
     H, nb, _ = layout.shape
-    max_a = max(1, int(layout.sum(-1).max()))
-    max_at = max(1, int(layout.sum(-2).max()))
-    counts = np.zeros((H * nb,), np.int32)
-    cols = np.zeros((H * nb, max_a), np.int32)
-    counts_t = np.zeros((H * nb,), np.int32)
-    rows_t = np.zeros((H * nb, max_at), np.int32)
+    assert nb % group == 0, f"layout rows {nb} not divisible by group {group}"
+    assert nb < (1 << _MEMB_SHIFT) and group <= 7, "packed LUT limits: nb < 2^24, group <= 7"
+    ng = nb // group
+    per_group = []
+    max_a = 1
     for h in range(H):
-        for i in range(nb):
-            act = np.nonzero(layout[h, i])[0]
-            counts[h * nb + i] = len(act)
-            cols[h * nb + i, :len(act)] = act
-            act_t = np.nonzero(layout[h, :, i])[0]
-            counts_t[h * nb + i] = len(act_t)
-            rows_t[h * nb + i, :len(act_t)] = act_t
-    return counts, cols, counts_t, rows_t
+        for gi in range(ng):
+            rows = layout[h, gi * group:(gi + 1) * group]  # [group, nb]
+            act = np.nonzero(rows.any(axis=0))[0]
+            max_a = max(max_a, len(act))
+            per_group.append((h, gi, rows, act))
+    counts = np.zeros((H * ng,), np.int32)
+    packed = np.zeros((H * ng, max_a), np.int32)
+    for h, gi, rows, act in per_group:
+        r = h * ng + gi
+        counts[r] = len(act)
+        for idx, kb in enumerate(act):
+            memb = int(sum(1 << g for g in range(group) if rows[g, kb]))
+            packed[r, idx] = int(kb) | (memb << _MEMB_SHIFT)
+    return counts, packed
 
 
 # ---------------------------------------------------------------------------
@@ -62,20 +110,22 @@ def build_luts(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray, 
 # ---------------------------------------------------------------------------
 
 def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
-                   kbuf, vbuf, sems, *, sm_scale, causal, block, num_heads, nb, kwidth):
+                   kbuf, vbuf, sems, *, sm_scale, causal, block, num_heads, ng, kwidth,
+                   group):
     """K/V stay in HBM; only the layout's active blocks are DMA'd in — HBM traffic
     scales with density, not seq_len^2 (splash-attention structure).
 
     Blocks land LANE-CONCATENATED in VMEM ([D, A_pad*block] scratch), so the compute
-    loop consumes ``kwidth`` blocks per iteration as one [bq, kwidth*block] score tile:
-    MXU-shaped matmuls and 1/kwidth the loop/softmax-bookkeeping overhead — this is
-    what closed the round-1 gap where per-iteration fixed cost made 17%-density time
-    like dense."""
+    loop consumes ``kwidth`` blocks per iteration as one [group*block, kwidth*block]
+    score tile. ``group`` q-rows share a grid cell via the union LUT: each sub-row's
+    actual membership is a per-slot bitmask in the entry's high bits, masked per
+    128-row band —
+    bigger MXU tiles and 1/group the per-row fixed cost at low density."""
     b = pl.program_id(0)
     i = pl.program_id(1)
     h = b % num_heads
-    row = h * nb + i
-    bq = q_ref.shape[0]
+    row = h * ng + i
+    bq = q_ref.shape[0]  # group * block
     d = q_ref.shape[1]
     # bf16-in/fp32-accumulate is the MXU's native mode (see flash_attention._fwd_kernel)
     q = q_ref[...]
@@ -84,13 +134,13 @@ def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
     n_slots = ((n_active + kwidth - 1) // kwidth) * kwidth  # padded slots DMA block 0
 
     def start_dma(j):
-        kb = cols_ref[row, j]
+        kb = cols_ref[row, j] & ((1 << _MEMB_SHIFT) - 1)
         dst = pl.ds(j * block, block)
         pltpu.make_async_copy(k_hbm.at[b, kb], kbuf.at[:, dst], sems.at[0, j]).start()
         pltpu.make_async_copy(v_hbm.at[b, kb], vbuf.at[:, dst], sems.at[1, j]).start()
 
     def wait_dma(j):
-        kb = cols_ref[row, j]
+        kb = cols_ref[row, j] & ((1 << _MEMB_SHIFT) - 1)
         dst = pl.ds(j * block, block)
         pltpu.make_async_copy(k_hbm.at[b, kb], kbuf.at[:, dst], sems.at[0, j]).wait()
         pltpu.make_async_copy(v_hbm.at[b, kb], vbuf.at[:, dst], sems.at[1, j]).wait()
@@ -102,6 +152,8 @@ def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
     m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
+    lane_iota = jax.lax.broadcasted_iota(jnp.int32, (bq, block), 1)
+    band = _row_band_masks(bq, block, group)
 
     def body(t, carry):
         m, l, acc = carry
@@ -111,14 +163,14 @@ def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
         kt = kbuf[:, tile]               # [D, kwidth*block]
         vt = vbuf[:, tile]
         s = jnp.dot(q, kt, preferred_element_type=jnp.float32) * sm_scale  # [bq, W*blk]
-        # per-sub-block k positions + validity (padded slots hold garbage block 0)
+        # per-sub-block k positions + per-sub-row membership (padded slots: memb 0)
         parts_pos, parts_ok = [], []
         for w in range(kwidth):
-            j = t * kwidth + w
-            kb = cols_ref[row, jnp.minimum(j, cols_ref.shape[1] - 1)]
-            iota = jax.lax.broadcasted_iota(jnp.int32, (bq, block), 1)
-            parts_pos.append(kb * block + iota)
-            parts_ok.append(jnp.full((bq, block), True) & (j < n_active))
+            j = jnp.minimum(t * kwidth + w, cols_ref.shape[1] - 1)
+            entry = cols_ref[row, j]
+            kb = entry & ((1 << _MEMB_SHIFT) - 1)
+            parts_pos.append(kb * block + lane_iota)
+            parts_ok.append(_memb_mask(entry >> _MEMB_SHIFT, band, group, bq, block))
         k_pos = jnp.concatenate(parts_pos, axis=1)
         ok = jnp.concatenate(parts_ok, axis=1)
         if causal:
@@ -127,7 +179,7 @@ def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
         s = jnp.where(ok, s, DEFAULT_MASK_VALUE)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        p = jnp.where(ok, p, 0.0)  # exact zero for padded lanes
+        p = jnp.where(ok, p, 0.0)  # exact zero for padded/non-member lanes
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         # p @ v with v stored [D, W*block]: contract the lane dims
@@ -144,16 +196,17 @@ def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
     lse_ref[...] = (m + jnp.log(l)).reshape(1, bq)
 
 
-def _bs_dq_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, do_ref, lse_ref, delta_ref,
-                  dq_ref, kbuf, vbuf, sems, *, sm_scale, causal, block, num_heads, nb,
-                  kwidth):
-    """dq over this q-row's active k-blocks, kwidth blocks per iteration (same
-    HBM-resident K/V + lane-concatenated VMEM scratch structure as the forward)."""
+def _bs_dq_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, do_ref, lse_ref,
+                  delta_ref, dq_ref, kbuf, vbuf, sems, *, sm_scale, causal, block,
+                  num_heads, ng, kwidth, group):
+    """dq over this q-row-GROUP's union of active k-blocks, kwidth blocks per
+    iteration (same HBM-resident K/V + lane-concatenated VMEM scratch + membership
+    bitmask structure as the forward)."""
     b = pl.program_id(0)
     i = pl.program_id(1)
     h = b % num_heads
-    row = h * nb + i
-    bq, d = q_ref.shape
+    row = h * ng + i
+    bq, d = q_ref.shape  # bq = group * block
     q = q_ref[...]
     do = do_ref[...]
     lse = lse_ref[...].reshape(bq, 1)
@@ -163,18 +216,20 @@ def _bs_dq_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, do_ref, lse_ref, de
     n_slots = ((n_active + kwidth - 1) // kwidth) * kwidth
 
     def start_dma(j):
-        kb = cols_ref[row, j]
+        kb = cols_ref[row, j] & ((1 << _MEMB_SHIFT) - 1)
         dst = pl.ds(j * block, block)
         pltpu.make_async_copy(k_hbm.at[b, kb], kbuf.at[:, dst], sems.at[0, j]).start()
         pltpu.make_async_copy(v_hbm.at[b, kb], vbuf.at[:, dst], sems.at[1, j]).start()
 
     def wait_dma(j):
-        kb = cols_ref[row, j]
+        kb = cols_ref[row, j] & ((1 << _MEMB_SHIFT) - 1)
         dst = pl.ds(j * block, block)
         pltpu.make_async_copy(k_hbm.at[b, kb], kbuf.at[:, dst], sems.at[0, j]).wait()
         pltpu.make_async_copy(v_hbm.at[b, kb], vbuf.at[:, dst], sems.at[1, j]).wait()
 
     jax.lax.fori_loop(0, n_slots, lambda j, c: (start_dma(j), c)[1], 0)
+    lane_iota = jax.lax.broadcasted_iota(jnp.int32, (bq, block), 1)
+    band = _row_band_masks(bq, block, group)
 
     def body(t, dq):
         jax.lax.fori_loop(t * kwidth, (t + 1) * kwidth,
@@ -185,11 +240,11 @@ def _bs_dq_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, do_ref, lse_ref, de
         s = jnp.dot(q, kt, preferred_element_type=jnp.float32) * sm_scale
         parts_pos, parts_ok = [], []
         for w in range(kwidth):
-            j = t * kwidth + w
-            kb = cols_ref[row, jnp.minimum(j, cols_ref.shape[1] - 1)]
-            iota = jax.lax.broadcasted_iota(jnp.int32, (bq, block), 1)
-            parts_pos.append(kb * block + iota)
-            parts_ok.append(jnp.full((bq, block), True) & (j < n_active))
+            j = jnp.minimum(t * kwidth + w, cols_ref.shape[1] - 1)
+            entry = cols_ref[row, j]
+            kb = entry & ((1 << _MEMB_SHIFT) - 1)
+            parts_pos.append(kb * block + lane_iota)
+            parts_ok.append(_memb_mask(entry >> _MEMB_SHIFT, band, group, bq, block))
         k_pos = jnp.concatenate(parts_pos, axis=1)
         ok = jnp.concatenate(parts_ok, axis=1)
         if causal:
@@ -211,19 +266,20 @@ def _bs_dq_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, do_ref, lse_ref, de
     dq_ref[...] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
-def _bs_dkv_kernel(counts_t_ref, rows_t_ref, q_hbm, k_ref, v_ref, do_hbm, lse_ref,
-                   delta_ref, dk_ref, dv_ref, qbuf, dobuf, sems, *, sm_scale, causal,
-                   block, num_heads, nb, kwidth):
-    """dk/dv over this k-column's active q-blocks, kwidth blocks per iteration.
-    Q/dO stay in HBM stored TRANSPOSED [BH, nb, D, block] (lane dim = block, so HBM
-    slices are 128-lane aligned — [block, D<128] tiles trip Mosaic's memref_slice);
+def _bs_dkv_kernel(counts_t_ref, rows_t_ref, q_hbm, k_ref, v_ref, do_hbm,
+                   lse_ref, delta_ref, dk_ref, dv_ref, qbuf, dobuf, sems, *, sm_scale,
+                   causal, block, num_heads, ng, kwidth, group):
+    """dk/dv over this k-column-GROUP's union of active q-blocks, kwidth blocks per
+    iteration. Q/dO stay in HBM stored TRANSPOSED [BH, nb, D, block] (lane dim = the
+    128-aligned block size — [block, D<128] tiles trip Mosaic's memref_slice);
     active q-blocks are DMA'd lane-concatenated into [D, A_pad*block] scratch and all
-    matmuls contract via dimension_numbers instead of VMEM transposes."""
+    matmuls contract via dimension_numbers instead of VMEM transposes. Membership
+    bitmasks select which of the ``group`` k-column bands each q-block attends."""
     b = pl.program_id(0)
-    i = pl.program_id(1)  # k-block index
+    i = pl.program_id(1)  # k-column-group index
     h = b % num_heads
-    col = h * nb + i
-    bk, d = k_ref.shape
+    col = h * ng + i
+    bk, d = k_ref.shape  # bk = group * block
     k = k_ref[...]
     v = v_ref[...]
 
@@ -231,18 +287,25 @@ def _bs_dkv_kernel(counts_t_ref, rows_t_ref, q_hbm, k_ref, v_ref, do_hbm, lse_re
     n_slots = ((n_active + kwidth - 1) // kwidth) * kwidth
 
     def start_dma(j):
-        qb = rows_t_ref[col, j]
+        qb = rows_t_ref[col, j] & ((1 << _MEMB_SHIFT) - 1)
         dst = pl.ds(j * block, block)
         pltpu.make_async_copy(q_hbm.at[b, qb], qbuf.at[:, dst], sems.at[0, j]).start()
         pltpu.make_async_copy(do_hbm.at[b, qb], dobuf.at[:, dst], sems.at[1, j]).start()
 
     def wait_dma(j):
-        qb = rows_t_ref[col, j]
+        qb = rows_t_ref[col, j] & ((1 << _MEMB_SHIFT) - 1)
         dst = pl.ds(j * block, block)
         pltpu.make_async_copy(q_hbm.at[b, qb], qbuf.at[:, dst], sems.at[0, j]).wait()
         pltpu.make_async_copy(do_hbm.at[b, qb], dobuf.at[:, dst], sems.at[1, j]).wait()
 
     jax.lax.fori_loop(0, n_slots, lambda j, c: (start_dma(j), c)[1], 0)
+    row_iota = jax.lax.broadcasted_iota(jnp.int32, (block, bk), 0)
+    # which of the group's 128-column bands a lane belongs to (transposed band masks)
+    if group == 1:
+        band = None
+    else:
+        lane_sub = jax.lax.broadcasted_iota(jnp.int32, (block, bk), 1) // block
+        band = [lane_sub == g for g in range(group)]
 
     def body(t, carry):
         dk, dv = carry
@@ -253,12 +316,12 @@ def _bs_dkv_kernel(counts_t_ref, rows_t_ref, q_hbm, k_ref, v_ref, do_hbm, lse_re
         dot = dobuf[:, tile]             # [D, W*block]
         parts_pos, parts_ok, parts_lse, parts_delta = [], [], [], []
         for w in range(kwidth):
-            j = t * kwidth + w
-            qb = rows_t_ref[col, jnp.minimum(j, rows_t_ref.shape[1] - 1)]
+            j = jnp.minimum(t * kwidth + w, rows_t_ref.shape[1] - 1)
+            entry = rows_t_ref[col, j]
+            qb = entry & ((1 << _MEMB_SHIFT) - 1)
             qs = pl.ds(qb * block, block)
-            iota = jax.lax.broadcasted_iota(jnp.int32, (block, bk), 0)
-            parts_pos.append(qb * block + iota)
-            parts_ok.append(jnp.full((block, bk), True) & (j < n_active))
+            parts_pos.append(qb * block + row_iota)
+            parts_ok.append(_memb_mask(entry >> _MEMB_SHIFT, band, group, block, bk))
             parts_lse.append(lse_ref[0, qs].reshape(block, 1))
             parts_delta.append(delta_ref[0, qs].reshape(block, 1))
         q_pos = jnp.concatenate(parts_pos, axis=0)
@@ -304,19 +367,32 @@ _KWIDTH = 4  # k-blocks consumed per compute iteration (one [bq, KW*block] score
 
 
 def _pad_lut(lut, max_width=_KWIDTH):
-    """Clamp the tile width to the LUT and pad the LUT width to a tile multiple
-    (padded slots DMA block 0; their lanes are masked in-kernel).
+    """Clamp the tile width to the LUT and pad its width to a tile multiple
+    (padded slots DMA block 0; their lanes mask out via the zero membership bits
+    in the entries' high bits).
     Returns (padded_lut, padded_width, kwidth)."""
-    kwidth = max(1, min(max_width, int(lut.shape[1])))
-    a_pad = (int(lut.shape[1]) + kwidth - 1) // kwidth * kwidth
-    if a_pad != lut.shape[1]:
-        lut = jnp.pad(lut, ((0, 0), (0, a_pad - lut.shape[1])))
-    return lut, a_pad, kwidth
+    width = int(lut.shape[1])
+    kwidth = max(1, min(max_width, width))
+    a_pad = (width + kwidth - 1) // kwidth * kwidth
+    if a_pad != width:
+        lut = jnp.pad(lut, ((0, 0), (0, a_pad - width)))
+    return jnp.asarray(lut), a_pad, kwidth
 
 
-def _bs_fwd(q, k, v, counts, cols, sm_scale, causal, block, interpret):
+def _pick_group(nb: int, block: int) -> int:
+    """Rows per grid cell: target 256-row score tiles (two 128 blocks), capped at 4
+    (the membership select chain grows with group), falling back to 1 when the
+    layout height doesn't divide."""
+    g = min(4, max(1, 256 // block))
+    while g > 1 and nb % g != 0:
+        g //= 2
+    return g
+
+
+def _bs_fwd(q, k, v, counts, cols, group, sm_scale, causal, block, interpret):
     B, H, T, D = q.shape
     nb = T // block
+    ng = nb // group
     q3 = q.reshape(B * H, T, D)
     # K/V blocks stored transposed [BH, nb, D, block]: the DMA'd tile's lane dim is the
     # 128-aligned block size, and the kernel's matmuls consume [D, block] directly
@@ -331,20 +407,20 @@ def _bs_fwd(q, k, v, counts, cols, sm_scale, causal, block, interpret):
     assert vmem_need < 12 * 1024 * 1024, \
         f"layout too dense for all-upfront DMA ({vmem_need} B of VMEM); reduce max row density"
     kernel = functools.partial(_bs_fwd_kernel, sm_scale=sm_scale, causal=causal, block=block,
-                               num_heads=H, nb=nb, kwidth=kwidth)
+                               num_heads=H, ng=ng, kwidth=kwidth, group=group)
     out, lse = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(B * H, nb),
+            grid=(B * H, ng),
             in_specs=[
-                pl.BlockSpec((None, block, D), lambda b, i, c0, c1: (b, i, 0)),
+                pl.BlockSpec((None, group * block, D), lambda b, i, *_: (b, i, 0)),
                 pl.BlockSpec(memory_space=pl.ANY),  # K stays in HBM
                 pl.BlockSpec(memory_space=pl.ANY),  # V stays in HBM
             ],
             out_specs=[
-                pl.BlockSpec((None, block, D), lambda b, i, c0, c1: (b, i, 0)),
-                pl.BlockSpec((None, 1, block), lambda b, i, c0, c1: (b, 0, i)),
+                pl.BlockSpec((None, group * block, D), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((None, 1, group * block), lambda b, i, *_: (b, 0, i)),
             ],
             scratch_shapes=[
                 pltpu.VMEM((D, a_pad * block), q.dtype),
@@ -360,10 +436,11 @@ def _bs_fwd(q, k, v, counts, cols, sm_scale, causal, block, interpret):
     return out.reshape(B, H, T, D), lse.reshape(B, H, T)
 
 
-def _bs_bwd(res, g, sm_scale, causal, block, interpret):
-    q, k, v, out, lse, counts, cols, counts_t, rows_t = res
+def _bs_bwd(res, g, sm_scale, causal, block, group, interpret):
+    (q, k, v, out, lse, counts, cols, counts_t, rows_t) = res
     B, H, T, D = q.shape
     nb = T // block
+    ng = nb // group
     do = g
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     lse3 = lse.reshape(B * H, 1, T)
@@ -378,19 +455,19 @@ def _bs_bwd(res, g, sm_scale, causal, block, interpret):
     v3 = v.reshape(B * H, nb, block, D).transpose(0, 1, 3, 2)
     dq = pl.pallas_call(
         functools.partial(_bs_dq_kernel, sm_scale=sm_scale, causal=causal, block=block,
-                          num_heads=H, nb=nb, kwidth=kwidth),
+                          num_heads=H, ng=ng, kwidth=kwidth, group=group),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(B * H, nb),
+            grid=(B * H, ng),
             in_specs=[
-                pl.BlockSpec((None, block, D), lambda b, i, c0, c1: (b, i, 0)),
+                pl.BlockSpec((None, group * block, D), lambda b, i, *_: (b, i, 0)),
                 pl.BlockSpec(memory_space=pl.ANY),  # K stays in HBM
                 pl.BlockSpec(memory_space=pl.ANY),  # V stays in HBM
-                pl.BlockSpec((None, block, D), lambda b, i, c0, c1: (b, i, 0)),
-                pl.BlockSpec((None, 1, block), lambda b, i, c0, c1: (b, 0, i)),
-                pl.BlockSpec((None, 1, block), lambda b, i, c0, c1: (b, 0, i)),
+                pl.BlockSpec((None, group * block, D), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((None, 1, group * block), lambda b, i, *_: (b, 0, i)),
+                pl.BlockSpec((None, 1, group * block), lambda b, i, *_: (b, 0, i)),
             ],
-            out_specs=pl.BlockSpec((None, block, D), lambda b, i, c0, c1: (b, i, 0)),
+            out_specs=pl.BlockSpec((None, group * block, D), lambda b, i, *_: (b, i, 0)),
             scratch_shapes=[
                 pltpu.VMEM((D, a_pad * block), q.dtype),
                 pltpu.VMEM((D, a_pad * block), q.dtype),
@@ -411,21 +488,21 @@ def _bs_bwd(res, g, sm_scale, causal, block, interpret):
     v3f = v.reshape(B * H, T, D)
     dk, dv = pl.pallas_call(
         functools.partial(_bs_dkv_kernel, sm_scale=sm_scale, causal=causal, block=block,
-                          num_heads=H, nb=nb, kwidth=kwidth_t),
+                          num_heads=H, ng=ng, kwidth=kwidth_t, group=group),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(B * H, nb),
+            grid=(B * H, ng),
             in_specs=[
                 pl.BlockSpec(memory_space=pl.ANY),  # Q stays in HBM
-                pl.BlockSpec((None, block, D), lambda b, i, c0, c1: (b, i, 0)),
-                pl.BlockSpec((None, block, D), lambda b, i, c0, c1: (b, i, 0)),
+                pl.BlockSpec((None, group * block, D), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((None, group * block, D), lambda b, i, *_: (b, i, 0)),
                 pl.BlockSpec(memory_space=pl.ANY),  # dO stays in HBM
-                pl.BlockSpec((None, 1, T), lambda b, i, c0, c1: (b, 0, 0)),
-                pl.BlockSpec((None, 1, T), lambda b, i, c0, c1: (b, 0, 0)),
+                pl.BlockSpec((None, 1, T), lambda b, i, *_: (b, 0, 0)),
+                pl.BlockSpec((None, 1, T), lambda b, i, *_: (b, 0, 0)),
             ],
             out_specs=[
-                pl.BlockSpec((None, block, D), lambda b, i, c0, c1: (b, i, 0)),
-                pl.BlockSpec((None, block, D), lambda b, i, c0, c1: (b, i, 0)),
+                pl.BlockSpec((None, group * block, D), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((None, group * block, D), lambda b, i, *_: (b, i, 0)),
             ],
             scratch_shapes=[
                 pltpu.VMEM((D, at_pad * block), q.dtype),
@@ -445,21 +522,23 @@ def _bs_bwd(res, g, sm_scale, causal, block, interpret):
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
 def _bs_attention_core(q, k, v, counts, cols, counts_t, rows_t,
-                       block, causal, sm_scale, interpret):
-    out, _ = _bs_core_fwd(q, k, v, counts, cols, counts_t, rows_t, block, causal, sm_scale,
-                          interpret)
+                       block, causal, sm_scale, group, interpret):
+    out, _ = _bs_core_fwd(q, k, v, counts, cols, counts_t, rows_t,
+                          block, causal, sm_scale, group, interpret)
     return out
 
 
-def _bs_core_fwd(q, k, v, counts, cols, counts_t, rows_t, block, causal, sm_scale, interpret):
-    out, lse = _bs_fwd(q, k, v, counts, cols, sm_scale, causal, block, interpret)
+def _bs_core_fwd(q, k, v, counts, cols, counts_t, rows_t,
+                 block, causal, sm_scale, group, interpret):
+    out, lse = _bs_fwd(q, k, v, counts, cols, group, sm_scale, causal, block,
+                       interpret)
     return out, (q, k, v, out, lse, counts, cols, counts_t, rows_t)
 
 
-def _bs_core_bwd(block, causal, sm_scale, interpret, res, g):
-    dq, dk, dv = _bs_bwd(res, g, sm_scale, causal, block, interpret)
+def _bs_core_bwd(block, causal, sm_scale, group, interpret, res, g):
+    dq, dk, dv = _bs_bwd(res, g, sm_scale, causal, block, group, interpret)
     return dq, dk, dv, None, None, None, None
 
 
@@ -467,18 +546,30 @@ _bs_attention_core.defvjp(_bs_core_fwd, _bs_core_bwd)
 
 
 def block_sparse_attention(q, k, v, layout, block: int, causal: bool = False,
-                           sm_scale: Optional[float] = None, interpret: Optional[bool] = None):
-    """Block-sparse attention on [B, H, T, D] with a [H, T/block, T/block] layout."""
+                           sm_scale: Optional[float] = None, interpret: Optional[bool] = None,
+                           group: Optional[int] = None):
+    """Block-sparse attention on [B, H, T, D] with a [H, T/block, T/block] layout.
+
+    ``group``: layout q-rows (and, transposed, k-columns) packed per grid cell via a
+    union LUT + membership bitmasks; default targets 256-wide score tiles."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     assert q.shape[2] % block == 0, f"seq len {q.shape[2]} must be divisible by block {block}"
     assert layout.shape[1] == q.shape[2] // block, "layout block-count mismatch with seq len"
-    counts, cols, counts_t, rows_t = build_luts(np.asarray(layout))
+    layout = np.asarray(layout)
+    nb = q.shape[2] // block
+    if group is None:
+        group = _pick_group(nb, block)
+    while nb % group != 0:
+        group //= 2
+    group = max(1, group)
+    counts, cols = build_grouped_luts(layout, group)
+    counts_t, rows_t = build_grouped_luts(np.transpose(layout, (0, 2, 1)), group)
     return _bs_attention_core(q, k, v, jnp.asarray(counts), jnp.asarray(cols),
                               jnp.asarray(counts_t), jnp.asarray(rows_t),
-                              block, causal, sm_scale, interpret)
+                              block, causal, sm_scale, group, interpret)
 
 
 def dense_blocksparse_attention(q, k, v, layout, block: int, causal: bool = False,

@@ -15,12 +15,13 @@ def init(master_params) -> SgdState:
         lambda p: jnp.zeros(p.shape, jnp.float32), master_params))
 
 
-def apply(grads, state: SgdState, master_params, step, hyper):
-    lr = hyper["lr"]
-    mom = hyper.get("beta1", 0.0)  # momentum rides the beta1 slot
-    wd = hyper["weight_decay"]
+def apply(grads, state: SgdState, master_params, step, hyper, groups=None):
+    from .adam import flat_group_ids, hyper_for_group
 
-    def leaf(g, b, p):
+    def leaf(g, b, p, gi):
+        h = hyper_for_group(hyper, gi)
+        lr, wd = h["lr"], h["weight_decay"]
+        mom = h.get("beta1", 0.0)  # momentum rides the beta1 slot
         g = g.astype(jnp.float32) + wd * p
         b = mom * b + g
         return p - lr * b, b
@@ -28,9 +29,10 @@ def apply(grads, state: SgdState, master_params, step, hyper):
     flat_g, treedef = jax.tree_util.tree_flatten(grads)
     flat_b = jax.tree_util.tree_leaves(state.momentum_buf)
     flat_p = jax.tree_util.tree_leaves(master_params)
+    flat_gi = flat_group_ids(groups, len(flat_g))
     new_p, new_b = [], []
-    for g, b, p in zip(flat_g, flat_b, flat_p):
-        np_, nb = leaf(g, b, p)
+    for g, b, p, gi in zip(flat_g, flat_b, flat_p, flat_gi):
+        np_, nb = leaf(g, b, p, gi)
         new_p.append(np_)
         new_b.append(nb)
     unflat = jax.tree_util.tree_unflatten

@@ -25,9 +25,11 @@ stage-input size per step, while 1F1B WITHOUT remat holds up to S in-flight
 micro-batches x full per-layer activations (~12x stage-input per stage for 2-layer
 stages) regardless of M. For the training configs this engine targets (M <= ~4S
 micro-batches per accumulation window), GPipe+remat live memory is at or below
-1F1B-without-remat; 1F1B's advantage only reappears at M >> S, where raising the
-engine's gradient-accumulation steps (multiple pipeline flushes per optimizer step)
-bounds M per flush the same way.
+1F1B-without-remat. At M >> S, ``pipeline_apply`` automatically splits the window
+into rematerialized flushes of <= 4S micro-batches (``_flushed_apply``), restoring
+the bound: measured at M = 16S (GPT-2 2L/128E/S=2, T=512, mb-batch 16, grad of the
+full loss, peak RSS on the 8-virtual-device CPU) single flush 4529 MB vs scanned
+flushes 2287 MB.
 
 Requires homogeneous stages (equal per-stage blocks) — the layout GPT/BERT stacks
 naturally have. Heterogeneous first/last work (embedding, LM head, loss) runs inside the
@@ -62,6 +64,70 @@ def stacked_param_sharding(mesh: Mesh, stacked_tree):
     return jax.tree_util.tree_map(leaf, stacked_tree)
 
 
+def _flushed_apply(stage_fn, stacked_params, x_microbatches, cap, *, mesh,
+                   last_stage_fn, last_stage_args, first_stage_fn, first_stage_args,
+                   last_stage_args_specs, first_stage_args_specs, stacked_param_specs,
+                   last_stage_collective):
+    """Split an M-micro-batch window into M/cap pipeline flushes and scan over them
+    with a ``jax.checkpoint``-wrapped flush body.
+
+    The scan serializes the flushes (a Python-unrolled loop lets the runtime
+    overlap independent flush recomputations, which RAISES peak memory) and the
+    checkpoint discards each flush's interior residuals, so backward live memory is
+    one flush's stage inputs + the running grads — bounded in M. Measured (8-virtual-
+    device CPU peak RSS, 256-step scan analog): whole 1291 MB vs scanned flushes
+    657 MB; Python-unrolled flushes regressed to 1625 MB."""
+    M = x_microbatches.shape[0]
+    n = M // cap
+
+    def is_microbatched(a, spec):
+        # micro-batched last_stage_args (labels) scan with the flushes; weights and
+        # scalars ride the closure. With explicit specs ONLY a leading None marks
+        # the micro-batch dim (P() means replicated — a weight whose leading dim
+        # happens to equal M must NOT be chunked); without specs fall back on the
+        # [M, batch, ...] shape heuristic.
+        if not (hasattr(a, "ndim") and a.ndim >= 2 and a.shape[0] == M):
+            return False
+        return spec is None or (len(spec) > 0 and spec[0] is None)
+
+    flat_args, args_treedef = jax.tree_util.tree_flatten(last_stage_args)
+    if last_stage_args_specs is not None:
+        # specs may be a PREFIX tree (one P covering a whole subtree, as shard_map
+        # accepts): broadcast each prefix leaf over its matching args subtree
+        is_p = lambda x: isinstance(x, P)
+        broadcast = jax.tree_util.tree_map(
+            lambda spec, sub: jax.tree_util.tree_map(lambda _: spec, sub),
+            last_stage_args_specs, last_stage_args, is_leaf=is_p)
+        flat_specs = jax.tree_util.tree_leaves(broadcast, is_leaf=is_p)
+    else:
+        flat_specs = [None] * len(flat_args)
+    mb_flags = [is_microbatched(a, sp) for a, sp in zip(flat_args, flat_specs)]
+
+    x_chunks = x_microbatches.reshape((n, cap) + x_microbatches.shape[1:])
+    scanned = [a.reshape((n, cap) + a.shape[1:]) for a, f in zip(flat_args, mb_flags) if f]
+
+    @jax.checkpoint
+    def flush(acc, chunk_and_mb):
+        chunk, mb_leaves = chunk_and_mb
+        it = iter(mb_leaves)
+        largs = jax.tree_util.tree_unflatten(
+            args_treedef, [next(it) if f else a for a, f in zip(flat_args, mb_flags)])
+        loss = pipeline_apply(
+            stage_fn, stacked_params, chunk, mesh=mesh,
+            last_stage_fn=last_stage_fn, last_stage_args=largs,
+            first_stage_fn=first_stage_fn, first_stage_args=first_stage_args,
+            last_stage_args_specs=last_stage_args_specs,
+            first_stage_args_specs=first_stage_args_specs,
+            stacked_param_specs=stacked_param_specs,
+            last_stage_collective=last_stage_collective,
+            max_microbatches_per_flush=0)
+        return acc + loss, None
+
+    total, _ = jax.lax.scan(flush, jnp.zeros((), jnp.float32),
+                            (x_chunks, tuple(scanned)))
+    return total / n
+
+
 def pipeline_apply(stage_fn: Callable,
                    stacked_params,
                    x_microbatches,
@@ -74,8 +140,18 @@ def pipeline_apply(stage_fn: Callable,
                    last_stage_args_specs=None,
                    first_stage_args_specs=None,
                    stacked_param_specs=None,
-                   last_stage_collective: bool = False):
+                   last_stage_collective: bool = False,
+                   max_microbatches_per_flush: int = None):
     """Run micro-batches through the pipe-axis pipeline inside shard_map.
+
+    When the window exceeds ``max_microbatches_per_flush`` (default ``4 * n_stages``,
+    the M <= ~4S regime where GPipe+remat live memory matches 1F1B — see module
+    docstring), the loss path automatically splits into ``ceil(M / cap)`` independent
+    pipeline FLUSHES, each wrapped in ``jax.checkpoint``: the backward of flush i
+    replays only flush i's forward, so live memory is bounded by one flush's stage
+    inputs regardless of M — the engine-level analog of the reference running multiple
+    1F1B flushes per optimizer step (gradient accumulation over train_batch calls).
+    Pass ``max_microbatches_per_flush=0`` to disable splitting.
 
     Args:
       stage_fn: homogeneous per-stage function ``(stage_params, x) -> y``; applied by
@@ -102,6 +178,32 @@ def pipeline_apply(stage_fn: Callable,
     Differentiable in stacked_params / x_microbatches / *args.
     """
     M = x_microbatches.shape[0]
+    S = mesh.shape[PIPE_AXIS]
+    cap = 4 * S if max_microbatches_per_flush is None else max_microbatches_per_flush
+    if last_stage_fn is not None and cap > 0 and M > cap:
+        # equal-size flushes so the global mean is the mean of flush means; the
+        # largest divisor of M <= cap keeps one compile and one scan shape
+        cap_eff = max(d for d in range(1, cap + 1) if M % d == 0)
+        if cap_eff < max(2, cap // 2):
+            # M has no divisor near the cap (prime/awkward window): either the
+            # memory bound silently lapses (cap_eff < 2 -> unsplit) or tiny flushes
+            # crater pipeline utilization — surface it instead of both
+            import logging
+            logging.getLogger("DeepSpeedTPU").warning(
+                f"pipeline flush split: window M={M} has no divisor near the cap "
+                f"{cap} (best {cap_eff}); %s. Choose M a multiple of a value <= "
+                f"{cap} for the documented memory bound.",
+                "running a SINGLE unsplit flush (memory grows with M)"
+                if cap_eff < 2 else f"running {M // cap_eff} flushes of {cap_eff}")
+        if cap_eff >= 2:
+            return _flushed_apply(
+                stage_fn, stacked_params, x_microbatches, cap_eff, mesh=mesh,
+                last_stage_fn=last_stage_fn, last_stage_args=last_stage_args,
+                first_stage_fn=first_stage_fn, first_stage_args=first_stage_args,
+                last_stage_args_specs=last_stage_args_specs,
+                first_stage_args_specs=first_stage_args_specs,
+                stacked_param_specs=stacked_param_specs,
+                last_stage_collective=last_stage_collective)
 
     def inner(stacked_local, x_mb, last_args, first_args):
         S = jax.lax.axis_size(PIPE_AXIS)

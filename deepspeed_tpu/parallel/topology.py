@@ -64,35 +64,27 @@ class ProcessTopology:
         raise ValueError(f"rank {rank} not found in topology.")
 
     def get_axis_comm_lists(self, axis: str) -> List[List[int]]:
-        """All communication groups along ``axis``: lists of ranks differing only in ``axis``."""
+        """All communication groups along ``axis``: lists of ranks differing only in
+        ``axis``. Computed by bucketing the precomputed rank table on the remaining
+        coordinates — one pass, no cartesian re-enumeration. Because ranks enumerate
+        coordinates row-major, bucket insertion order reproduces the conventional
+        (outer-axes row-major) group ordering and each bucket is ordered by axis index."""
         if axis not in self.axes:
             return []
-        other_axes = [a for a in self.axes if a != axis]
-        lists = []
-        ranges = [range(self.get_dim(a)) for a in other_axes]
-        for coord in product(*ranges):
-            other_keys = dict(zip(other_axes, coord))
-            sub_list = [self.mapping[self.ProcessCoord(**{axis: axis_key, **other_keys})]
-                        for axis_key in range(self.get_dim(axis))]
-            lists.append(sub_list)
-        return lists
+        ai = self.axes.index(axis)
+        buckets: Dict[tuple, List[int]] = {}
+        for rank, coord in enumerate(self._rank_to_coord):
+            buckets.setdefault(coord[:ai] + coord[ai + 1:], []).append(rank)
+        return list(buckets.values())
 
     def filter_match(self, **filter_kwargs) -> List[int]:
-        """Ranks whose coordinates match all of the given axis=value filters, sorted."""
-
-        def _filter_helper(x):
-            for key, val in filter_kwargs.items():
-                if getattr(x, key) != val:
-                    return False
-            return True
-
-        coords = filter(_filter_helper, self.mapping.keys())
-        return sorted(self.mapping[coord] for coord in coords)
+        """Ranks whose coordinates match all of the given axis=value filters, ascending
+        (rank-table scan order is already ascending)."""
+        return [rank for rank, coord in enumerate(self._rank_to_coord)
+                if all(getattr(coord, ax) == val for ax, val in filter_kwargs.items())]
 
     def get_axis_list(self, axis: str, idx: int) -> List[int]:
-        axis_num = self.axes.index(axis)
-        ranks = [self.mapping[k] for k in self.mapping.keys() if k[axis_num] == idx]
-        return sorted(ranks)
+        return self.filter_match(**{axis: idx})
 
     def world_size(self) -> int:
         size = 1

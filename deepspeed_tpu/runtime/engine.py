@@ -46,21 +46,51 @@ MEMORY_OPT_ALLREDUCE_SIZE = 500000000
 
 
 class OptimizerHandle:
-    """Host-side view of optimizer hyperparameters (the reference's param_groups)."""
+    """Host-side view of optimizer hyperparameters (the reference's param_groups,
+    engine.py:503-650 / fp16/fused_optimizer.py:48-66).
 
-    def __init__(self, name: str, params: dict):
+    Group 0 holds the optimizer block's top-level hypers; each ``group_specs`` entry
+    adds a group that inherits the base values and applies its overrides (lr,
+    weight_decay, betas, eps). Leaf membership is decided elsewhere (the engine's
+    group-index tree); the handle only owns the per-group scalars that schedulers
+    mutate and ``current_hyper`` ships to the device each step."""
+
+    def __init__(self, name: str, params: dict, group_specs=()):
         self.name = name
-        hyper = adam_opt.hyper_from_params(params or {})
-        self.param_groups = [{"lr": hyper["lr"], "betas": (hyper["beta1"], hyper["beta2"]),
-                              "eps": hyper["eps"], "weight_decay": hyper["weight_decay"]}]
+        params = params or {}
+
+        def group_dict(overrides: dict) -> dict:
+            hyper = adam_opt.hyper_from_params({**params, **overrides})
+            return {"lr": hyper["lr"], "betas": (hyper["beta1"], hyper["beta2"]),
+                    "eps": hyper["eps"], "weight_decay": hyper["weight_decay"]}
+
+        self.param_groups = [group_dict({})]
+        for spec in group_specs or ():
+            overrides = {k: v for k, v in dict(spec).items()
+                         if k in ("lr", "weight_decay", "betas", "eps")}
+            self.param_groups.append(group_dict(overrides))
 
     def current_hyper(self) -> dict:
-        g = self.param_groups[0]
-        return dict(lr=jnp.asarray(g["lr"], jnp.float32),
-                    beta1=jnp.asarray(g["betas"][0], jnp.float32),
-                    beta2=jnp.asarray(g["betas"][1], jnp.float32),
-                    eps=jnp.asarray(g["eps"], jnp.float32),
-                    weight_decay=jnp.asarray(g["weight_decay"], jnp.float32))
+        gs = self.param_groups
+        if len(gs) == 1:  # single group: 0-d scalars, the historical jit signature
+            g = gs[0]
+            return dict(lr=jnp.asarray(g["lr"], jnp.float32),
+                        beta1=jnp.asarray(g["betas"][0], jnp.float32),
+                        beta2=jnp.asarray(g["betas"][1], jnp.float32),
+                        eps=jnp.asarray(g["eps"], jnp.float32),
+                        weight_decay=jnp.asarray(g["weight_decay"], jnp.float32))
+        return dict(
+            lr=jnp.asarray([g["lr"] for g in gs], jnp.float32),
+            beta1=jnp.asarray([g["betas"][0] for g in gs], jnp.float32),
+            beta2=jnp.asarray([g["betas"][1] for g in gs], jnp.float32),
+            eps=jnp.asarray([g["eps"] for g in gs], jnp.float32),
+            weight_decay=jnp.asarray([g["weight_decay"] for g in gs], jnp.float32))
+
+    def hyper_for_leaf_groups(self) -> list:
+        """Host-side per-group hyper dicts (the offload path's view)."""
+        return [dict(lr=g["lr"], beta1=g["betas"][0], beta2=g["betas"][1],
+                     eps=g["eps"], weight_decay=g["weight_decay"])
+                for g in self.param_groups]
 
     # schedulers poke param_groups[i]['lr'] directly
 
@@ -385,7 +415,41 @@ class DeepSpeedEngine:
         return [g["betas"] for g in self.optimizer.param_groups]
 
     # ------------------------------------------------------------------ setup
+    def _build_group_index(self, specs):
+        """Per-leaf STATIC group ids from pattern specs: leaf paths matching
+        ``specs[i]['pattern']`` (first match wins) belong to group i+1; unmatched
+        leaves to the base group 0. The analog of the reference's torch param_groups
+        lists (engine.py:503-650) for a functional pytree, where leaves are named by
+        path, not identity — the BERT no-decay recipe is
+        ``[{"pattern": "bias|LayerNorm|ln_", "weight_decay": 0.0}]``."""
+        import re
+        treedef = jax.tree_util.tree_structure(self.params)
+        paths = jax.tree_util.tree_flatten_with_path(self.params)[0]
+        compiled = [re.compile(s["pattern"]) for s in specs]
+        ids, counts = [], [0] * (len(specs) + 1)
+        for path, _ in paths:
+            pstr = "/".join(str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p))))
+                            for p in path)
+            gi = 0
+            for i, rx in enumerate(compiled):
+                if rx.search(pstr):
+                    gi = i + 1
+                    break
+            ids.append(gi)
+            counts[gi] += 1
+        log_dist(f"optimizer param groups: {counts[0]} base leaves + "
+                 f"{counts[1:]} per pattern group", ranks=[0])
+        return jax.tree_util.tree_unflatten(treedef, ids)
+
     def _configure_optimizer(self, client_optimizer):
+        # per-group hyperparameters: JSON config wins, else an optional model hook
+        # (patterns over leaf paths; see _build_group_index)
+        specs = (self.config.optimizer_params or {}).get("param_groups")
+        if not specs:
+            hook = getattr(self.module, "param_group_patterns", None)
+            specs = tuple(hook()) if callable(hook) else ()
+        specs = tuple(specs or ())
+        self._group_index = self._build_group_index(specs) if specs else None
         if self._offload is not None:
             # Host-tier optimizer: the engine steps DeepSpeedCPUAdam directly
             # (reference engine.py:560-566 requires the cpu_adam op under ZeRO-Offload).
@@ -394,7 +458,8 @@ class DeepSpeedEngine:
                 f"ZeRO-Offload supports Adam/AdamW (got {name!r})"
             assert client_optimizer is None or isinstance(client_optimizer, str), \
                 "ZeRO-Offload steps the host-side DeepSpeedCPUAdam; client optimizers unsupported"
-            self.optimizer = OptimizerHandle(name, self.config.optimizer_params or {})
+            self.optimizer = OptimizerHandle(name, self.config.optimizer_params or {},
+                                             group_specs=specs)
             log_dist("Using ZeRO-Offload: host-tier DeepSpeedCPUAdam "
                      f"({'native' if self._offload._lib is not None else 'numpy'} kernel, "
                      f"{self._offload.numel} local master elements)", ranks=[0])
@@ -402,6 +467,8 @@ class DeepSpeedEngine:
         if client_optimizer is not None and not isinstance(client_optimizer, str):
             # client-provided (init, apply) pair or OptimizerHandle-compatible object
             if isinstance(client_optimizer, tuple) and len(client_optimizer) == 2:
+                assert not specs, ("param_groups require a built-in optimizer; a client "
+                                   "(init, apply) pair has no groups kwarg contract")
                 self._opt_init, self._opt_apply = client_optimizer
                 self.optimizer = OptimizerHandle("client", self.config.optimizer_params or {})
             else:
@@ -410,6 +477,8 @@ class DeepSpeedEngine:
         else:
             name = self.config.optimizer_name or ADAM_OPTIMIZER
             if name == ONEBIT_ADAM_OPTIMIZER:
+                assert not specs, "1-bit Adam runs a single param group (compressed " \
+                                  "error feedback is not per-group)"
                 from ..ops import onebit_adam as onebit
                 freeze_step = (self.config.optimizer_params or {}).get("freeze_step", 100000)
                 self._onebit = onebit.OneBitAdam(freeze_step=freeze_step, dp_size=self.dp_size,
@@ -417,9 +486,13 @@ class DeepSpeedEngine:
                 self._opt_init, self._opt_apply = self._onebit.init, self._onebit.apply
             elif name in _OPTIMIZER_APPLY:
                 self._opt_init, self._opt_apply = _OPTIMIZER_APPLY[name]
+                if self._group_index is not None:
+                    self._opt_apply = functools.partial(self._opt_apply,
+                                                        groups=self._group_index)
             else:
                 raise ValueError(f"Unrecognized optimizer {name!r}")
-            self.optimizer = OptimizerHandle(name, self.config.optimizer_params or {})
+            self.optimizer = OptimizerHandle(name, self.config.optimizer_params or {},
+                                             group_specs=specs)
         init = self._opt_init
         opt_state_zero = jax.eval_shape(init, self.master_params)
         # optimizer states mirror the master-param tree (Adam moments, momentum buffers):
@@ -479,12 +552,14 @@ class DeepSpeedEngine:
         predivide = float(self.config.gradient_predivide_factor or 1.0)
         prescale = self.config.prescale_gradients
         use_stacked = self._use_stacked_grads
-        # ZeRO-Offload keeps device grads in the compute dtype (the reference keeps
-        # fp16 grads on-GPU and upcasts on the host master, stage2.py:333-349) —
-        # halves the grad HBM footprint, which bounds max trainable params/chip. The
-        # host tier upcasts to fp32 in its landing buffer. On-device optimizers
-        # accumulate/update in fp32 as before.
-        grad_dtype = compute_dtype if self._offload is not None else jnp.float32
+        # ZeRO stage >= 2 and ZeRO-Offload keep device grads in the compute dtype —
+        # the reference's fp16 grad partitions (stage2.py:333-349, upcast only at the
+        # fp32 master update) — halving the grad HBM footprint that bounds max model
+        # size per chip. Stage <= 1 keeps fp32 grads (the reference's fp32 allreduce
+        # option); the optimizer update always upcasts per-leaf inside its fused loop.
+        zero_stage_ = self.zero_optimization_stage()
+        grad_dtype = (compute_dtype if (self._offload is not None or zero_stage_ >= 2)
+                      else jnp.float32)
 
         def local_loss_and_grad(params, scale, *batch):
             def scaled_loss_fn(p):
@@ -587,13 +662,13 @@ class DeepSpeedEngine:
         self._jit_loss_and_grad_cached = None
         self._jit_eval_cached = None
 
-        # Under offload, per-microbatch grads stay in the compute dtype (halves the
-        # backward HBM footprint) but the ACCUMULATOR is fp32 when the window spans
-        # multiple micro-batches: bf16 a+g loses mantissa bits as the window grows and
+        # Per-microbatch grads stay in the compute dtype (halves the backward HBM
+        # footprint) but the ACCUMULATOR is fp32 when the window spans multiple
+        # micro-batches: bf16 a+g loses mantissa bits as the window grows and
         # loss-scaled fp16 sums can overflow mid-window. The reference accumulates into
         # fp32 host buffers (stage2.py async CPU grad accumulation) — matching numerics
-        # costs one fp32 accumulator, which the host fetch reads anyway.
-        acc_dtype = (jnp.float32 if (self._offload is not None and grad_acc_steps > 1)
+        # costs one fp32 accumulator.
+        acc_dtype = (jnp.float32 if (grad_dtype != jnp.float32 and grad_acc_steps > 1)
                      else grad_dtype)
         self._acc_dtype = acc_dtype
 
@@ -611,13 +686,29 @@ class DeepSpeedEngine:
             in_shardings=(self._grad_shardings,),
             out_shardings=self._grad_shardings))
 
-        def apply_update(master, opt_state, scaler_state, acc_grads, step, hyper):
+        def apply_update(master, opt_state, scaler_state, acc_grads, params, step, hyper):
             scale = scaler_state.cur_scale
             overflow = has_inf_or_nan_tree(acc_grads) if fp16 else jnp.zeros((), jnp.bool_)
-            inv = jnp.where(scale > 0, 1.0 / scale, 1.0)
-            grads = jax.tree_util.tree_map(lambda g: g * inv, acc_grads)
+            if fp16:
+                inv = jnp.where(scale > 0, 1.0 / scale, 1.0)
+
+                def unscale(g):
+                    # bf16 spans fp32's exponent range, so a power-of-two unscale is
+                    # an exact exponent shift in-dtype (no fp32-tree materialization).
+                    # fp16's narrow exponent would flush small unscaled grads to zero
+                    # — exactly what loss scaling protects — so fp16 unscales through
+                    # fp32 (costing the fp32 grad copy the reference also pays at its
+                    # fp32 master update, fused there into the optimizer).
+                    if g.dtype == jnp.float16:
+                        return g.astype(jnp.float32) * inv
+                    return g * inv.astype(g.dtype)
+
+                grads = jax.tree_util.tree_map(unscale, acc_grads)
+            else:
+                grads = acc_grads  # scale fixed at 1
             if prescale and predivide != 1.0:
-                grads = jax.tree_util.tree_map(lambda g: g * predivide, grads)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g * jnp.asarray(predivide, g.dtype), grads)
             if use_stacked:
                 # stacked per-worker grads: the logical gradient is the worker mean —
                 # clip/report on that, not on the sqrt(dp)-inflated stacked norm
@@ -636,6 +727,8 @@ class DeepSpeedEngine:
             new_master, new_opt = jax.lax.cond(overflow, skip_update, do_update, operand=None)
             new_scaler = ls.update(scaler_state, overflow, dynamic=dynamic, scale_window=scale_window,
                                    min_scale=min_scale, hysteresis=hysteresis)
+            # params enter only to donate their buffer to the re-cast output
+            del params
             new_params = jax.tree_util.tree_map(lambda p: p.astype(compute_dtype), new_master)
             return new_master, new_opt, new_scaler, new_params, overflow, norm
 
@@ -669,7 +762,7 @@ class DeepSpeedEngine:
             out_shardings=(self._master_shardings, self._opt_shardings,
                            jax.tree_util.tree_map(lambda _: scalar_shard, self.scaler_state),
                            self._param_shardings, scalar_shard, scalar_shard),
-            donate_argnums=(0, 1, 3))
+            donate_argnums=(0, 1, 3, 4))
 
     # ------------------------------------------------------------------ train API
     def shard_batch(self, batch):
@@ -799,7 +892,8 @@ class DeepSpeedEngine:
         step = jnp.asarray(self.global_steps + 1 - self.skipped_steps, jnp.int32)
         (self.master_params, self.opt_state, self.scaler_state, self.params,
          overflow, self._last_grad_norm) = self._jit_apply_update(
-            self.master_params, self.opt_state, self.scaler_state, self._grad_acc, step, hyper)
+            self.master_params, self.opt_state, self.scaler_state, self._grad_acc,
+            self.params, step, hyper)
         self._finish_step(self.fp16_enabled() and bool(jax.device_get(overflow)))
 
     def _offload_step(self) -> bool:
@@ -836,13 +930,18 @@ class DeepSpeedEngine:
             factor *= clip / (norm + 1e-6)
 
         if not overflow:
-            g = self.optimizer.param_groups[0]
+            group_hypers = self.optimizer.hyper_for_leaf_groups()
+            leaf_hypers = None
+            if self._group_index is not None:
+                leaf_hypers = [group_hypers[gi]
+                               for gi in jax.tree_util.tree_leaves(self._group_index)]
+            g = group_hypers[0]
             step_count = self.global_steps + 1 - self.skipped_steps
             out_dtype = np.dtype(self.compute_dtype)
             pushed = self._offload.step_regions(
-                handles, step_count, lr=g["lr"], beta1=g["betas"][0], beta2=g["betas"][1],
+                handles, step_count, lr=g["lr"], beta1=g["beta1"], beta2=g["beta2"],
                 eps=g["eps"], weight_decay=g["weight_decay"], grad_scale=factor,
-                out_dtype=out_dtype)
+                out_dtype=out_dtype, leaf_hypers=leaf_hypers)
             self.params = (pushed if self._jit_offload_push is None
                            else self._jit_offload_push(pushed))
         self.scaler_state = ls.update(

@@ -55,7 +55,8 @@ class FP16_Optimizer:
                  eps: float = 1e-8,
                  weight_decay: float = 0.0,
                  inner_apply: Optional[Callable] = None,
-                 inner_init: Optional[Callable] = None):
+                 inner_init: Optional[Callable] = None,
+                 groups=None):
         self.compute_dtype = compute_dtype
         self.clip_grad = float(clip_grad)
         self.dynamic = bool(dynamic_loss_scale) and not static_loss_scale
@@ -65,14 +66,21 @@ class FP16_Optimizer:
         self.hyper = {"lr": lr, "beta1": betas[0], "beta2": betas[1], "eps": eps,
                       "weight_decay": weight_decay}
 
+        # per-group hypers (reference fused_optimizer.py:48-66 iterates param_groups):
+        # ``groups`` is a static per-leaf group-id pytree; hyper values may then be
+        # [n_groups] sequences (e.g. lr=[1e-3, 5e-4])
         if inner_apply is not None:
+            assert groups is None, "groups require a built-in inner optimizer"
             self._apply, self._init = inner_apply, inner_init
         elif optimizer in ("adam", "adamw"):
             self._apply = lambda g, s, p, t, h: adam_opt.apply(g, s, p, t, h,
-                                                               adamw=(optimizer == "adamw"))
+                                                               adamw=(optimizer == "adamw"),
+                                                               groups=groups)
             self._init = adam_opt.init
         elif optimizer == "lamb":
-            self._apply, self._init = lamb_opt.apply, lamb_opt.init
+            self._apply = lambda g, s, p, t, h: lamb_opt.apply(g, s, p, t, h,
+                                                               groups=groups)
+            self._init = lamb_opt.init
         else:
             raise ValueError(f"unknown optimizer {optimizer!r}")
 

@@ -209,8 +209,13 @@ class PipelineEngine(DeepSpeedEngine):
 
         mb = self.micro_batches
         S = self.num_stages
-        streams = [list(iter(schedule.TrainSchedule(micro_batches=mb, stages=S, stage_id=s)))
-                   for s in range(S)]
+        scheds = [schedule.TrainSchedule(micro_batches=mb, stages=S, stage_id=s)
+                  for s in range(S)]
+        streams = [list(iter(sc)) for sc in scheds]
+        # the reference's per-stage buffer-ring memory contract
+        # (deepspeed/runtime/pipe/engine.py:133-148) as a tested invariant: in-flight
+        # payloads bound by the RECEIVER's num_pipe_buffers()
+        ring_size = [sc.num_pipe_buffers() for sc in scheds]
 
         act_in = [dict() for _ in range(S)]    # stage -> buffer_id -> input activation
         act_out = [dict() for _ in range(S)]   # stage -> buffer_id -> output activation
@@ -279,6 +284,10 @@ class PipelineEngine(DeepSpeedEngine):
             elif isinstance(cmd, schedule.SendActivation):
                 mb_id, payload = act_out[s].pop(cmd.buffer_id)
                 chan_act[(s, mb_id)] = payload
+                in_flight = sum(1 for (src, _) in chan_act if src == s)
+                assert in_flight <= ring_size[s + 1], (
+                    f"stage {s}->{s + 1} activation channel holds {in_flight} payloads "
+                    f"> receiver num_pipe_buffers()={ring_size[s + 1]}")
             elif isinstance(cmd, schedule.RecvActivation):
                 mb_id = recv_act_count[s]
                 recv_act_count[s] += 1
@@ -301,6 +310,10 @@ class PipelineEngine(DeepSpeedEngine):
             elif isinstance(cmd, schedule.SendGrad):
                 mb_id, payload = dx_buf[s].pop(cmd.buffer_id)
                 chan_grad[(s, mb_id)] = payload
+                in_flight = sum(1 for (src, _) in chan_grad if src == s)
+                assert in_flight <= ring_size[s - 1], (
+                    f"stage {s}->{s - 1} grad channel holds {in_flight} payloads "
+                    f"> receiver num_pipe_buffers()={ring_size[s - 1]}")
             elif isinstance(cmd, schedule.RecvGrad):
                 mb_id = recv_grad_count[s]
                 recv_grad_count[s] += 1
@@ -369,7 +382,8 @@ class PipelineEngine(DeepSpeedEngine):
         step = jnp.asarray(self.global_steps + 1 - self.skipped_steps, jnp.int32)
         (self.master_params, self.opt_state, self.scaler_state, self.params,
          overflow, self._last_grad_norm) = self._jit_apply_update(
-            self.master_params, self.opt_state, self.scaler_state, full_grads, step, hyper)
+            self.master_params, self.opt_state, self.scaler_state, full_grads,
+            self.params, step, hyper)
         if self.fp16_enabled() and bool(jax.device_get(overflow)):
             # jit already skipped the master update and backed off the scale; mirror
             # the host-side accounting (reference _take_model_step overflow branch)
@@ -385,9 +399,10 @@ class PipelineEngine(DeepSpeedEngine):
         send/recv ordering of schedule.InferenceSchedule are preserved)."""
         mb = self.micro_batches
         S = self.num_stages
-        streams = [list(iter(schedule.InferenceSchedule(micro_batches=mb, stages=S,
-                                                        stage_id=s)))
-                   for s in range(S)]
+        scheds = [schedule.InferenceSchedule(micro_batches=mb, stages=S, stage_id=s)
+                  for s in range(S)]
+        streams = [list(iter(sc)) for sc in scheds]
+        ring_size = [sc.num_pipe_buffers() for sc in scheds]  # two-buffer ring
 
         act_in = [dict() for _ in range(S)]    # stage -> buffer_id -> input activation
         act_out = [dict() for _ in range(S)]   # stage -> buffer_id -> output activation
@@ -424,6 +439,10 @@ class PipelineEngine(DeepSpeedEngine):
             elif isinstance(cmd, schedule.SendActivation):
                 mb_id, payload = act_out[s].pop(cmd.buffer_id)
                 chan_act[(s, mb_id)] = payload
+                in_flight = sum(1 for (src, _) in chan_act if src == s)
+                assert in_flight <= ring_size[s + 1], (
+                    f"stage {s}->{s + 1} activation channel holds {in_flight} payloads "
+                    f"> receiver num_pipe_buffers()={ring_size[s + 1]}")
             elif isinstance(cmd, schedule.RecvActivation):
                 mb_id = recv_act_count[s]
                 recv_act_count[s] += 1

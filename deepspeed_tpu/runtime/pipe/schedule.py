@@ -125,55 +125,52 @@ class InferenceSchedule(PipeSchedule):
 
 class TrainSchedule(PipeSchedule):
     """1F1B training stream: forwards and backwards interleave once the pipe fills, so
-    at most ``stages - stage_id + 1`` activations are live per stage."""
+    at most ``stages - stage_id + 1`` activations are live per stage.
+
+    The whole schedule follows from two latencies (see ``_step_to_micro_batch``):
+    micro-batch 0's forward reaches stage s at step s, and its backward returns to
+    stage s at step ``2*stages - s - 1``; every stage then alternates F/B locally.
+    Stream-level behavior is pinned to the reference's
+    (deepspeed/runtime/pipe/schedule.py TrainSchedule) by the schedule parity tests.
+    """
 
     def steps(self):
-        prev_micro_batch_id = -1
         total_steps = 2 * (self.micro_batches + self.stages - 1)
+        last_mb = -1  # micro-batch this stage touched on the previous step
         for step_id in range(total_steps):
-            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
-
-            prev_buffer = curr_buffer = None
-            if self._valid_micro_batch(prev_micro_batch_id):
-                prev_buffer = self._buffer_idx(prev_micro_batch_id)
-            if self._valid_micro_batch(micro_batch_id):
-                curr_buffer = self._buffer_idx(micro_batch_id)
-
+            mb, fwd = self._step_to_micro_batch(step_id)
+            live = self._valid_micro_batch(mb)
+            retiring = self._valid_micro_batch(last_mb)
             cmds = []
 
-            # Activation/gradient exchange. A forward step pairs its activation recv with
-            # the previous micro-batch's grad send (and vice versa) so adjacent stages'
-            # blocking p2p calls always match up.
-            if is_forward:
-                if self._valid_micro_batch(micro_batch_id) and self._valid_stage(self.prev_stage):
-                    cmds.append(RecvActivation(curr_buffer))
-                if self._valid_micro_batch(prev_micro_batch_id) and self._valid_stage(self.prev_stage):
-                    cmds.append(SendGrad(prev_buffer))
-            else:
-                if self._valid_micro_batch(prev_micro_batch_id) and self._valid_stage(self.next_stage):
-                    cmds.append(SendActivation(prev_buffer))
-                if self._valid_micro_batch(micro_batch_id) and self._valid_stage(self.next_stage):
-                    cmds.append(RecvGrad(curr_buffer))
+            # Boundary traffic first, pairing this step's recv with the LAST
+            # micro-batch's opposite-direction send: both sides of a stage boundary
+            # then issue their matching transfer within the same merged step, which
+            # is what lets blocking pairwise exchanges rendezvous.
+            if fwd and self._valid_stage(self.prev_stage):
+                if live:
+                    cmds.append(RecvActivation(self._buffer_idx(mb)))
+                if retiring:
+                    cmds.append(SendGrad(self._buffer_idx(last_mb)))
+            elif not fwd and self._valid_stage(self.next_stage):
+                if retiring:
+                    cmds.append(SendActivation(self._buffer_idx(last_mb)))
+                if live:
+                    cmds.append(RecvGrad(self._buffer_idx(mb)))
 
-            # First/last stage loads the micro-batch
-            if self.stage_id == 0 or self.stage_id == self.stages - 1:
-                if is_forward and self._valid_micro_batch(micro_batch_id):
-                    cmds.append(LoadMicroBatch(curr_buffer))
+            if live:
+                # only the pipe endpoints touch the dataloader (inputs at stage 0,
+                # labels at the loss stage)
+                if fwd and (self.is_first_stage or self.is_last_stage):
+                    cmds.append(LoadMicroBatch(self._buffer_idx(mb)))
+                cmds.append((ForwardPass if fwd else BackwardPass)(self._buffer_idx(mb)))
 
-            # Computation
-            if self._valid_micro_batch(micro_batch_id):
-                if is_forward:
-                    cmds.append(ForwardPass(curr_buffer))
-                else:
-                    cmds.append(BackwardPass(curr_buffer))
-
-            # Model step at the end of the batch
-            if step_id == total_steps - 1:
+            if step_id == total_steps - 1:  # whole batch drained: reduce + step
                 cmds.append(ReduceTiedGrads())
                 cmds.append(ReduceGrads())
                 cmds.append(OptimizerStep())
 
-            prev_micro_batch_id = micro_batch_id
+            last_mb = mb
             yield cmds
 
     def num_pipe_buffers(self):
@@ -181,32 +178,20 @@ class TrainSchedule(PipeSchedule):
         return max(2, buffers)
 
     def _step_to_micro_batch(self, step_id):
-        """Map a global step to (micro_batch_id, is_forward) for this stage.
+        """(micro_batch_id, is_forward) for this stage at a global step.
 
-        Even stages run forwards on even steps; odd stages on odd steps — the two
-        populations interleave 1F1B without further coordination.
+        Two closed forms cover the whole interleave. Forwards: micro-batch f's
+        activation reaches stage s at step ``s + 2f`` (one step of fill latency per
+        stage, one F and one B per micro-batch thereafter), so on steps with the
+        stage's own parity ``f = (step - s) / 2``. Backwards: micro-batch 0's
+        gradient returns to stage s at step ``2*stages - s - 1`` (down the pipe and
+        back), so on opposite-parity steps ``b = (step - (2*stages - s - 1)) / 2``.
+        Out-of-range ids simply mean the stage idles that step.
         """
-        if _is_even(step_id) and _is_even(self.stage_id):
-            return self._even_step_forward_id(step_id), True
-        if _is_odd(step_id) and _is_odd(self.stage_id):
-            return self._odd_step_forward_id(step_id), True
-        if _is_even(step_id) and _is_odd(self.stage_id):
-            return self._even_step_backward_id(step_id), False
-        if _is_odd(step_id) and _is_even(self.stage_id):
-            return self._odd_step_backward_id(step_id), False
-        raise AssertionError("unreachable")
-
-    def _even_step_forward_id(self, step_id):
-        return step_id // 2 - self.stage_id // 2
-
-    def _odd_step_forward_id(self, step_id):
-        return (step_id - 1) // 2 - self.stage_id // 2
-
-    def _even_step_backward_id(self, step_id):
-        return step_id // 2 - self.stages + (self.stage_id + 1) // 2
-
-    def _odd_step_backward_id(self, step_id):
-        return (step_id - 1) // 2 - self.stages + 1 + self.stage_id // 2
+        offset = step_id - self.stage_id
+        if offset % 2 == 0:
+            return offset // 2, True
+        return (step_id - (2 * self.stages - self.stage_id - 1)) // 2, False
 
 
 class DataParallelSchedule(PipeSchedule):

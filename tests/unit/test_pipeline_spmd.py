@@ -215,3 +215,67 @@ def test_gpt2_pipe_to_dense_roundtrip(tp):
     pipe4 = GPT2Pipe(cfg, num_stages=4)
     restacked = pipe4.from_dense(back)
     assert restacked["io"]["wte"].shape[0] == 132
+
+
+def test_auto_flush_split_matches_single_flush(mesh):
+    """M = 8S must auto-split into rematerialized flushes (VERDICT r2 next #5) with
+    bit-comparable loss AND grads vs the unsplit pipeline."""
+    S2, M8 = 2, 16
+    key = jax.random.PRNGKey(2)
+    per_stage = []
+    for _ in range(S2):
+        k1, key = jax.random.split(key)
+        per_stage.append({"w": jax.random.normal(k1, (H, H)) * 0.3, "b": jnp.zeros((H,))})
+    stacked = stack_stage_params(per_stage)
+    stacked = jax.device_put(stacked, stacked_param_sharding(mesh, stacked))
+    x_mb = jax.random.normal(key, (M8, B, H))
+    labels_mb = jnp.tanh(x_mb @ (jax.random.normal(jax.random.PRNGKey(3), (H, H)) * 0.5))
+
+    def last_fn(y, labels_all, mb):
+        return jnp.mean((y - labels_all[mb])**2)
+
+    def loss(cap):
+        def f(s, x):
+            return pipeline_apply(stage_fn, s, x, mesh=mesh, last_stage_fn=last_fn,
+                                  last_stage_args=(labels_mb,),
+                                  max_microbatches_per_flush=cap)
+        return f
+
+    l_split = jax.jit(loss(None))(stacked, x_mb)       # default cap 4*S=8 < M: splits
+    l_whole = jax.jit(loss(0))(stacked, x_mb)          # splitting disabled
+    np.testing.assert_allclose(float(l_split), float(l_whole), rtol=1e-6)
+
+    g_split = jax.jit(jax.grad(loss(None)))(stacked, x_mb)
+    g_whole = jax.jit(jax.grad(loss(0)))(stacked, x_mb)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(g_split[k]), np.asarray(g_whole[k]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_auto_flush_split_through_gpt2_pipe(mesh):
+    """GPT2Pipe at M = 8S (vocab-parallel embedding/head + collective last stage)
+    still matches the dense model under the flush splitter."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.models.gpt2_pipe import GPT2Pipe
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg = GPT2Config(vocab_size=64, n_positions=16, n_embd=16, n_layer=2, n_head=2,
+                     compute_dtype=jnp.float32)
+    dense = GPT2Model(cfg)
+    dense_params = dense.init(jax.random.PRNGKey(4))
+    pipe = GPT2Pipe(cfg, num_stages=2)
+    params = pipe.from_dense(dense_params)
+    placed = jax.device_put(params, pipe.param_shardings(mesh, params))
+
+    M8 = 16  # 8 * num_stages -> two flushes of 8
+    rng = np.random.default_rng(5)
+    toks = rng.integers(0, cfg.vocab_size, size=(M8, 4, 8)).astype(np.int32)
+    labels = np.roll(toks, -1, axis=2)
+    spec = NamedSharding(mesh, P(None, "data"))
+    toks_d = jax.device_put(jnp.asarray(toks), spec)
+    labels_d = jax.device_put(jnp.asarray(labels), spec)
+    pipe_loss = float(jax.device_get(pipe.loss(placed, toks_d, labels_d, mesh=mesh)))
+    dense_losses = [float(jax.device_get(dense.apply(dense_params, jnp.asarray(toks[m]),
+                                                     jnp.asarray(labels[m]))))
+                    for m in range(M8)]
+    np.testing.assert_allclose(pipe_loss, np.mean(dense_losses), rtol=1e-5)
