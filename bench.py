@@ -15,9 +15,9 @@ extra:
   n_layer) whose ZeRO-Offload HBM footprint — bf16 params + bf16 grads + remat
   activations; master/moments live in host DRAM — completes fwd+bwd on the chip
   (binary search over n_layer). The host Adam tier scales with host DRAM, so HBM is
-  the binding constraint. (The axon tunnel's ~3 MB/s D2H makes timing full-model
-  host offload steps meaningless in this harness — on a real TPU-VM the host link is
-  PCIe-class; the offload step's overlap structure is covered by unit perf checks.)
+  the binding constraint. (Full-model offload step timing rides the axon relay
+  tunnel rather than a PCIe-class TPU-VM host link; a real small-scale engine step's
+  fetch/adam/push breakdown is recorded in extra.offload_step_timing instead.)
 
 Set DS_BENCH_FAST=1 to run only the 420M flagship (quick iteration).
 """
@@ -237,8 +237,8 @@ def bench_offload_step_timing():
            "fetch_wait_s": round(t["fetch_wait"], 3),
            "host_adam_s": round(t["host_adam"], 3),
            "push_s": round(t["push"], 3), "total_s": round(t["total"], 3),
-           "note": ("axon-tunnel transfer dominates (~3 MB/s D2H); breakdown proves "
-                    "the overlapped region pipeline, not production wall-clock")}
+           "note": ("transfers ride the axon relay tunnel; the breakdown proves the "
+                    "overlapped region pipeline, not production wall-clock")}
     del engine, params
     gc.collect()
     return out

@@ -447,10 +447,23 @@ def _flash_attention_core(q, k, v, bias, seed, causal, sm_scale, rate, block_q, 
     return out
 
 
-def _resolve(q, sm_scale, block_q, block_k, interpret):
+def _resolve(q, sm_scale, block_q, block_k, causal, interpret):
     T = q.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if block_q is None or block_k is None:
+        # Measured on v5e (slope-timed, relay fence cancelled; tests/perf/flash_sweep):
+        # non-causal T=4096: (1024,1024) 101.6 TF/s vs (256,512) 56.2 — the bigger
+        # q tile amortizes per-cell K/V residency; T=8192: (512,1024) 67.6 vs 58.9.
+        # Causal prefers small q blocks (diagonal work balance): (256,512).
+        if causal or T < 4096:
+            dq_, dk_ = 256, 512
+        elif T < 8192:
+            dq_, dk_ = 1024, 1024
+        else:
+            dq_, dk_ = 512, 1024
+        block_q = block_q or dq_
+        block_k = block_k or dk_
 
     def fit(b):
         # largest power-of-two-reduced block that divides the sequence length
@@ -468,7 +481,8 @@ def _resolve(q, sm_scale, block_q, block_k, interpret):
 
 def _core_fwd_rule(q, k, v, bias, seed, causal, sm_scale, rate, block_q, block_k,
                    interpret):
-    sm_scale_, bq, bk, interp = _resolve(q, sm_scale, block_q, block_k, interpret)
+    sm_scale_, bq, bk, interp = _resolve(q, sm_scale, block_q, block_k, causal,
+                                         interpret)
     assert q.shape[2] % bq == 0 and q.shape[2] % bk == 0, \
         f"seq_len {q.shape[2]} must be divisible by block sizes ({bq}, {bk})"
     out, lse = _flash_fwd(q, k, v, seed, bias, sm_scale_, causal, rate, bq, bk, interp)
@@ -477,7 +491,8 @@ def _core_fwd_rule(q, k, v, bias, seed, causal, sm_scale, rate, block_q, block_k
 
 def _core_bwd_rule(causal, sm_scale, rate, block_q, block_k, interpret, res, g):
     q, k, v, out, lse, bias, seed = res
-    sm_scale_, bq, bk, interp = _resolve(q, sm_scale, block_q, block_k, interpret)
+    sm_scale_, bq, bk, interp = _resolve(q, sm_scale, block_q, block_k, causal,
+                                         interpret)
     dq, dk, dv = _flash_bwd((q, k, v, out, lse), g, seed, bias, sm_scale_, causal, rate,
                             bq, bk, interp)
     # bias is the (non-trainable) padding mask: cotangent is zero by contract; seed is
@@ -491,7 +506,8 @@ _flash_attention_core.defvjp(_core_fwd_rule, _core_bwd_rule)
 
 
 def flash_attention(q, k, v, causal: bool = False, sm_scale: Optional[float] = None,
-                    block_q: int = 256, block_k: int = 512, interpret: Optional[bool] = None,
+                    block_q: Optional[int] = None, block_k: Optional[int] = None,
+                    interpret: Optional[bool] = None,
                     bias=None, dropout_rate: float = 0.0, dropout_seed=None):
     """Blocked flash attention on [B, H, T, D] tensors. Differentiable in q/k/v.
 

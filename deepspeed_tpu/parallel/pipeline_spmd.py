@@ -84,11 +84,14 @@ def _flushed_apply(stage_fn, stacked_params, x_microbatches, cap, *, mesh,
         # micro-batched last_stage_args (labels) scan with the flushes; weights and
         # scalars ride the closure. With explicit specs ONLY a leading None marks
         # the micro-batch dim (P() means replicated — a weight whose leading dim
-        # happens to equal M must NOT be chunked); without specs fall back on the
-        # [M, batch, ...] shape heuristic.
-        if not (hasattr(a, "ndim") and a.ndim >= 2 and a.shape[0] == M):
+        # happens to equal M must NOT be chunked), and a [M] 1-D leaf (per-micro-
+        # batch weights) qualifies; without specs fall back on the conservative
+        # [M, batch, ...] shape heuristic (ndim >= 2).
+        if not (hasattr(a, "ndim") and a.ndim >= 1 and a.shape and a.shape[0] == M):
             return False
-        return spec is None or (len(spec) > 0 and spec[0] is None)
+        if spec is None:
+            return a.ndim >= 2
+        return len(spec) > 0 and spec[0] is None
 
     flat_args, args_treedef = jax.tree_util.tree_flatten(last_stage_args)
     if last_stage_args_specs is not None:

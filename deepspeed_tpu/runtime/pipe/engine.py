@@ -37,6 +37,17 @@ from ..engine import DeepSpeedEngine
 from . import schedule
 
 
+
+def _assert_ring_bound(chan, src_stage, receiver_ring, direction):
+    """The reference's per-stage buffer-ring memory contract
+    (deepspeed/runtime/pipe/engine.py:133-148) as a tested invariant: payloads
+    in flight from ``src_stage`` never exceed the RECEIVER's num_pipe_buffers()."""
+    in_flight = sum(1 for (src, _) in chan if src == src_stage)
+    assert in_flight <= receiver_ring, (
+        f"stage {src_stage} {direction} channel holds {in_flight} payloads "
+        f"> receiver num_pipe_buffers()={receiver_ring}")
+
+
 class PipelineError(Exception):
     """Errors related to the use of deepspeed.PipelineEngine."""
 
@@ -212,10 +223,7 @@ class PipelineEngine(DeepSpeedEngine):
         scheds = [schedule.TrainSchedule(micro_batches=mb, stages=S, stage_id=s)
                   for s in range(S)]
         streams = [list(iter(sc)) for sc in scheds]
-        # the reference's per-stage buffer-ring memory contract
-        # (deepspeed/runtime/pipe/engine.py:133-148) as a tested invariant: in-flight
-        # payloads bound by the RECEIVER's num_pipe_buffers()
-        ring_size = [sc.num_pipe_buffers() for sc in scheds]
+        ring_size = [sc.num_pipe_buffers() for sc in scheds]  # see _assert_ring_bound
 
         act_in = [dict() for _ in range(S)]    # stage -> buffer_id -> input activation
         act_out = [dict() for _ in range(S)]   # stage -> buffer_id -> output activation
@@ -284,10 +292,7 @@ class PipelineEngine(DeepSpeedEngine):
             elif isinstance(cmd, schedule.SendActivation):
                 mb_id, payload = act_out[s].pop(cmd.buffer_id)
                 chan_act[(s, mb_id)] = payload
-                in_flight = sum(1 for (src, _) in chan_act if src == s)
-                assert in_flight <= ring_size[s + 1], (
-                    f"stage {s}->{s + 1} activation channel holds {in_flight} payloads "
-                    f"> receiver num_pipe_buffers()={ring_size[s + 1]}")
+                _assert_ring_bound(chan_act, s, ring_size[s + 1], "activation")
             elif isinstance(cmd, schedule.RecvActivation):
                 mb_id = recv_act_count[s]
                 recv_act_count[s] += 1
@@ -310,10 +315,7 @@ class PipelineEngine(DeepSpeedEngine):
             elif isinstance(cmd, schedule.SendGrad):
                 mb_id, payload = dx_buf[s].pop(cmd.buffer_id)
                 chan_grad[(s, mb_id)] = payload
-                in_flight = sum(1 for (src, _) in chan_grad if src == s)
-                assert in_flight <= ring_size[s - 1], (
-                    f"stage {s}->{s - 1} grad channel holds {in_flight} payloads "
-                    f"> receiver num_pipe_buffers()={ring_size[s - 1]}")
+                _assert_ring_bound(chan_grad, s, ring_size[s - 1], "grad")
             elif isinstance(cmd, schedule.RecvGrad):
                 mb_id = recv_grad_count[s]
                 recv_grad_count[s] += 1
@@ -439,10 +441,7 @@ class PipelineEngine(DeepSpeedEngine):
             elif isinstance(cmd, schedule.SendActivation):
                 mb_id, payload = act_out[s].pop(cmd.buffer_id)
                 chan_act[(s, mb_id)] = payload
-                in_flight = sum(1 for (src, _) in chan_act if src == s)
-                assert in_flight <= ring_size[s + 1], (
-                    f"stage {s}->{s + 1} activation channel holds {in_flight} payloads "
-                    f"> receiver num_pipe_buffers()={ring_size[s + 1]}")
+                _assert_ring_bound(chan_act, s, ring_size[s + 1], "activation")
             elif isinstance(cmd, schedule.RecvActivation):
                 mb_id = recv_act_count[s]
                 recv_act_count[s] += 1

@@ -1,13 +1,14 @@
-"""Block-sparse vs dense-flash attention on the real TPU (VERDICT r3 next #2 evidence).
+"""Block-sparse vs dense-flash attention on the real TPU (slope-timed; see
+devtime.py — host-loop timings over the axon relay are fence-noise).
 
 BigBird layout at long seq; prints sparse/dense time and the speedup vs the
-density-ideal bound. Fence via device_get (axon relay). Run:
+density-ideal bound.
 
     python tests/perf/block_sparse_perf.py [--groups 1,2] [--bwd]
 """
 
+import os
 import sys
-import time
 
 import numpy as np
 
@@ -15,23 +16,12 @@ import jax
 import jax.numpy as jnp
 
 sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from devtime import timeit_slope  # noqa: E402
 from deepspeed_tpu.ops.pallas.block_sparse_attention import block_sparse_attention  # noqa: E402
 from deepspeed_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402
 from deepspeed_tpu.ops.sparse_attention.sparsity_config import BigBirdSparsityConfig  # noqa: E402
-
-
-def time_fn(fn, *args, iters=10):
-    fn(*args)
-    float(jax.device_get(jnp.sum(fn(*args))))
-    best = float("inf")
-    for _ in range(2):
-        t0 = time.time()
-        for _ in range(iters):
-            out = fn(*args)
-        float(jax.device_get(jnp.sum(out)))
-        best = min(best, (time.time() - t0) / iters)
-    return best
 
 
 def main():
@@ -48,26 +38,27 @@ def main():
         q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
         k = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
         v = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
+        n1, n2 = (50, 250) if T <= 4096 else (10, 60)
 
-        dt_dense = time_fn(jax.jit(lambda q, k, v: flash_attention(q, k, v)), q, k, v)
-        print(f"T={T} density={density:.3f} dense-flash fwd: {dt_dense*1e3:.2f} ms "
-              f"(ideal sparse: {dt_dense*density*1e3:.2f} ms)")
+        dt_dense = timeit_slope(lambda q, k, v: flash_attention(q, k, v), q, k, v,
+                                n1=n1, n2=n2)
+        print(f"T={T} density={density:.3f} dense-flash fwd: {dt_dense*1e3:.3f} ms "
+              f"(density-ideal sparse: {dt_dense*density*1e3:.3f} ms)")
         for g in groups:
-            f = jax.jit(lambda q, k, v, g=g: block_sparse_attention(
-                q, k, v, layout, BLOCK, group=g))
-            dt = time_fn(f, q, k, v)
-            print(f"  group={g}: {dt*1e3:.2f} ms  speedup {dt_dense/dt:.2f}x "
+            dt = timeit_slope(lambda q, k, v, g=g: block_sparse_attention(
+                q, k, v, layout, BLOCK, group=g), q, k, v, n1=n1, n2=n2)
+            print(f"  group={g}: {dt*1e3:.3f} ms  speedup {dt_dense/dt:.2f}x "
                   f"(ideal {1/density:.1f}x)")
             if do_bwd:
-                gr = jax.jit(jax.grad(lambda q, k, v, g=g: jnp.sum(
+                gs = lambda q, k, v, g=g: jax.grad(lambda q: jnp.sum(
                     block_sparse_attention(q, k, v, layout, BLOCK, group=g)
-                    .astype(jnp.float32))))
-                dt_b = time_fn(gr, q, k, v)
-                gd = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
-                    flash_attention(q, k, v).astype(jnp.float32))))
-                dt_db = time_fn(gd, q, k, v)
-                print(f"  group={g} bwd(dq-only-grad fwd+bwd): sparse {dt_b*1e3:.2f} ms "
-                      f"vs dense {dt_db*1e3:.2f} ms -> {dt_db/dt_b:.2f}x")
+                    .astype(jnp.float32)))(q)
+                gd = lambda q, k, v: jax.grad(lambda q: jnp.sum(
+                    flash_attention(q, k, v).astype(jnp.float32)))(q)
+                dt_b = timeit_slope(gs, q, k, v, n1=5, n2=30)
+                dt_db = timeit_slope(gd, q, k, v, n1=5, n2=30)
+                print(f"  group={g} fwd+bwd: sparse {dt_b*1e3:.3f} ms vs dense "
+                      f"{dt_db*1e3:.3f} ms -> {dt_db/dt_b:.2f}x")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,45 @@
+"""Slope-based kernel timing for the axon relay.
+
+A single device_get fence over the relay costs ~107 ms (measured 2026-07-30) and
+per-dispatch overhead is ~10 ms, so host-loop timings of ms-scale kernels are pure
+noise. This harness iterates the kernel ON DEVICE inside one jit (serial dependency
+defeats CSE/overlap) at two iteration counts and reports the SLOPE — the fence and
+dispatch costs cancel exactly:
+
+    t = (T(n2) - T(n1)) / (n2 - n1)
+
+Negative results mean fence variance still exceeds the compute delta: raise n1/n2.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit_slope(fn, *args, n1=10, n2=50, reps=3):
+    """Per-call seconds of ``fn(*args)`` (first arg must be a float array)."""
+
+    def make(inner):
+        @jax.jit
+        def many(*a):
+            def body(_, s):
+                out = fn(a[0] + s.astype(a[0].dtype) * 0, *a[1:])
+                return jnp.sum(out.astype(jnp.float32)) * 1e-30
+            return jax.lax.fori_loop(0, inner, body, jnp.zeros((), jnp.float32))
+        return many
+
+    f1, f2 = make(n1), make(n2)
+    for f in (f1, f2):
+        f(*args)
+        float(jax.device_get(f(*args)))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.time()
+        float(jax.device_get(f1(*args)))
+        ta = time.time() - t0
+        t0 = time.time()
+        float(jax.device_get(f2(*args)))
+        tb = time.time() - t0
+        best = min(best, (tb - ta) / (n2 - n1))
+    return best

@@ -1,14 +1,11 @@
-"""Flash-attention block-size sweep on the real TPU (VERDICT r3 kernel roofline work).
-
-Times the fwd kernel (and optionally fwd+bwd) across (block_q, block_k) at long seq.
-Fence via device_get (axon relay: block_until_ready does not fence). Run:
+"""Flash-attention block-size sweep on the real TPU (slope-timed; see devtime.py —
+host-loop timings over the axon relay are fence-noise).
 
     python tests/perf/flash_sweep.py [--bwd]
 """
 
-import itertools
+import os
 import sys
-import time
 
 import numpy as np
 
@@ -16,49 +13,39 @@ import jax
 import jax.numpy as jnp
 
 sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from devtime import timeit_slope  # noqa: E402
 from deepspeed_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402
-
-
-def time_fn(fn, *args, iters=10):
-    fn(*args)  # compile
-    float(jax.device_get(jnp.sum(fn(*args))))  # warm + fence
-    best = float("inf")
-    for _ in range(2):
-        t0 = time.time()
-        for _ in range(iters):
-            out = fn(*args)
-        float(jax.device_get(jnp.sum(out)))
-        best = min(best, (time.time() - t0) / iters)
-    return best
 
 
 def main():
     do_bwd = "--bwd" in sys.argv
     B, H, D = 1, 16, 64
     rng = np.random.default_rng(0)
-    for T, causal in ((4096, True), (4096, False), (8192, False)):
+    for T, causal in ((4096, False), (4096, True), (8192, False), (8192, True)):
         q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
         k = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
         v = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
         flops = 4.0 * B * H * T * T * D * (0.5 if causal else 1.0)
-        for bq, bk in itertools.product((256, 512), (512, 1024, 2048)):
-            if bq > T or bk > T:
-                continue
+        for bq, bk in ((None, None), (256, 512), (512, 1024), (1024, 1024)):
+            label = "auto" if bq is None else f"bq={bq} bk={bk}"
             try:
-                f = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash_attention(
-                    q, k, v, causal=causal, block_q=bq, block_k=bk))
-                dt = time_fn(f, q, k, v)
-                tag = f"T={T} causal={int(causal)} bq={bq} bk={bk}"
-                print(f"{tag}: {dt*1e3:.2f} ms  {flops/dt/1e12:.1f} TF/s")
+                dt = timeit_slope(lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                    q, k, v, causal=causal, block_q=bq, block_k=bk), q, k, v,
+                    n1=20, n2=100)
+                print(f"T={T} causal={int(causal)} {label}: {dt*1e3:7.3f} ms "
+                      f"{flops/dt/1e12:6.1f} TF/s")
                 if do_bwd:
-                    g = jax.jit(jax.grad(lambda q, k, v, bq=bq, bk=bk: jnp.sum(
-                        flash_attention(q, k, v, causal=causal, block_q=bq,
-                                        block_k=bk).astype(jnp.float32))))
-                    dt = time_fn(g, q, k, v)
-                    print(f"{tag} +bwd: {dt*1e3:.2f} ms  {3.5*flops/dt/1e12:.1f} TF/s")
+                    g = lambda q, k, v, bq=bq, bk=bk: jax.grad(
+                        lambda q: jnp.sum(flash_attention(
+                            q, k, v, causal=causal, block_q=bq,
+                            block_k=bk).astype(jnp.float32)))(q)
+                    dt = timeit_slope(g, q, k, v, n1=5, n2=30)
+                    print(f"T={T} causal={int(causal)} {label} +bwd: {dt*1e3:7.3f} ms "
+                          f"{3.5*flops/dt/1e12:6.1f} TF/s")
             except Exception as e:
-                print(f"T={T} bq={bq} bk={bk}: {type(e).__name__}: {e}")
+                print(f"T={T} causal={int(causal)} {label}: {type(e).__name__}: {e}")
 
 
 if __name__ == "__main__":

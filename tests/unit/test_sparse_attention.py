@@ -223,3 +223,23 @@ def test_grouped_kernel_parity(group, causal):
     for a, b, n in zip(gs, gd, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-4,
                                    err_msg=f"d{n} (group={group})")
+
+
+@pytest.mark.parametrize("group", [1, 2])
+def test_empty_row_inside_nonempty_group(group):
+    """An all-masked q-row packed into a group whose union is non-empty must yield
+    ZERO output and finite grads (the l-clamp guards the 0/0; regression pin for a
+    review-flagged NaN scenario that the clamp in fact prevents)."""
+    lay = np.ones((H, T // BLOCK, T // BLOCK), np.int64)
+    lay[:, 1, :] = 0   # empty q-row inside group {0,1}
+    lay[:, :, 2] = 0   # empty k-column inside a group too (dkv side)
+    q, k, v = qkv()
+    out = block_sparse_attention(q, k, v, lay, BLOCK, group=group)
+    ref = dense_blocksparse_attention(q, k, v, lay, BLOCK)
+    assert bool(jnp.isfinite(out).all())
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=3e-5, atol=3e-5)
+    row1 = np.asarray(out)[:, :, BLOCK:2 * BLOCK, :]
+    np.testing.assert_array_equal(row1, np.zeros_like(row1))
+    g = jax.grad(lambda q: jnp.sum(block_sparse_attention(q, k, v, lay, BLOCK,
+                                                          group=group)))(q)
+    assert bool(jnp.isfinite(g).all())
