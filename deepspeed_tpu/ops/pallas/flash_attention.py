@@ -369,13 +369,19 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, seq_len, has_bias, rate, t
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, seed, bias, sm_scale, causal, rate, block_q, block_k, interpret):
+def _flash_bwd(res, g, seed, bias, sm_scale, causal, rate, block_q, block_k, interpret,
+               g_lse=None):
     q, k, v, out, lse = res
     B, H, T, D = q.shape
     do = g
     # delta = rowsum(do * o): the softmax-normalization correction term (valid under
     # dropout too: do.o = sum_j probs_j * keep_j * (do.v_j) = sum_j probs_j * dprobs_j)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [B,H,T]
+    if g_lse is not None:
+        # An LSE cotangent folds into delta: dL/ds_ij gains g_lse_i * p_ij (softmax
+        # jacobian of logsumexp), so ds = p*(dp - (delta - g_lse)) — the whole lse
+        # gradient costs one subtraction. dv is untouched (lse doesn't read V).
+        delta = delta - g_lse.astype(jnp.float32)
 
     q3 = q.reshape(B * H, T, D)
     k3 = k.reshape(B * H, T, D)
@@ -503,6 +509,51 @@ def _core_bwd_rule(causal, sm_scale, rate, block_q, block_k, interpret, res, g):
 
 
 _flash_attention_core.defvjp(_core_fwd_rule, _core_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_attention_core_lse(q, k, v, bias, seed, causal, sm_scale, rate, block_q,
+                              block_k, interpret):
+    out, res = _core_fwd_rule(q, k, v, bias, seed, causal, sm_scale, rate, block_q,
+                              block_k, interpret)
+    return out, res[4]
+
+
+def _core_lse_fwd(q, k, v, bias, seed, causal, sm_scale, rate, block_q, block_k,
+                  interpret):
+    out, res = _core_fwd_rule(q, k, v, bias, seed, causal, sm_scale, rate, block_q,
+                              block_k, interpret)
+    return (out, res[4]), res
+
+
+def _core_lse_bwd(causal, sm_scale, rate, block_q, block_k, interpret, res, g):
+    g_out, g_lse = g
+    q, k, v, out, lse, bias, seed = res
+    sm_scale_, bq, bk, interp = _resolve(q, sm_scale, block_q, block_k, causal,
+                                         interpret)
+    dq, dk, dv = _flash_bwd((q, k, v, out, lse), g_out, seed, bias, sm_scale_, causal,
+                            rate, bq, bk, interp, g_lse=g_lse)
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    dseed = None if seed is None else np.zeros(np.shape(seed), jax.dtypes.float0)
+    return dq, dk, dv, dbias, dseed
+
+
+_flash_attention_core_lse.defvjp(_core_lse_fwd, _core_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = False,
+                             sm_scale: Optional[float] = None,
+                             block_q: Optional[int] = None,
+                             block_k: Optional[int] = None,
+                             interpret: Optional[bool] = None):
+    """Flash attention returning ``(out, lse)``, BOTH differentiable.
+
+    ``lse`` is the per-row log-sum-exp of the scaled scores ([B, H, T_q], natural
+    log) — the quantity sequence-parallel/ring attention combines across k/v chunks
+    (parallel/ring_attention.py). The lse cotangent folds into the standard flash
+    backward's delta term, so the extra gradient is effectively free."""
+    return _flash_attention_core_lse(q, k, v, None, None, bool(causal), sm_scale,
+                                     0.0, block_q, block_k, interpret)
 
 
 def flash_attention(q, k, v, causal: bool = False, sm_scale: Optional[float] = None,

@@ -1,0 +1,103 @@
+"""Ring attention (sequence parallelism) vs dense attention on the 8-device mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.ops.pallas.flash_attention import (dense_attention,
+                                                      flash_attention_with_lse)
+from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.parallel.ring_attention import ring_attention_sharded
+
+B, H, T, D = 2, 4, 256, 32
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh(data=8, model=1, pipe=1)
+
+
+def qkv(seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (B, H, T, D), jnp.float32) for k in ks)
+
+
+def test_flash_lse_matches_dense_logsumexp():
+    q, k, v = qkv()
+    out, lse = flash_attention_with_lse(q, k, v, interpret=True)
+    import math
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(D)
+    ref_lse = jax.scipy.special.logsumexp(scores, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense_attention(q, k, v)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_lse_cotangent_matches_autodiff():
+    """grad through BOTH outputs (out and lse) must match dense autodiff — the lse
+    cotangent is what makes the pure-JAX ring backward correct."""
+    q, k, v = qkv(1)
+    w = jax.random.normal(jax.random.PRNGKey(9), (B, H, T), jnp.float32)
+
+    def loss_flash(q, k, v):
+        out, lse = flash_attention_with_lse(q, k, v, interpret=True)
+        return jnp.sum(out ** 2) + jnp.sum(w * lse)
+
+    def loss_dense(q, k, v):
+        import math
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(D)
+        lse = jax.scipy.special.logsumexp(scores, axis=-1)
+        out = dense_attention(q, k, v)
+        return jnp.sum(out ** 2) + jnp.sum(w * lse)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+                                   err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_dense(mesh, causal):
+    q, k, v = qkv(2)
+    out = ring_attention_sharded(q, k, v, mesh, causal=causal, interpret=True)
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    # really sequence-sharded over the ring axis
+    assert not out.sharding.is_fully_replicated
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_grads_match_dense(mesh, causal):
+    q, k, v = qkv(3)
+    g = jax.random.normal(jax.random.PRNGKey(7), (B, H, T, D), jnp.float32)
+    spec = NamedSharding(mesh, P(None, None, "data", None))
+    g = jax.device_put(g, spec)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention_sharded(q, k, v, mesh, causal=causal,
+                                              interpret=True) * g)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=causal) * g)
+
+    gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))(q, k, v)
+    for a, b, name in zip(gr, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5,
+                                   err_msg=f"d{name} (causal={causal})")
+
+
+def test_ring_memory_is_chunked(mesh):
+    """The per-chunk flash only ever sees [T/n]-sized operands: a sequence whose
+    FULL [T, T] score matrix would be enormous still runs (no O(T^2) anywhere)."""
+    T_big = 1024  # scores would be [1024, 1024] per (b, h) — chunk kernel sees 128
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, T_big, D), jnp.float32) for kk in ks)
+    out = ring_attention_sharded(q, k, v, mesh, interpret=True)
+    ref = dense_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
