@@ -79,6 +79,7 @@ class GPT2Model:
         self.config = config
         self.tp_axis = None   # set via with_tp() for manual-collective (shard_map) TP
         self.tp_size = 1
+        self.seq_axis = None  # set via with_sequence_parallel() for ring attention
 
     def with_tp(self, axis: str, size: int) -> "GPT2Model":
         """A copy configured for manual tensor parallelism over mesh axis ``axis``."""
@@ -89,6 +90,41 @@ class GPT2Model:
         m.tp_axis = axis
         m.tp_size = size
         return m
+
+    def with_sequence_parallel(self, axis: str) -> "GPT2Model":
+        """A copy configured for ring-attention sequence parallelism over mesh axis
+        ``axis``: call inside shard_map with tokens/activations sharded over the
+        SEQUENCE dim (see ``sequence_parallel_loss_fn`` for the packaged wrapper).
+        Position embeddings offset by the rank's chunk start; attention runs the
+        ppermute ring (parallel/ring_attention.py). Long-context path past the
+        single-chip flash kernel's whole-K/V VMEM cap."""
+        assert self.tp_axis is None, \
+            "sequence parallelism does not compose with manual TP yet"
+        assert self.config.dropout == 0.0, \
+            "the ring attention path has no in-kernel dropout; set dropout=0"
+        m = GPT2Model(self.config)
+        m.seq_axis = axis
+        return m
+
+    def sequence_parallel_loss_fn(self, mesh, axis: str):
+        """``model_fn(params, tokens, labels) -> loss`` for the engine: shard_map
+        over ``axis`` with the sequence dim of tokens/labels sharded and ring
+        attention inside. ``labels`` must be globally next-token-shifted BEFORE
+        sharding (the shift crosses chunk boundaries)."""
+        from jax.sharding import PartitionSpec as P
+        sp = self.with_sequence_parallel(axis)
+        tok_spec = P(None, axis)
+
+        def model_fn(params, tokens, labels):
+            def local(params, tokens, labels):
+                # equal shards: global token mean = mean of per-rank means
+                return jax.lax.pmean(sp.apply(params, tokens, labels), axis)
+
+            return jax.shard_map(local, mesh=mesh,
+                                 in_specs=(P(), tok_spec, tok_spec),
+                                 out_specs=P(), check_vma=False)(params, tokens, labels)
+
+        return model_fn
 
     def param_shardings(self, mesh):
         """Megatron-style TP layouts over the mesh's ``model`` axis for the GSPMD path:
@@ -176,7 +212,12 @@ class GPT2Model:
         k = k.reshape(B, T, nh, c.head_dim).transpose(0, 2, 1, 3)
         v = v.reshape(B, T, nh, c.head_dim).transpose(0, 2, 1, 3)
 
-        if c.use_flash_attention:
+        if self.seq_axis is not None:
+            # sequence-parallel ring: T here is the LOCAL chunk; global causality is
+            # handled by chunk ordering + the diagonal chunk's in-kernel mask
+            from ..parallel.ring_attention import ring_attention
+            y = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
+        elif c.use_flash_attention:
             from ..ops.pallas.flash_attention import flash_attention
             rate, seed = 0.0, None
             if dropout_rng is not None and c.dropout > 0:
@@ -245,6 +286,9 @@ class GPT2Model:
         c = self.config
         B, T = tokens.shape
         pos = jnp.arange(T)
+        if self.seq_axis is not None:
+            # sequence-sharded: this rank holds global positions [r*T, (r+1)*T)
+            pos = pos + jax.lax.axis_index(self.seq_axis) * T
         x = params["wte"][tokens].astype(c.compute_dtype) + params["wpe"][pos].astype(c.compute_dtype)
         use_dropout = rng is not None and c.dropout > 0
         if use_dropout:

@@ -101,3 +101,65 @@ def test_ring_memory_is_chunked(mesh):
     out = ring_attention_sharded(q, k, v, mesh, interpret=True)
     ref = dense_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_gpt2_sequence_parallel_matches_dense(mesh):
+    """GPT-2 with with_sequence_parallel over 8 ranks: loss AND grads equal the
+    plain dense model on the full sequence (positions offset per rank, ring
+    attention, pmean'd token loss)."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    cfg = GPT2Config(vocab_size=128, n_positions=128, n_embd=32, n_layer=2, n_head=2,
+                     compute_dtype=jnp.float32)
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, size=(2, 128)).astype(np.int32)
+    labels = np.roll(toks, -1, axis=1)  # global shift BEFORE sharding
+
+    sp_loss = model.sequence_parallel_loss_fn(mesh, "data")
+    l_sp = jax.jit(sp_loss)(params, jnp.asarray(toks), jnp.asarray(labels))
+    l_ref = model.apply(params, jnp.asarray(toks), jnp.asarray(labels))
+    np.testing.assert_allclose(float(l_sp), float(l_ref), rtol=2e-5)
+
+    g_sp = jax.jit(jax.grad(sp_loss))(params, jnp.asarray(toks), jnp.asarray(labels))
+    g_ref = jax.grad(model.apply)(params, jnp.asarray(toks), jnp.asarray(labels))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=1e-3, atol=1e-5),
+        g_sp, g_ref)
+
+
+def test_gpt2_sequence_parallel_trains_through_engine(mesh):
+    """The packaged model_fn drives DeepSpeedEngine end to end (seq sharded over
+    the data axis; params replicated; loss decreases)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    cfg = GPT2Config(vocab_size=64, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+                     compute_dtype=jnp.float32)
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(1))
+    model_fn = model.sequence_parallel_loss_fn(mesh, "data")
+    engine = DeepSpeedEngine(
+        model=model_fn, model_parameters=params, mesh=mesh,
+        config_params={"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+                       "gradient_accumulation_steps": 1, "steps_per_print": 100,
+                       "optimizer": {"type": "Adam", "params": {"lr": 3e-3}}})
+    rng = np.random.default_rng(2)
+    losses = []
+    toks = rng.integers(0, 64, size=(2, 64)).astype(np.int32)
+    labels = np.roll(toks, -1, axis=1)
+    # the 'data' axis carries the SEQUENCE here: pre-shard inputs on dim 1 (the
+    # engine's shard_batch default of dim-0-over-data doesn't apply)
+    spec = NamedSharding(mesh, P(None, "data"))
+    toks_d = jax.device_put(jnp.asarray(toks), spec)
+    labels_d = jax.device_put(jnp.asarray(labels), spec)
+    for _ in range(30):
+        loss = engine(toks_d, labels_d)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
