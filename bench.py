@@ -184,20 +184,37 @@ def _engine_1p5b_subprocess():
     import subprocess
     # measured r3: dots/attn at batch 8 and dots at 4 OOM next to the dp=1 fp32
     # master; attn@4 (0.395 MFU) edges out full@8 (0.388). dots@8 stays first in
-    # case a future round frees HBM (it matches the hand-rolled 0.46-MFU config).
-    for policy, batch in (("dots", 8), ("attn", 4), ("full", 8)):
-        try:
-            r = subprocess.run([sys.executable, os.path.abspath(__file__),
-                                "--engine-1p5b", policy, str(batch)],
-                               capture_output=True, text=True, timeout=1500)
+    # case a future round frees HBM (it matches the hand-rolled 0.46-MFU config);
+    # full@4 is the last resort for a shared-tunnel chip under HBM pressure.
+    # Transient relay-compile failures ("response body closed", HTTP 500) get one
+    # retry per config before falling through.
+    for policy, batch in (("dots", 8), ("attn", 4), ("full", 8), ("full", 4)):
+        for attempt in range(2):
+            try:
+                r = subprocess.run([sys.executable, os.path.abspath(__file__),
+                                    "--engine-1p5b", policy, str(batch)],
+                                   capture_output=True, text=True, timeout=1500)
+            except subprocess.TimeoutExpired:
+                sys.stderr.write(f"[bench] engine 1.5B ({policy}, B={batch}) timed out\n")
+                break
             for line in r.stdout.splitlines():
                 if line.startswith("ENGINE_OK "):
                     _, tps, mfu = line.split()
                     return float(tps), float(mfu), f"remat={policy},batch={batch}"
-            sys.stderr.write(f"[bench] engine 1.5B ({policy}, B={batch}) failed:\n"
+            # relay hiccups are retryable; resource exhaustion is deterministic even
+            # when it surfaces through the remote-compile path (HTTP 500 can be a
+            # real scoped-VMEM/SMEM overflow — never retry those)
+            deterministic = any(sig in r.stderr for sig in
+                                ("RESOURCE_EXHAUSTED", "Ran out of memory",
+                                 "exceeded scoped"))
+            transient = not deterministic and any(
+                sig in r.stderr for sig in
+                ("response body", "remote_compile", "HTTP 500"))
+            sys.stderr.write(f"[bench] engine 1.5B ({policy}, B={batch}) failed"
+                             f"{' (transient, retrying)' if transient and attempt == 0 else ''}:\n"
                              + "\n".join(r.stderr.splitlines()[-3:]) + "\n")
-        except subprocess.TimeoutExpired:
-            sys.stderr.write(f"[bench] engine 1.5B ({policy}, B={batch}) timed out\n")
+            if not (transient and attempt == 0):
+                break
     return 0.0, 0.0, "failed"
 
 
