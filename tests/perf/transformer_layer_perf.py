@@ -1,0 +1,60 @@
+"""BERT-large encoder layer fwd+bwd on the real TPU (slope-timed; see devtime.py).
+
+The reference's headline: 64 TFLOPS (seq 128) / 53 TFLOPS (seq 512) for its fused
+fp16 CUDA kernel on V100 (docs/_tutorials/bert-pretraining.md:387). Mask + train-mode
+dropout active (the flash kernel's in-kernel mask+dropout path).
+
+    python tests/perf/transformer_layer_perf.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from devtime import timeit_slope  # noqa: E402
+from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,  # noqa: E402
+                                           DeepSpeedTransformerLayer)
+
+
+def layer_flops(batch, seq, hidden, inter, heads):
+    mm = 2 * batch * seq * hidden * (3 * hidden + hidden) + 2 * batch * seq * (
+        hidden * inter + inter * hidden)
+    attn = 4 * batch * heads * seq * seq * (hidden // heads)
+    return 3.5 * (mm + attn)  # fwd + ~2.5x bwd (flash recompute included)
+
+
+def main():
+    H, I, NH = 1024, 4096, 16  # BERT-large
+    rng = np.random.default_rng(0)
+    for seq, batch in ((128, 64), (512, 16)):
+        cfg = DeepSpeedTransformerConfig(
+            batch_size=batch, max_seq_length=seq, hidden_size=H, intermediate_size=I,
+            heads=NH, attn_dropout_ratio=0.1, hidden_dropout_ratio=0.1,
+            num_hidden_layers=24, fp16=False, pre_layer_norm=True)
+        layer = DeepSpeedTransformerLayer(cfg)
+        params = layer.init(jax.random.PRNGKey(0))
+        x = jnp.asarray(rng.normal(size=(batch, seq, H)), jnp.bfloat16)
+        mask = jnp.zeros((batch, 1, 1, seq), jnp.float32)
+        key = jax.random.PRNGKey(1)
+
+        def loss(x, params):
+            out = layer.apply(params, x, attention_mask=mask, rng=key,
+                              deterministic=False)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        g = lambda x, params: jax.grad(loss, argnums=(0, 1))(x, params)[0]
+        dt = timeit_slope(g, x, params, n1=10, n2=50)
+        fl = layer_flops(batch, seq, H, I, NH)
+        print(f"seq={seq} batch={batch}: {dt*1e3:.3f} ms  {fl/dt/1e12:.1f} TF/s "
+              f"(reference V100 claim: {64 if seq == 128 else 53} TFLOPS)")
+
+
+if __name__ == "__main__":
+    main()
