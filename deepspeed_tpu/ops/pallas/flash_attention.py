@@ -556,6 +556,56 @@ def flash_attention_with_lse(q, k, v, causal: bool = False,
                                      0.0, block_q, block_k, interpret)
 
 
+def _merge_partial(o, lse, o_new, lse_new):
+    """Online-softmax merge of normalized partials (fp32 accumulator)."""
+    lse_out = jnp.logaddexp(lse, lse_new)
+    o_out = (o * jnp.exp(lse - lse_out)[..., None]
+             + o_new.astype(jnp.float32) * jnp.exp(lse_new - lse_out)[..., None])
+    return o_out, lse_out
+
+
+# The whole-K/V-resident kernel exceeds scoped VMEM (16 MB) past this sequence
+# length at d=64 (measured: T=16384 needs 16.16 MB); longer single-chip sequences
+# stream K/V in chunks below.
+_RESIDENT_T_LIMIT = 8192
+
+
+def _flash_attention_chunked(q, k, v, causal, sm_scale, interpret, chunk):
+    """Single-chip long-context flash: decompose the [T, T] attention into equal
+    ``chunk x chunk`` tiles, run the resident kernel per (q-chunk, k-chunk) pair
+    and merge each q-chunk's (out, lse) partials — the sequential analog of ring
+    attention's combine (same `flash_attention_with_lse` + online merge, so fully
+    differentiable; one compiled kernel shape reused for every pair). Causal is
+    EXACT with no wasted compute: a q-chunk visits only its <= k-chunks, the
+    diagonal pair with the in-kernel triangular mask."""
+    B, H, T, D = q.shape
+    n = T // chunk
+    rows = []
+    for i in range(n):
+        qi = q[:, :, i * chunk:(i + 1) * chunk]
+        o = lse = None
+        for c in range(i + 1 if causal else n):
+            ks = k[:, :, c * chunk:(c + 1) * chunk]
+            vs = v[:, :, c * chunk:(c + 1) * chunk]
+            oc, lc = flash_attention_with_lse(qi, ks, vs, causal=(causal and c == i),
+                                              sm_scale=sm_scale, interpret=interpret)
+            if o is None:  # adopt the first partial; no merge against -inf init
+                o, lse = oc.astype(jnp.float32), lc
+            else:
+                o, lse = _merge_partial(o, lse, oc, lc)
+        rows.append(o)
+    return jnp.concatenate(rows, axis=2).astype(q.dtype)
+
+
+def _chunk_for(T: int) -> int:
+    """Largest divisor of T not exceeding the resident VMEM ceiling (halving from
+    the limit keeps chunks 128-aligned for any even T)."""
+    c = _RESIDENT_T_LIMIT
+    while c > 1 and T % c != 0:
+        c //= 2
+    return c
+
+
 def flash_attention(q, k, v, causal: bool = False, sm_scale: Optional[float] = None,
                     block_q: Optional[int] = None, block_k: Optional[int] = None,
                     interpret: Optional[bool] = None,
@@ -574,6 +624,17 @@ def flash_attention(q, k, v, causal: bool = False, sm_scale: Optional[float] = N
     for parity tests.
     """
     rate = float(dropout_rate)
+    T_k = k.shape[2]
+    if (T_k > _RESIDENT_T_LIMIT and q.shape[2] == T_k and bias is None and rate == 0
+            and block_q is None and block_k is None and _chunk_for(T_k) >= 1024
+            and not (interpret or jax.default_backend() != "tpu")):
+        # Past the resident kernel's scoped-VMEM ceiling: decompose into chunk
+        # tiles. bias/dropout callers and explicit block sizes keep the resident
+        # path — the coordinate-hash dropout PRNG indexes positions tile-locally,
+        # so in-kernel attention dropout is limited to T <= 8192 (disable attention
+        # dropout for longer sequences, standard for long-context training).
+        return _flash_attention_chunked(q, k, v, bool(causal), sm_scale, interpret,
+                                        chunk=_chunk_for(T_k))
     if rate > 0:
         assert dropout_seed is not None, "dropout_rate > 0 requires a dropout_seed"
         seed = jnp.asarray(dropout_seed, jnp.int32).reshape(())

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.pallas.flash_attention import flash_attention_with_lse
+from ..ops.pallas.flash_attention import _merge_partial, flash_attention_with_lse
 from .mesh import DATA_AXIS
 
 
@@ -72,15 +72,12 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
             keep = src < rank  # strictly-past chunks attend; future contribute zero
             lse_r = jnp.where(keep, lse_r, -jnp.inf)
             out_r = jnp.where(keep, out_r, jnp.zeros((), out_r.dtype))
-        out_r32 = out_r.astype(jnp.float32)
         if o is None:
-            o, lse = out_r32, lse_r
+            o, lse = out_r.astype(jnp.float32), lse_r
         else:
-            # online-softmax merge of normalized partials: weights from the lse gap
-            lse_new = jnp.logaddexp(lse, lse_r)
-            o = (o * jnp.exp(lse - lse_new)[..., None]
-                 + out_r32 * jnp.exp(lse_r - lse_new)[..., None])
-            lse = lse_new
+            # online-softmax merge of normalized partials (shared with the
+            # single-chip chunked flash path)
+            o, lse = _merge_partial(o, lse, out_r, lse_r)
     return o.astype(q.dtype)
 
 
