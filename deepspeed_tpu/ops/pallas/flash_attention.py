@@ -124,7 +124,12 @@ def _fwd_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, thres
     # sm_scale*log2e is pre-folded into q: scores come out of the MXU in base-2 units.
     q = (q_ref[...].astype(jnp.float32) * (sm_scale * LOG2E)).astype(q_ref.dtype)
     if rate > 0:
+        # seed operand is [seed, q_offset, k_offset]: the offsets translate this
+        # call's LOCAL positions into GLOBAL sequence coordinates for the dropout
+        # hash, so chunked long-context tiles and ring-attention shards regenerate
+        # the same bit stream as a single whole-sequence kernel would.
         seed_u32 = seed_ref[0].astype(jnp.uint32)
+        q_off, k_off = seed_ref[1], seed_ref[2]
         bh_u32 = pl.program_id(0).astype(jnp.uint32)
         inv_keep = 1.0 / (1.0 - rate)
 
@@ -161,7 +166,7 @@ def _fwd_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, thres
             # the normalizer uses the UNdropped probabilities (torch dropout(softmax(s)))
             l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
             if rate > 0:
-                bits = _dropout_bits(seed_u32, bh_u32, q_pos, k_pos)
+                bits = _dropout_bits(seed_u32, bh_u32, q_pos + q_off, k_pos + k_off)
                 keep = (bits >= jnp.uint32(threshold)).astype(jnp.float32) * inv_keep
                 p_eff = p * keep
             else:
@@ -189,7 +194,9 @@ def _aux_operands(seed, bias, B, H, T, rate, block_k_map=None):
     """
     operands, specs = [], []
     if rate > 0:
-        operands.append(jnp.asarray(seed, jnp.int32).reshape(1))
+        # [seed, q_offset, k_offset] — see _fwd_kernel on the global-coordinate
+        # contract for the dropout hash
+        operands.append(jnp.asarray(seed, jnp.int32).reshape(3))
         specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
     if bias is not None:
         operands.append(jnp.asarray(bias, jnp.float32).reshape(B, 1, T))
@@ -258,6 +265,7 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, th
     delta = delta_ref[...].reshape(bq, 1)
     if rate > 0:
         seed_u32 = seed_ref[0].astype(jnp.uint32)
+        q_off, k_off = seed_ref[1], seed_ref[2]
         bh_u32 = pl.program_id(0).astype(jnp.uint32)
         inv_keep = 1.0 / (1.0 - rate)
 
@@ -284,7 +292,7 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, th
             p = jnp.exp2(s - lse2)
             dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
             if rate > 0:
-                bits = _dropout_bits(seed_u32, bh_u32, q_pos, k_pos)
+                bits = _dropout_bits(seed_u32, bh_u32, q_pos + q_off, k_pos + k_off)
                 dp = dp * ((bits >= jnp.uint32(threshold)).astype(jnp.float32) * inv_keep)
             ds = p * (dp - delta)
             return dq + jnp.dot(ds.astype(k_blk.dtype), k_blk, preferred_element_type=jnp.float32)
@@ -313,6 +321,7 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, seq_len, has_bias, rate, t
     v = v_ref[...]
     if rate > 0:
         seed_u32 = seed_ref[0].astype(jnp.uint32)
+        q_off, k_off = seed_ref[1], seed_ref[2]
         bh_u32 = pl.program_id(0).astype(jnp.uint32)
         inv_keep = 1.0 / (1.0 - rate)
 
@@ -343,7 +352,7 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, seq_len, has_bias, rate, t
                 s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
             p = jnp.exp2(s - lse2_blk)
             if rate > 0:
-                bits = _dropout_bits(seed_u32, bh_u32, q_pos, k_pos)
+                bits = _dropout_bits(seed_u32, bh_u32, q_pos + q_off, k_pos + k_off)
                 keep = (bits >= jnp.uint32(threshold)).astype(jnp.float32) * inv_keep
                 p_drop = p * keep
             else:
@@ -541,19 +550,41 @@ def _core_lse_bwd(causal, sm_scale, rate, block_q, block_k, interpret, res, g):
 _flash_attention_core_lse.defvjp(_core_lse_fwd, _core_lse_bwd)
 
 
+def _seed_vec(seed, q_offset, k_offset):
+    """Pack (seed, global q offset, global k offset) into the (3,) int32 operand the
+    kernels read from SMEM. Offsets may be traced (ring attention derives them from
+    ``axis_index``)."""
+    return jnp.stack([jnp.asarray(seed, jnp.int32).reshape(()),
+                      jnp.asarray(q_offset, jnp.int32).reshape(()),
+                      jnp.asarray(k_offset, jnp.int32).reshape(())])
+
+
 def flash_attention_with_lse(q, k, v, causal: bool = False,
                              sm_scale: Optional[float] = None,
                              block_q: Optional[int] = None,
                              block_k: Optional[int] = None,
-                             interpret: Optional[bool] = None):
+                             interpret: Optional[bool] = None,
+                             dropout_rate: float = 0.0, dropout_seed=None,
+                             dropout_q_offset=0, dropout_k_offset=0):
     """Flash attention returning ``(out, lse)``, BOTH differentiable.
 
     ``lse`` is the per-row log-sum-exp of the scaled scores ([B, H, T_q], natural
     log) — the quantity sequence-parallel/ring attention combines across k/v chunks
     (parallel/ring_attention.py). The lse cotangent folds into the standard flash
-    backward's delta term, so the extra gradient is effectively free."""
-    return _flash_attention_core_lse(q, k, v, None, None, bool(causal), sm_scale,
-                                     0.0, block_q, block_k, interpret)
+    backward's delta term, so the extra gradient is effectively free.
+
+    ``dropout_q_offset``/``dropout_k_offset`` translate this call's local positions
+    into global sequence coordinates for the dropout PRNG, so chunk/ring callers
+    sample the same mask a whole-sequence kernel would (they may be traced values).
+    """
+    rate = float(dropout_rate)
+    if rate > 0:
+        assert dropout_seed is not None, "dropout_rate > 0 requires a dropout_seed"
+        seed = _seed_vec(dropout_seed, dropout_q_offset, dropout_k_offset)
+    else:
+        seed = None
+    return _flash_attention_core_lse(q, k, v, None, seed, bool(causal), sm_scale,
+                                     rate, block_q, block_k, interpret)
 
 
 def _merge_partial(o, lse, o_new, lse_new):
@@ -570,14 +601,18 @@ def _merge_partial(o, lse, o_new, lse_new):
 _RESIDENT_T_LIMIT = 8192
 
 
-def _flash_attention_chunked(q, k, v, causal, sm_scale, interpret, chunk):
+def _flash_attention_chunked(q, k, v, causal, sm_scale, interpret, chunk,
+                             rate=0.0, seed=None, block_q=None, block_k=None):
     """Single-chip long-context flash: decompose the [T, T] attention into equal
     ``chunk x chunk`` tiles, run the resident kernel per (q-chunk, k-chunk) pair
     and merge each q-chunk's (out, lse) partials — the sequential analog of ring
     attention's combine (same `flash_attention_with_lse` + online merge, so fully
     differentiable; one compiled kernel shape reused for every pair). Causal is
     EXACT with no wasted compute: a q-chunk visits only its <= k-chunks, the
-    diagonal pair with the in-kernel triangular mask."""
+    diagonal pair with the in-kernel triangular mask. Attention dropout works at
+    any length: each tile hashes GLOBAL (q, k) coordinates via the per-tile
+    offsets, so the sampled mask equals the whole-sequence kernel's
+    (``dropout_keep_reference`` at full T is the oracle)."""
     B, H, T, D = q.shape
     n = T // chunk
     rows = []
@@ -588,7 +623,11 @@ def _flash_attention_chunked(q, k, v, causal, sm_scale, interpret, chunk):
             ks = k[:, :, c * chunk:(c + 1) * chunk]
             vs = v[:, :, c * chunk:(c + 1) * chunk]
             oc, lc = flash_attention_with_lse(qi, ks, vs, causal=(causal and c == i),
-                                              sm_scale=sm_scale, interpret=interpret)
+                                              sm_scale=sm_scale, interpret=interpret,
+                                              block_q=block_q, block_k=block_k,
+                                              dropout_rate=rate, dropout_seed=seed,
+                                              dropout_q_offset=i * chunk,
+                                              dropout_k_offset=c * chunk)
             if o is None:  # adopt the first partial; no merge against -inf init
                 o, lse = oc.astype(jnp.float32), lc
             else:
@@ -624,22 +663,36 @@ def flash_attention(q, k, v, causal: bool = False, sm_scale: Optional[float] = N
     for parity tests.
     """
     rate = float(dropout_rate)
-    T_k = k.shape[2]
-    if (T_k > _RESIDENT_T_LIMIT and q.shape[2] == T_k and bias is None and rate == 0
-            and block_q is None and block_k is None and _chunk_for(T_k) >= 1024
-            and not (interpret or jax.default_backend() != "tpu")):
-        # Past the resident kernel's scoped-VMEM ceiling: decompose into chunk
-        # tiles. bias/dropout callers and explicit block sizes keep the resident
-        # path — the coordinate-hash dropout PRNG indexes positions tile-locally,
-        # so in-kernel attention dropout is limited to T <= 8192 (disable attention
-        # dropout for longer sequences, standard for long-context training).
-        return _flash_attention_chunked(q, k, v, bool(causal), sm_scale, interpret,
-                                        chunk=_chunk_for(T_k))
     if rate > 0:
         assert dropout_seed is not None, "dropout_rate > 0 requires a dropout_seed"
-        seed = jnp.asarray(dropout_seed, jnp.int32).reshape(())
-    else:
-        seed = None
+    T_k = k.shape[2]
+    if T_k > _RESIDENT_T_LIMIT and not (interpret or jax.default_backend() != "tpu"):
+        # Past the resident kernel's scoped-VMEM ceiling (the K/V operands are
+        # whole-sequence-resident regardless of block sizes): decompose into chunk
+        # tiles. Dropout works at any length (tiles hash global coordinates); an
+        # additive bias or non-square attention cannot take the chunked path, and
+        # silently compiling the resident kernel would fail deep inside Mosaic —
+        # raise the constraint instead.
+        chunk = _chunk_for(T_k)
+        if q.shape[2] == T_k and bias is None and chunk >= 1024:
+            return _flash_attention_chunked(q, k, v, bool(causal), sm_scale, interpret,
+                                            chunk=chunk, rate=rate, seed=dropout_seed,
+                                            block_q=block_q, block_k=block_k)
+        reasons = []
+        if q.shape[2] != T_k:
+            reasons.append(f"q_len ({q.shape[2]}) != k_len ({T_k}) — chunking assumes "
+                           "square self-attention")
+        if bias is not None:
+            reasons.append("an additive bias is not supported on the chunked path "
+                           "(fold padding into shorter sequences or segment masks)")
+        if chunk < 1024:
+            reasons.append(f"seq_len {T_k} has no divisor chunk >= 1024 (largest: "
+                           f"{chunk}) — pad the sequence to a multiple of 1024")
+        raise ValueError(
+            f"flash_attention: seq_len {T_k} exceeds the whole-K/V-resident kernel's "
+            f"scoped-VMEM ceiling (T <= {_RESIDENT_T_LIMIT}) and the chunked "
+            f"long-context path is ineligible: {'; '.join(reasons)}.")
+    seed = _seed_vec(dropout_seed, 0, 0) if rate > 0 else None
     if bias is not None:
         B, T_k = q.shape[0], k.shape[2]
         # no-grad contract made explicit in the jaxpr: a learnable bias passed here

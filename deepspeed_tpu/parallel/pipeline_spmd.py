@@ -82,18 +82,25 @@ def _flushed_apply(stage_fn, stacked_params, x_microbatches, cap, *, mesh,
 
     def is_microbatched(a, spec):
         # micro-batched last_stage_args (labels) scan with the flushes; weights and
-        # scalars ride the closure. With explicit specs ONLY a leading None marks
+        # scalars ride the closure. ONLY a leading None in the explicit spec marks
         # the micro-batch dim (P() means replicated — a weight whose leading dim
         # happens to equal M must NOT be chunked), and a [M] 1-D leaf (per-micro-
-        # batch weights) qualifies; without specs fall back on the conservative
-        # [M, batch, ...] shape heuristic (ndim >= 2).
+        # batch weights) qualifies.
         if not (hasattr(a, "ndim") and a.ndim >= 1 and a.shape and a.shape[0] == M):
             return False
-        if spec is None:
-            return a.ndim >= 2
         return len(spec) > 0 and spec[0] is None
 
     flat_args, args_treedef = jax.tree_util.tree_flatten(last_stage_args)
+    if last_stage_args_specs is None and flat_args:
+        # A shape heuristic here (leading dim == M) would silently chunk a weight
+        # whose leading dim coincides with M across flushes — demand the explicit
+        # contract instead of guessing.
+        raise ValueError(
+            f"pipeline_apply: the {M}-micro-batch window splits into flushes of "
+            f"{cap}, which requires explicit last_stage_args_specs to tell "
+            "micro-batched leaves (leading-None PartitionSpec, e.g. P(None, 'data')) "
+            "from per-flush constants (P()). Pass last_stage_args_specs, or "
+            "max_microbatches_per_flush=0 to disable splitting.")
     if last_stage_args_specs is not None:
         # specs may be a PREFIX tree (one P covering a whole subtree, as shard_map
         # accepts): broadcast each prefix leaf over its matching args subtree
@@ -103,7 +110,7 @@ def _flushed_apply(stage_fn, stacked_params, x_microbatches, cap, *, mesh,
             last_stage_args_specs, last_stage_args, is_leaf=is_p)
         flat_specs = jax.tree_util.tree_leaves(broadcast, is_leaf=is_p)
     else:
-        flat_specs = [None] * len(flat_args)
+        flat_specs = [P()] * len(flat_args)
     mb_flags = [is_microbatched(a, sp) for a, sp in zip(flat_args, flat_specs)]
 
     x_chunks = x_microbatches.reshape((n, cap) + x_microbatches.shape[1:])
@@ -173,6 +180,9 @@ def pipeline_apply(stage_fn: Callable,
         over pipe-sharded first_stage_args (vocab-parallel embedding).
       first_stage_args_specs: optional PartitionSpecs for first_stage_args (defaults to
         replicated); pass P(pipe, ...) leaves to shard IO params over the pipe axis.
+        first_stage_args must NOT be micro-batched ([M, ...]-leading): they ride the
+        flush closure whole and are never scanned — put per-micro-batch inputs in
+        ``x_microbatches`` (or labels-like data in ``last_stage_args``) instead.
       last_stage_collective: when True, last_stage_fn runs on EVERY pipe rank against
         the per-step psum-broadcast final activation and MAY use pipe-axis collectives
         over pipe-sharded last_stage_args (the vocab-parallel tied head+loss). Only one

@@ -43,17 +43,24 @@ from .mesh import DATA_AXIS
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
                    sm_scale: Optional[float] = None,
-                   interpret: Optional[bool] = None):
+                   interpret: Optional[bool] = None,
+                   dropout_rate: float = 0.0, dropout_seed=None):
     """Attention over a sequence sharded on ``axis_name`` (call inside shard_map).
 
     Args:
       q, k, v: LOCAL [B, H, T_local, D] shards; global sequence = n * T_local in
         ring order (rank r holds positions [r*T_local, (r+1)*T_local)).
       axis_name: mesh axis the sequence is sharded over.
+      dropout_rate/dropout_seed: in-kernel attention dropout. Each rank hashes
+        GLOBAL coordinates (its q offset is rank*T_local; the visiting chunk's k
+        offset follows the rotation), so the sampled mask is identical to a
+        single-chip kernel's over the full sequence — ``dropout_keep_reference``
+        at global T stays the oracle, and the mask is invariant to ring size.
     Returns the LOCAL [B, H, T_local, D] attention output. Differentiable in q/k/v.
     """
     n = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
+    T_local = q.shape[2]
     # chunks step to the NEXT rank each rotation: after r steps rank i holds the
     # k/v chunk originally at rank (i - r) mod n
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -66,7 +73,10 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
             vc = jax.lax.ppermute(vc, axis_name, perm)
         out_r, lse_r = flash_attention_with_lse(
             q, kc, vc, causal=(causal and r == 0), sm_scale=sm_scale,
-            interpret=interpret)
+            interpret=interpret, dropout_rate=dropout_rate,
+            dropout_seed=dropout_seed,
+            dropout_q_offset=rank * T_local,
+            dropout_k_offset=((rank - r) % n) * T_local)
         if causal and r > 0:
             src = (rank - r) % n
             keep = src < rank  # strictly-past chunks attend; future contribute zero
@@ -83,7 +93,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
 def ring_attention_sharded(q, k, v, mesh: Mesh, seq_axis: str = DATA_AXIS,
                            causal: bool = False, sm_scale: Optional[float] = None,
-                           interpret: Optional[bool] = None):
+                           interpret: Optional[bool] = None,
+                           dropout_rate: float = 0.0, dropout_seed=None):
     """Convenience wrapper: global [B, H, T, D] arrays, sequence sharded over
     ``seq_axis`` (dim 2). Places inputs if they aren't already sharded."""
     assert q.shape[2] % mesh.shape[seq_axis] == 0, \
@@ -94,6 +105,7 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, seq_axis: str = DATA_AXIS,
                jax.device_put(x, sharding) for x in (q, k, v))
     fn = jax.shard_map(
         functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
-                          sm_scale=sm_scale, interpret=interpret),
+                          sm_scale=sm_scale, interpret=interpret,
+                          dropout_rate=dropout_rate, dropout_seed=dropout_seed),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     return fn(q, k, v)

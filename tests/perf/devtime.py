@@ -24,7 +24,15 @@ def timeit_slope(fn, *args, n1=10, n2=50, reps=3):
         @jax.jit
         def many(*a):
             def body(_, s):
-                out = fn(a[0] + s.astype(a[0].dtype) * 0, *a[1:])
+                # Serial dependency XLA cannot fold away: the carry enters the
+                # kernel input scaled by a nonzero constant (a literal ``* 0``
+                # would constant-fold, making the body loop-invariant and
+                # hoistable, flattening the slope). The dtype's smallest NORMAL
+                # value is nonzero in every float dtype (a fixed 1e-30 would
+                # itself round to literal 0.0 in fp16 and restore the fold) and
+                # perturbs inputs by less than one ulp.
+                tiny = jnp.asarray(jnp.finfo(a[0].dtype).tiny, a[0].dtype)
+                out = fn(a[0] + s.astype(a[0].dtype) * tiny, *a[1:])
                 return jnp.sum(out.astype(jnp.float32)) * 1e-30
             return jax.lax.fori_loop(0, inner, body, jnp.zeros((), jnp.float32))
         return many

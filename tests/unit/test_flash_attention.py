@@ -197,3 +197,53 @@ def test_chunked_long_context_matches_dense(causal):
     for a, b, n in zip(gc, gd, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5,
                                    err_msg=f"d{n} (causal={causal})")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_chunked_dropout_matches_global_oracle(causal):
+    """Chunked tiles hash GLOBAL coordinates: dropout through the chunked path must
+    equal dense attention with the whole-sequence oracle mask (VERDICT r3 #4 — the
+    long-context path previously ran without attention dropout)."""
+    from deepspeed_tpu.ops.pallas.flash_attention import (_flash_attention_chunked,
+                                                          dropout_keep_reference)
+    B, H, T, D = 1, 2, 256, 32
+    rate, seed = 0.15, 99
+    ks = jax.random.split(jax.random.PRNGKey(21), 3)
+    q, k, v = (jax.random.normal(kk, (B, H, T, D), jnp.float32) for kk in ks)
+    keep = dropout_keep_reference(seed, B, H, T, T, rate)
+
+    def f_chunk(q, k, v):
+        return jnp.sum(_flash_attention_chunked(q, k, v, causal, None, True,
+                                                chunk=64, rate=rate, seed=seed) ** 2)
+
+    def f_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=causal, dropout_keep=keep) ** 2)
+
+    np.testing.assert_allclose(float(f_chunk(q, k, v)), float(f_dense(q, k, v)),
+                               rtol=2e-5)
+    gc = jax.grad(f_chunk, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, n in zip(gc, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5,
+                                   err_msg=f"d{n} (causal={causal})")
+
+
+def test_long_context_dispatch_raises_when_chunk_ineligible(monkeypatch):
+    """Past the resident VMEM ceiling, an ineligible chunked path must raise a
+    descriptive error instead of compiling the resident kernel into a Mosaic
+    failure (ADVICE r3)."""
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    D = 32
+    k = jnp.zeros((1, 1, 16384, D), jnp.bfloat16)
+    v = jnp.zeros((1, 1, 16384, D), jnp.bfloat16)
+    # non-square cross attention
+    with pytest.raises(ValueError, match="square self-attention"):
+        flash_attention(jnp.zeros((1, 1, 128, D), jnp.bfloat16), k, v)
+    # additive bias not supported on the chunked path
+    q = jnp.zeros((1, 1, 16384, D), jnp.bfloat16)
+    with pytest.raises(ValueError, match="additive bias"):
+        flash_attention(q, k, v, bias=jnp.zeros((1, 1, 1, 16384)))
+    # no divisor chunk >= 1024 (8704 = 512 * 17)
+    t = jnp.zeros((1, 1, 8704, D), jnp.bfloat16)
+    with pytest.raises(ValueError, match="divisor"):
+        flash_attention(t, t, t)

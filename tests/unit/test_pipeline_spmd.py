@@ -220,6 +220,7 @@ def test_gpt2_pipe_to_dense_roundtrip(tp):
 def test_auto_flush_split_matches_single_flush(mesh):
     """M = 8S must auto-split into rematerialized flushes (VERDICT r2 next #5) with
     bit-comparable loss AND grads vs the unsplit pipeline."""
+    from jax.sharding import PartitionSpec as P
     S2, M8 = 2, 16
     key = jax.random.PRNGKey(2)
     per_stage = []
@@ -238,6 +239,7 @@ def test_auto_flush_split_matches_single_flush(mesh):
         def f(s, x):
             return pipeline_apply(stage_fn, s, x, mesh=mesh, last_stage_fn=last_fn,
                                   last_stage_args=(labels_mb,),
+                                  last_stage_args_specs=(P(None, "data"),),
                                   max_microbatches_per_flush=cap)
         return f
 

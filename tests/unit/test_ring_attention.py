@@ -163,3 +163,31 @@ def test_gpt2_sequence_parallel_trains_through_engine(mesh):
         engine.step()
         losses.append(float(jax.device_get(loss)))
     assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_dropout_matches_global_oracle(mesh, causal):
+    """Attention dropout under the ring: every rank hashes GLOBAL coordinates, so
+    the 8-shard ring must equal dense attention with the whole-sequence oracle
+    mask — fwd and grads (VERDICT r3 #4)."""
+    from deepspeed_tpu.ops.pallas.flash_attention import dropout_keep_reference
+    rate, seed = 0.2, 1234
+    q, k, v = qkv(5)
+    keep = dropout_keep_reference(seed, B, H, T, T, rate)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention_sharded(q, k, v, mesh, causal=causal,
+                                              interpret=True, dropout_rate=rate,
+                                              dropout_seed=seed) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=causal,
+                                       dropout_keep=keep) ** 2)
+
+    np.testing.assert_allclose(float(jax.jit(loss_ring)(q, k, v)),
+                               float(loss_dense(q, k, v)), rtol=2e-5)
+    gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gr, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5,
+                                   err_msg=f"d{name} (causal={causal})")
