@@ -92,9 +92,12 @@ def bench_420m():
 
 def _shard_optimizer(dp):
     """Client (init, apply) pair for DeepSpeedEngine doing exactly one v5e-32 ZeRO-2
-    rank's optimizer work: Adam over a 1/dp fp32 shard of the gradient stream (the
-    engine's full fp32 master passes through untouched — a real rank would instead
-    all-gather updated bf16 shards, which needs the other 31 chips)."""
+    rank's optimizer work: Adam over a 1/dp fp32 shard of the gradient stream. The
+    apply is marked ``external_master``: the fp32 master shard it owns lives in
+    opt_state, so the engine keeps its dp=1 FULL fp32 master as host cold storage
+    (zero HBM — a real 1/32 rank never holds it) and skips the full-params re-cast
+    (a real rank refreshes params from the 32-way all-gather, which needs the other
+    31 chips and is excluded here like every cross-chip collective)."""
     import jax
     import jax.numpy as jnp
 
@@ -120,16 +123,18 @@ def _shard_optimizer(dp):
         shard = state["shard"] - hyper["lr"] * m1 / (jnp.sqrt(m2) + hyper["eps"])
         return master, {"shard": shard, "m1": m1, "m2": m2}
 
+    apply.external_master = True
     return init, apply
 
 
 def bench_1p5b_engine(remat_policy="dots", batch=8):
-    """The 1.5B metric measured THROUGH DeepSpeedEngine (VERDICT r2 next #1a): the
-    real jitted value_and_grad, grad adoption, apply_update with donated buffers,
-    monitor/report path — with the per-rank optimizer work supplied as a client
-    (init, apply) pair. Differences vs a real v5e-32 rank: the engine's dp=1 fp32
-    master is FULL (6.2 GB; a real rank holds 1/32), which also forces the full
-    params re-cast each step, and cross-chip collectives are excluded."""
+    """The 1.5B metric measured THROUGH DeepSpeedEngine: the real jitted
+    value_and_grad, grad adoption, apply_update with donated buffers,
+    monitor/report path — with the per-rank optimizer work supplied as an
+    external-master client pair (the fp32 shard lives in opt_state; the engine's
+    dp=1 full fp32 master is host cold storage, matching a real 1/32 rank's HBM
+    footprint). The only remaining difference vs a real v5e-32 rank: cross-chip
+    collectives are excluded (they need the other 31 chips)."""
     import jax
     import jax.numpy as jnp
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
@@ -178,44 +183,79 @@ def bench_1p5b_engine(remat_policy="dots", batch=8):
     return tps, mfu
 
 
+PINNED_ENGINE_CONFIG = ("dots", 8)  # the hand-rolled 0.46-MFU config, now reachable
+# through the engine: the external-master shard optimizer keeps the dp=1 fp32
+# master off-HBM, so remat=dots fits at batch 8 (VERDICT r3 #2).
+
+
 def _engine_1p5b_subprocess():
     """Engine-driven 1.5B in a fresh process (an OOM must not poison the relay for
-    the rest of the bench), falling back through lighter configs."""
+    the rest of the bench).
+
+    Config discipline (VERDICT r3 #8): the PINNED config runs first and is the ONLY
+    config whose number may become ``gpt2_1p5b_engine_mfu`` — if it fails
+    deterministically the metric reports 0.0 (loud) with the failure log in extra,
+    and any fallback measurement is reported separately as ``engine_fallback_*`` so
+    the round-over-round headline stays config-stable. Transient relay failures
+    ("response body closed", HTTP 500 without a resource signature) get up to two
+    retries; resource exhaustion never retries."""
     import subprocess
-    # measured r3: dots/attn at batch 8 and dots at 4 OOM next to the dp=1 fp32
-    # master; attn@4 (0.395 MFU) edges out full@8 (0.388). dots@8 stays first in
-    # case a future round frees HBM (it matches the hand-rolled 0.46-MFU config);
-    # full@4 is the last resort for a shared-tunnel chip under HBM pressure.
-    # Transient relay-compile failures ("response body closed", HTTP 500) get one
-    # retry per config before falling through.
-    for policy, batch in (("dots", 8), ("attn", 4), ("full", 8), ("full", 4)):
-        for attempt in range(2):
+
+    attempts = []
+
+    def run_one(policy, batch, retries):
+        for attempt in range(retries + 1):
+            rec = {"config": f"remat={policy},batch={batch}", "attempt": attempt}
             try:
                 r = subprocess.run([sys.executable, os.path.abspath(__file__),
                                     "--engine-1p5b", policy, str(batch)],
                                    capture_output=True, text=True, timeout=1500)
             except subprocess.TimeoutExpired:
-                sys.stderr.write(f"[bench] engine 1.5B ({policy}, B={batch}) timed out\n")
-                break
+                # a tunnel stall is transient — retry like any relay hiccup rather
+                # than zeroing the headline on one slow attempt
+                rec["outcome"] = "timeout"
+                attempts.append(rec)
+                sys.stderr.write(f"[bench] engine 1.5B ({policy}, B={batch}) timed out"
+                                 f"{' (retrying)' if attempt < retries else ''}\n")
+                continue
             for line in r.stdout.splitlines():
                 if line.startswith("ENGINE_OK "):
                     _, tps, mfu = line.split()
-                    return float(tps), float(mfu), f"remat={policy},batch={batch}"
-            # relay hiccups are retryable; resource exhaustion is deterministic even
-            # when it surfaces through the remote-compile path (HTTP 500 can be a
-            # real scoped-VMEM/SMEM overflow — never retry those)
+                    rec["outcome"] = "ok"
+                    attempts.append(rec)
+                    return float(tps), float(mfu)
             deterministic = any(sig in r.stderr for sig in
                                 ("RESOURCE_EXHAUSTED", "Ran out of memory",
                                  "exceeded scoped"))
             transient = not deterministic and any(
                 sig in r.stderr for sig in
                 ("response body", "remote_compile", "HTTP 500"))
+            rec["outcome"] = "transient" if transient else "failed"
+            rec["stderr_tail"] = r.stderr.splitlines()[-3:]
+            attempts.append(rec)
             sys.stderr.write(f"[bench] engine 1.5B ({policy}, B={batch}) failed"
-                             f"{' (transient, retrying)' if transient and attempt == 0 else ''}:\n"
+                             f"{' (transient, retrying)' if transient and attempt < retries else ''}:\n"
                              + "\n".join(r.stderr.splitlines()[-3:]) + "\n")
-            if not (transient and attempt == 0):
-                break
-    return 0.0, 0.0, "failed"
+            if not transient:
+                return None
+        return None
+
+    policy, batch = PINNED_ENGINE_CONFIG
+    got = run_one(policy, batch, retries=2)
+    if got is not None:
+        return {"tps": got[0], "mfu": got[1],
+                "config": f"remat={policy},batch={batch}", "attempts": attempts}
+    sys.stderr.write("[bench] PINNED engine 1.5B config failed — headline engine "
+                     "metric will read 0.0 (fallbacks reported separately)\n")
+    out = {"tps": 0.0, "mfu": 0.0, "config": f"remat={policy},batch={batch}",
+           "pinned_config_failed": True, "attempts": attempts}
+    for fb_policy, fb_batch in (("attn", 4), ("full", 4)):
+        fb = run_one(fb_policy, fb_batch, retries=1)
+        if fb is not None:
+            out["fallback"] = {"tps": fb[0], "mfu": fb[1],
+                               "config": f"remat={fb_policy},batch={fb_batch}"}
+            break
+    return out
 
 
 def bench_offload_step_timing():
@@ -517,11 +557,17 @@ def main():
                                      "optimizer-shard update (one v5e-32 ZeRO-2 rank's "
                                      "per-chip work; cross-chip collectives excluded)")})
     # the same metric measured THROUGH DeepSpeedEngine (jitted engine paths +
-    # donated-buffer update; full dp=1 fp32 master is the engine's extra burden)
-    e_tps, e_mfu, e_cfg = _engine_1p5b_subprocess()
-    extra.update({"gpt2_1p5b_engine_tokens_per_sec": round(e_tps, 1),
-                  "gpt2_1p5b_engine_mfu": round(e_mfu, 4),
-                  "gpt2_1p5b_engine_config": e_cfg})
+    # donated-buffer update; the external-master shard optimizer keeps the dp=1
+    # fp32 master off-HBM, matching a real rank's 1/32 footprint)
+    e = _engine_1p5b_subprocess()
+    extra.update({"gpt2_1p5b_engine_tokens_per_sec": round(e["tps"], 1),
+                  "gpt2_1p5b_engine_mfu": round(e["mfu"], 4),
+                  "gpt2_1p5b_engine_config": e["config"],
+                  "gpt2_1p5b_engine_attempts": e["attempts"]})
+    if e.get("pinned_config_failed"):
+        extra["gpt2_1p5b_engine_pinned_config_failed"] = True
+        if "fallback" in e:
+            extra["gpt2_1p5b_engine_fallback"] = e["fallback"]
     try:
         extra["offload_step_timing"] = bench_offload_step_timing()
     except Exception as e:

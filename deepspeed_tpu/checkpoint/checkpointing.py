@@ -31,12 +31,23 @@ def _path_key(path) -> str:
                     for p in path)
 
 
+def _needs_allgather(leaf) -> bool:
+    """Whether materializing ``leaf`` requires the collective gather. The decision
+    derives from the sharding's PROCESS SPAN — a globally consistent property —
+    not per-process addressability: an array placed on a subset of processes is
+    fully addressable on its owner but not elsewhere, and an addressability-based
+    rule would have the owner skip the allgather other processes join (deadlock)."""
+    if not isinstance(leaf, jax.Array) or leaf.is_fully_replicated:
+        return False
+    span = {d.process_index for d in leaf.sharding.device_set}
+    return len(span) > 1
+
+
 def _leaf_to_host(leaf) -> np.ndarray:
     """Host copy of a (possibly multi-host sharded) array. Cross-process sharded
     leaves are gathered collectively — EVERY process must call this on the same
     leaves in the same order (save_checkpoint guarantees it)."""
-    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable \
-            and not leaf.is_fully_replicated:
+    if _needs_allgather(leaf):
         from jax.experimental import multihost_utils
         return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
     return np.asarray(jax.device_get(leaf))
@@ -51,8 +62,7 @@ def _flatten_with_paths(tree, materialize: bool = True) -> Dict[str, np.ndarray]
     for path, leaf in leaves_with_paths:
         key = _path_key(path)
         if not materialize:
-            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable \
-                    and not leaf.is_fully_replicated:
+            if _needs_allgather(leaf):
                 _leaf_to_host(leaf)  # collective participation only
             continue
         arr = _leaf_to_host(leaf)
@@ -258,7 +268,11 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
     writer = jax.process_index() == 0
 
     # --- model states (replicated compute params + host-side counters) ---
-    params_flat = _flatten_with_paths(engine.params, materialize=writer)
+    # _ckpt_export: engines with a non-canonical runtime layout (SPMD pipeline's
+    # pipe-stacked stages) serialize in the layer-keyed form so checkpoints stay
+    # portable across stage counts / executor modes
+    params_flat = _flatten_with_paths(engine._ckpt_export(engine.params, "params"),
+                                      materialize=writer)
     if writer:
         np.savez(os.path.join(ckpt_dir, model_states_name() + ".npz"), **params_flat)
     meta = {
@@ -287,8 +301,10 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
     if offload is None:
         # --- optimizer + master states, one file per DP rank (elastic layout) ---
         dp = engine.dp_size
-        master_flat = _flatten_with_paths(engine.master_params, materialize=writer)
-        opt_flat = _flatten_with_paths(engine.opt_state, materialize=writer)
+        master_flat = _flatten_with_paths(engine._ckpt_export(engine.master_params, "master"),
+                                          materialize=writer)
+        opt_flat = _flatten_with_paths(engine._ckpt_export(engine.opt_state, "opt"),
+                                       materialize=writer)
         if writer:
             for dp_rank in range(dp):
                 shard = {}
@@ -350,8 +366,10 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     with open(os.path.join(ckpt_dir, model_states_name() + ".json")) as f:
         meta = json.load(f)
 
-    params = _load_tree_npz(os.path.join(ckpt_dir, model_states_name() + ".npz"), engine.params)
-    engine.params = jax.device_put(params, engine._param_shardings)
+    params = _load_tree_npz(os.path.join(ckpt_dir, model_states_name() + ".npz"),
+                            engine._ckpt_export(engine.params, "params"))
+    engine.params = jax.device_put(engine._ckpt_import(params, "params"),
+                                   engine._param_shardings)
 
     engine.global_steps = meta["global_steps"]
     engine.micro_steps = meta["micro_steps"]
@@ -388,12 +406,15 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                                    _unflatten_like(t, eas_flat, numpy=True))
             else:
                 master_flat, ea_flat, eas_flat = _load_offload_regions(ckpt_dir)
-                master = _unflatten_like(engine.master_params, master_flat)
+                master = _unflatten_like(engine._ckpt_export(engine.master_params, "master"),
+                                         master_flat)
                 opt_flat = {f"exp_avg/{k}": v for k, v in ea_flat.items()}
                 opt_flat.update({f"exp_avg_sq/{k}": v for k, v in eas_flat.items()})
-                opt = _unflatten_like(engine.opt_state, opt_flat)
-                engine.master_params = jax.device_put(master, engine._master_shardings)
-                engine.opt_state = jax.device_put(opt, engine._opt_shardings)
+                opt = _unflatten_like(engine._ckpt_export(engine.opt_state, "opt"), opt_flat)
+                engine.master_params = engine._place_master(
+                    engine._ckpt_import(master, "master"))
+                engine.opt_state = jax.device_put(
+                    engine._ckpt_import(opt, "opt"), engine._opt_shardings)
         else:
             merged = _merge_elastic(ckpt_dir)
             master_flat = {k[len("master/"):]: v for k, v in merged.items() if k.startswith("master/")}
@@ -401,6 +422,7 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             if hasattr(engine, "_onebit") and meta["dp_world_size"] != engine.dp_size:
                 # OneBitAdam state sizes are dp-dependent (padded moments, per-worker
                 # error buffers); adapt them instead of failing the reshape below.
+                # (1-bit Adam requires replicated params, so no _ckpt_export needed.)
                 opt_flat = engine._onebit.elastic_adapt(opt_flat, _flatten_with_paths(engine.opt_state))
             if offload is not None:
                 # host-tier state: unflatten on the host and copy into the flat offload
@@ -414,18 +436,20 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                                    _unflatten_like(t, ea, numpy=True),
                                    _unflatten_like(t, eas, numpy=True))
             else:
-                master = _unflatten_like(engine.master_params, master_flat)
-                opt = _unflatten_like(engine.opt_state, opt_flat)
-                engine.master_params = jax.device_put(master, engine._master_shardings)
-                engine.opt_state = jax.device_put(opt, engine._opt_shardings)
+                master = _unflatten_like(engine._ckpt_export(engine.master_params, "master"),
+                                         master_flat)
+                opt = _unflatten_like(engine._ckpt_export(engine.opt_state, "opt"), opt_flat)
+                engine.master_params = engine._place_master(
+                    engine._ckpt_import(master, "master"))
+                engine.opt_state = jax.device_put(
+                    engine._ckpt_import(opt, "opt"), engine._opt_shardings)
     else:
         # re-derive master from loaded params (fp16-derived restore, stage2.py:1781-1836)
         if getattr(engine, "_offload", None) is not None:
             engine._offload.load_trees(master_tree=engine.params)
         else:
-            engine.master_params = jax.device_put(
-                jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32), engine.params),
-                engine._master_shardings)
+            engine.master_params = engine._place_master(
+                jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32), engine.params))
 
     logger.info(f"[deepspeed_tpu] loaded checkpoint {tag} from {load_dir} "
                 f"(saved dp={meta['dp_world_size']}, current dp={engine.dp_size})")

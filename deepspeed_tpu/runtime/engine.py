@@ -195,6 +195,18 @@ class DeepSpeedEngine:
         else:
             self.compute_dtype = jnp.float32
 
+        # ---- external-master client optimizers ----
+        # A client (init, apply) pair whose apply carries ``external_master = True``
+        # declares that it OWNS the parameter state it updates (e.g. the bench's
+        # emulated ZeRO-2 rank, whose fp32 shard lives in opt_state and whose param
+        # refresh would come from the missing ranks' all-gather): the engine then
+        # keeps its full fp32 master as HOST-RESIDENT cold storage (numpy — zero
+        # HBM) and does not re-derive compute params after the update. At dp=1 this
+        # removes the 4-bytes/param master burden a real 1/dp rank never carries.
+        client_apply = (optimizer[1] if isinstance(optimizer, tuple)
+                        and len(optimizer) == 2 else None)
+        self._external_master = bool(getattr(client_apply, "external_master", False))
+
         # ---- shardings ----
         zero_stage = self.zero_optimization_stage()
         self._repl = lambda tree: replicated_sharding(self.mesh, tree)
@@ -258,6 +270,22 @@ class DeepSpeedEngine:
                 # stage 2: accumulated grads live reduce-scattered; stage<=1: replicated
                 self._grad_shardings = (zero_sharding(self.mesh, master_fp32, zero_stage)
                                         if zero_stage >= 2 else replicated_sharding(self.mesh, master_fp32))
+        self._zero_sharded_fraction = None
+        if zero_stage >= 1 and self.dp_size > 1:
+            # observability: zero_spec leaves awkward leaves replicated by policy —
+            # surface what fraction of master/optimizer bytes actually sharded
+            # (Adam moments mirror the master layout, so one count covers both)
+            from .zero.sharding import sharding_coverage
+            sharded_b, total_b = sharding_coverage(self._master_shardings, master_fp32)
+            self._zero_sharded_fraction = sharded_b / max(total_b, 1)
+            log_dist(
+                f"ZeRO-{zero_stage}: {sharded_b / 2**20:.1f}/{total_b / 2**20:.1f} MiB "
+                f"({self._zero_sharded_fraction:.1%}) of master+optimizer state sharded "
+                f"over data={self.dp_size}"
+                + ("" if self._zero_sharded_fraction > 0.9 else
+                   " — mostly REPLICATED (no dp-divisible axes / leaves under min_size);"
+                   " per-rank memory will not scale as 1/dp"),
+                ranks=[0])
 
         # ---- ZeRO-Offload: master weights + optimizer state live in host DRAM ----
         # (reference stage2.py:333-349 keeps fp32 master/grads pinned on host and steps
@@ -276,6 +304,9 @@ class DeepSpeedEngine:
             self._offload = DeepSpeedCPUAdam(master_fp32,
                                              adamw=(_offload_name == ADAMW_OPTIMIZER),
                                              shardings=self._master_shardings)
+        elif self._external_master:
+            self.master_params = jax.tree_util.tree_map(
+                lambda p: np.asarray(jax.device_get(p), np.float32), master_fp32)
         else:
             self.master_params = jax.device_put(master_fp32, self._master_shardings)
         self.params = jax.device_put(
@@ -686,7 +717,9 @@ class DeepSpeedEngine:
             in_shardings=(self._grad_shardings,),
             out_shardings=self._grad_shardings))
 
-        def apply_update(master, opt_state, scaler_state, acc_grads, params, step, hyper):
+        def prep_grads(acc_grads, scaler_state):
+            """Shared update prologue (standard + external-master paths): fp16
+            overflow check and unscale, optional predivide, global norm, clip."""
             scale = scaler_state.cur_scale
             overflow = has_inf_or_nan_tree(acc_grads) if fp16 else jnp.zeros((), jnp.bool_)
             if fp16:
@@ -717,6 +750,10 @@ class DeepSpeedEngine:
                 norm = global_norm(grads)
             if clip > 0:
                 grads = clip_grads_by_global_norm(grads, clip, norm=norm)
+            return grads, overflow, norm
+
+        def apply_update(master, opt_state, scaler_state, acc_grads, params, step, hyper):
+            grads, overflow, norm = prep_grads(acc_grads, scaler_state)
 
             def do_update(_):
                 return opt_apply(grads, opt_state, master, step, hyper)
@@ -757,6 +794,37 @@ class DeepSpeedEngine:
             return  # no jitted optimizer update; Adam runs on the host tier
 
         scalar_shard = NamedSharding(self.mesh, P())
+        if self._external_master:
+            # The optimizer owns its parameter state: the update touches only
+            # opt_state (the fp32 master is host cold storage and compute params
+            # are not re-derived — a real ZeRO rank refreshes them from the
+            # all-gather of every rank's updated shard).
+            def apply_update_ext(opt_state, scaler_state, acc_grads, step, hyper):
+                grads, overflow, norm = prep_grads(acc_grads, scaler_state)
+
+                def do_update(_):
+                    _, new_state = opt_apply(grads, opt_state, None, step, hyper)
+                    return new_state
+
+                new_opt = jax.lax.cond(overflow, lambda _: opt_state, do_update,
+                                       operand=None)
+                new_scaler = ls.update(scaler_state, overflow, dynamic=dynamic,
+                                       scale_window=scale_window, min_scale=min_scale,
+                                       hysteresis=hysteresis)
+                return new_opt, new_scaler, overflow, norm
+
+            self._jit_apply_update = jax.jit(
+                apply_update_ext,
+                out_shardings=(self._opt_shardings,
+                               jax.tree_util.tree_map(lambda _: scalar_shard,
+                                                      self.scaler_state),
+                               scalar_shard, scalar_shard),
+                # donate the grad buffer too (the standard path donates arg 3): at
+                # 1.5B the undonated fp32 grad tree would raise peak HBM through
+                # the update by a full param-tree
+                donate_argnums=(0, 2))
+            return
+
         self._jit_apply_update = jax.jit(
             apply_update,
             out_shardings=(self._master_shardings, self._opt_shardings,
@@ -890,6 +958,12 @@ class DeepSpeedEngine:
             return
         hyper = self.optimizer.current_hyper()
         step = jnp.asarray(self.global_steps + 1 - self.skipped_steps, jnp.int32)
+        if self._external_master:
+            (self.opt_state, self.scaler_state, overflow,
+             self._last_grad_norm) = self._jit_apply_update(
+                self.opt_state, self.scaler_state, self._grad_acc, step, hyper)
+            self._finish_step(self.fp16_enabled() and bool(jax.device_get(overflow)))
+            return
         (self.master_params, self.opt_state, self.scaler_state, self.params,
          overflow, self._last_grad_norm) = self._jit_apply_update(
             self.master_params, self.opt_state, self.scaler_state, self._grad_acc,
@@ -992,6 +1066,29 @@ class DeepSpeedEngine:
         log_dist(f"step={step}, skipped={self.skipped_steps}, lr={lr}, mom={mom}", ranks=[0])
 
     # ------------------------------------------------------------------ checkpointing
+    def _ckpt_export(self, tree, kind):
+        """Convert an in-memory state tree to the canonical on-disk representation.
+
+        Identity here. Engines whose runtime layout differs from the layer-keyed
+        checkpoint layout (the SPMD pipeline's pipe-stacked stages) override this so
+        checkpoints stay topology-portable — the reference's layer-keyed pipeline
+        checkpoints reload under a different stage count (pipe/module.py:536-567).
+        ``kind`` is one of {"params", "master", "opt"}."""
+        del kind
+        return tree
+
+    def _ckpt_import(self, tree, kind):
+        """Inverse of ``_ckpt_export``: canonical on-disk tree -> runtime layout."""
+        del kind
+        return tree
+
+    def _place_master(self, tree):
+        """Put a restored master tree where this engine keeps it: device shards
+        normally, host numpy under an external-master optimizer (cold storage)."""
+        if getattr(self, "_external_master", False):
+            return jax.tree_util.tree_map(lambda a: np.asarray(a, np.float32), tree)
+        return jax.device_put(tree, self._master_shardings)
+
     def save_checkpoint(self, save_dir, tag=None, client_state={}, save_latest=True):
         from ..checkpoint.checkpointing import save_checkpoint as _save
         return _save(self, save_dir, tag=tag, client_state=client_state, save_latest=save_latest)

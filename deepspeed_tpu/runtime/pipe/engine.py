@@ -1,8 +1,32 @@
-"""Pipeline engine: executes PipeSchedule instruction streams.
+"""Pipeline engine: SPMD ppermute executor with an instruction-stream fallback.
 
-TPU-native re-design of ``deepspeed/runtime/pipe/engine.py`` (PipelineEngine l.45). The
-instruction vocabulary and 1F1B stream are identical (schedule.py); what changes is the
-execution model:
+TPU-native re-design of ``deepspeed/runtime/pipe/engine.py`` (PipelineEngine l.45).
+``deepspeed.initialize(model=PipelineModule)`` — the reference's production multi-GPU
+pipelining entry point (deepspeed/__init__.py:111-133) — routes onto ONE of two
+executors:
+
+1. **SPMD mode** (default when eligible): homogeneous stages (the layout
+   ``partition_balanced`` yields for transformer stacks — an optional stage-0 prefix
+   like an embedding, S identical core blocks, an optional last-stage suffix like a
+   head) lower onto ``parallel/pipeline_spmd.py``: core stage params are STACKED on a
+   leading axis sharded over the ``pipe`` mesh axis, micro-batches stream through a
+   ``lax.scan`` whose stage→stage hand-off is a single ``lax.ppermute`` riding ICI,
+   and the whole 1F1B-equivalent window compiles into ONE jitted train step (XLA
+   derives the backward pipeline — see pipeline_spmd.py). This is the path that runs
+   the pipe axis of a real multi-chip mesh; the base engine supplies fp16/ZeRO/
+   monitoring unchanged (the accumulation window folds into the scan, so the base
+   sees ``gradient_accumulation_steps == 1``).
+2. **Instruction mode** (fallback / ``{"pipeline": {"spmd": false}}``): the
+   single-controller executor below, which interprets the reference's exact
+   instruction vocabulary and 1F1B stream (schedule.py) with jitted per-stage
+   forwards/backwards — the debug/heterogeneous-stage path, parity-tested against
+   the schedule semantics.
+
+Checkpoints are layer-keyed in BOTH modes (the SPMD stacking is undone on save via
+``_ckpt_export``), so stage boundaries and executor modes can change between save
+and load exactly like the reference (pipe/module.py:536-567).
+
+Instruction-mode execution model vs the reference:
 
 - The reference runs one process per stage, eager autograd per micro-batch, and blocking
   p2p broadcasts (pipe/p2p.py). Here a single controller executes every stage's stream
@@ -30,11 +54,51 @@ from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ...parallel.pipe.module import PipelineModule, TiedLayerSpec
+from ...parallel.mesh import DATA_AXIS, PIPE_AXIS, build_mesh
+from ...parallel.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec
+from ...parallel.pipeline_spmd import pipeline_apply
 from ...utils import log_dist, logger
 from ..engine import DeepSpeedEngine
 from . import schedule
+
+# params-dict key holding the pipe-stacked core stage parameters in SPMD mode
+# (namespaced so it can never collide with canonical 'layer_N' / 'tied::' keys)
+STACKED_KEY = "pipe_stages::stacked"
+
+
+def _raw_config_dict(args, config_params):
+    """The raw JSON config dict before DeepSpeedConfig exists — the SPMD routing
+    decision must happen before super().__init__ parses the config."""
+    if isinstance(config_params, dict):
+        return config_params
+    path = getattr(args, "deepspeed_config", None) if args is not None else None
+    if path:
+        try:
+            import json
+            with open(path) as f:
+                return json.load(f)
+        except Exception:
+            return {}
+    return {}
+
+
+def _spec_signature(spec):
+    """Comparable identity of a layer spec for stage-homogeneity checks. None marks
+    a spec that cannot be proven identical across stages (tied layers — their shared
+    storage cannot stack — or specs whose constructor args defeat comparison)."""
+    if isinstance(spec, TiedLayerSpec):
+        return None
+    if isinstance(spec, LayerSpec):
+        try:
+            return ("spec", id(spec.typename), repr(spec))
+        except Exception:
+            return None
+    if callable(spec):
+        return ("callable", id(spec))
+    return None
 
 
 
@@ -67,18 +131,82 @@ class PipelineEngine(DeepSpeedEngine):
         canonical, layer_keys = self._canonicalize_params(model, model_parameters)
         self._layer_keys = layer_keys
 
-        super().__init__(args=args, model=self._whole_model_fn, optimizer=optimizer,
-                         model_parameters=canonical, training_data=training_data,
-                         lr_scheduler=lr_scheduler, mpu=None, dist_init_required=dist_init_required,
-                         collate_fn=collate_fn, config_params=config_params, mesh=mesh)
+        # ---- executor selection (SPMD ppermute path vs instruction fallback) ----
+        self._spmd = False
+        self._spmd_decomp = None
+        raw_cfg = _raw_config_dict(args, config_params)
+        spmd_opt = (raw_cfg.get("pipeline") or {}).get("spmd", "auto")
+        opt_name = str(((raw_cfg.get("optimizer") or {}).get("type") or "")).lower()
+        has_param_groups = bool(((raw_cfg.get("optimizer") or {}).get("params") or {})
+                                .get("param_groups"))
+        n_dev = (int(np.prod(list(mesh.shape.values()))) if mesh is not None
+                 else len(jax.devices()))
+        eligible = (spmd_opt in (True, "auto")
+                    and self.num_stages > 1
+                    and model.loss_fn is not None
+                    and n_dev % self.num_stages == 0
+                    # 1-bit Adam needs replicated params; param-group regex patterns
+                    # are written against canonical layer paths
+                    and opt_name != "onebitadam"
+                    and not has_param_groups
+                    and (mesh is None or mesh.shape.get(PIPE_AXIS, 1) == self.num_stages))
+        if eligible:
+            self._spmd_decomp = self._find_spmd_decomposition(model, layer_keys, canonical)
+            if self._spmd_decomp is None and spmd_opt is True:
+                raise ValueError(
+                    "pipeline.spmd=true but the stage partition is not homogeneous "
+                    f"(parts={model.parts}): the SPMD executor needs S identical core "
+                    "blocks (plus optional stage-0 prefix / last-stage suffix)")
+
+        if self._spmd_decomp is not None:
+            self._spmd = True
+            if mesh is None:
+                mesh = build_mesh(pipe=self.num_stages)
+            spmd_params = self._canonical_to_spmd(canonical)
+            shardings = self._spmd_shardings(mesh, spmd_params)
+            model_fn = self._build_spmd_model_fn(mesh)
+            super().__init__(args=args, model=model_fn, optimizer=optimizer,
+                             model_parameters=spmd_params, training_data=training_data,
+                             lr_scheduler=lr_scheduler, mpu=None,
+                             dist_init_required=dist_init_required, collate_fn=collate_fn,
+                             config_params=config_params, mesh=mesh,
+                             param_shardings=shardings)
+            self._spmd_treedef = jax.tree_util.tree_structure(self.master_params)
+            # the canonical dict built above has exactly the round-trip structure —
+            # no need to materialize an unstack just for its treedef
+            self._canonical_treedef = jax.tree_util.tree_structure(canonical)
+        else:
+            super().__init__(args=args, model=self._whole_model_fn, optimizer=optimizer,
+                             model_parameters=canonical, training_data=training_data,
+                             lr_scheduler=lr_scheduler, mpu=None,
+                             dist_init_required=dist_init_required,
+                             collate_fn=collate_fn, config_params=config_params, mesh=mesh)
         assert self._offload is None, \
             "cpu_offload is not supported with pipeline parallelism (the pipeline " \
             "optimizer step runs on device; reference pairs offload with plain ZeRO-2 only)"
 
-        self.micro_batches = self.gradient_accumulation_steps()
-        self._compile_stage_fns()
+        # the REAL accumulation window (SPMD mode reports 1 to the base engine — the
+        # window folds into the jitted scan; see gradient_accumulation_steps)
+        self.micro_batches = self.config.gradient_accumulation_steps
+        if not self._spmd:
+            self._compile_stage_fns()
         self.agg_train_loss = None
-        log_dist(f"PipelineEngine: {self.num_stages} stages, parts={model.parts}", ranks=[0])
+        d = self._spmd_decomp
+        log_dist(
+            f"PipelineEngine[{'SPMD' if self._spmd else 'instruction'}]: "
+            f"{self.num_stages} stages, parts={model.parts}"
+            + (f", core={d['L']} layers/stage, prefix={len(d['prefix'])}, "
+               f"suffix={len(d['suffix'])}, mesh={dict(self.mesh.shape)}"
+               if self._spmd else ""),
+            ranks=[0])
+
+    def gradient_accumulation_steps(self):
+        # SPMD mode folds the whole micro-batch window into ONE jitted call (the
+        # scan inside pipeline_apply): the base engine sees a window of 1 so each
+        # train_batch is exactly one forward/backward/step.
+        if getattr(self, "_spmd", False):
+            return 1
+        return super().gradient_accumulation_steps()
 
     # ------------------------------------------------------------- params
     def _canonicalize_params(self, module: PipelineModule, model_parameters):
@@ -101,6 +229,197 @@ class PipelineEngine(DeepSpeedEngine):
             layer_keys.append(key)
         return canonical, layer_keys
 
+    # ------------------------------------------------------------- SPMD executor
+    def _find_spmd_decomposition(self, module, layer_keys, canonical):
+        """Homogeneity detection: can the stage partition be expressed as
+        ``[prefix] + S x (identical core block stack) + [suffix]``?
+
+        Returns ``{"starts": per-stage core start index, "L": core length,
+        "prefix": stage-0-only layer indices, "suffix": last-stage-only indices}``
+        or None when the partition is heterogeneous (→ instruction fallback).
+        Matching is by layer-spec identity (same class + constructor args) AND
+        param-tree structure/shape/dtype at every core position, so stacking over
+        the pipe axis is guaranteed well-formed."""
+        S = module.num_stages
+        parts = module.parts
+        counts = [parts[s + 1] - parts[s] for s in range(S)]
+        sigs = [_spec_signature(spec) for spec in module._layer_specs]
+
+        def try_core(L):
+            if counts[0] < L or counts[-1] < L:
+                return None
+            if any(counts[s] != L for s in range(1, S - 1)):
+                return None
+            starts = [parts[1] - L] + [parts[s] for s in range(1, S)]
+            pattern = sigs[starts[0]:starts[0] + L]
+            if any(p is None for p in pattern):
+                return None
+            for s in range(1, S):
+                if sigs[starts[s]:starts[s] + L] != pattern:
+                    return None
+            for j in range(L):
+                keys = [layer_keys[starts[s] + j] for s in range(S)]
+                if any((k is None) != (keys[0] is None) for k in keys):
+                    return None
+                if keys[0] is None:
+                    continue
+                trees = [canonical[k] for k in keys]
+                t0 = jax.tree_util.tree_structure(trees[0])
+                leaves0 = jax.tree_util.tree_leaves(trees[0])
+                for t in trees[1:]:
+                    if jax.tree_util.tree_structure(t) != t0:
+                        return None
+                    for a, b in zip(leaves0, jax.tree_util.tree_leaves(t)):
+                        if a.shape != b.shape or a.dtype != b.dtype:
+                            return None
+            return starts
+
+        if S > 2:
+            candidates = [counts[1]]  # middle stages fix the core length
+        else:
+            candidates = range(min(counts), 0, -1)  # S=2: maximal core first
+        for L in candidates:
+            starts = try_core(L)
+            if starts is not None:
+                return {"starts": starts, "L": L,
+                        "prefix": list(range(0, parts[1] - L)),
+                        "suffix": list(range(parts[S - 1] + L, parts[S]))}
+        return None
+
+    def _canonical_to_spmd(self, canonical):
+        """Layer-keyed dict -> SPMD layout: core stage params stack on a leading
+        S axis (one entry under STACKED_KEY); prefix/suffix keep canonical keys."""
+        d = self._spmd_decomp
+        S, L, starts = self.num_stages, d["L"], d["starts"]
+        out = {}
+        for idx in d["prefix"] + d["suffix"]:
+            k = self._layer_keys[idx]
+            if k is not None:
+                out[k] = canonical[k]
+        stacked = []
+        for j in range(L):
+            if self._layer_keys[starts[0] + j] is None:
+                stacked.append(None)
+                continue
+            per_stage = [canonical[self._layer_keys[starts[s] + j]] for s in range(S)]
+            stacked.append(jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage))
+        out[STACKED_KEY] = tuple(stacked)
+        return out
+
+    def _spmd_to_canonical(self, spmd):
+        """Inverse of _canonical_to_spmd (works on any tree with the params
+        structure — Adam moments included)."""
+        d = self._spmd_decomp
+        S, starts = self.num_stages, d["starts"]
+        out = {k: v for k, v in spmd.items() if k != STACKED_KEY}
+        for j, ent in enumerate(spmd[STACKED_KEY]):
+            if ent is None:
+                continue
+            for s in range(S):
+                out[self._layer_keys[starts[s] + j]] = jax.tree_util.tree_map(
+                    lambda a, s=s: a[s], ent)
+        return out
+
+    def _spmd_shardings(self, mesh, spmd_params):
+        """Core stacks shard their leading (stage) axis over ``pipe``; prefix/suffix
+        params replicate (ZeRO composes on top via merge_zero_into)."""
+        repl = NamedSharding(mesh, P())
+
+        def leaf(a):
+            return NamedSharding(mesh, P(*([PIPE_AXIS] + [None] * (a.ndim - 1))))
+
+        out = {k: jax.tree_util.tree_map(lambda _: repl, v)
+               for k, v in spmd_params.items() if k != STACKED_KEY}
+        out[STACKED_KEY] = jax.tree_util.tree_map(leaf, spmd_params[STACKED_KEY])
+        return out
+
+    def _build_spmd_model_fn(self, mesh):
+        """``(params, x_microbatches, labels_microbatches) -> mean loss`` through the
+        ppermute pipeline. The prefix runs as pipeline_apply's first_stage_fn, the
+        suffix + loss as its last_stage_fn; both draw their params from the SAME
+        params dict the core stack lives in, so tied prefix/suffix layers (shared
+        canonical entry) get their gradient contributions summed by autodiff."""
+        d = self._spmd_decomp
+        layers = self.pipe_module._built_layers
+        keys = self._layer_keys
+        core_idx0 = [d["starts"][0] + j for j in range(d["L"])]
+        core_keys = [keys[i] for i in core_idx0]
+        prefix, suffix = d["prefix"], d["suffix"]
+        pkeys = list(dict.fromkeys(k for i in prefix
+                                   if (k := keys[i]) is not None))
+        skeys = list(dict.fromkeys(k for i in suffix
+                                   if (k := keys[i]) is not None))
+        loss_fn = self.pipe_module.loss_fn
+        apply_layer = self._apply_layer
+
+        def stage_body(stage_params, x):
+            for j, idx in enumerate(core_idx0):
+                x = (layers[idx](x) if core_keys[j] is None
+                     else layers[idx].apply(stage_params[j], x))
+            return x
+
+        # remat the stage body: backward recomputes the stage forward per scan step,
+        # the same memory/compute trade the instruction executor's jitted VJPs make
+        stage_fn = jax.checkpoint(stage_body)
+
+        first_fn = None
+        if prefix:
+            def first_fn(x, *pvals):
+                env = dict(zip(pkeys, pvals))
+                for idx in prefix:
+                    x = apply_layer(idx, env, x)
+                return x
+
+        def last_fn(y, labels_all, *rest):
+            svals, mb = rest[:-1], rest[-1]
+            env = dict(zip(skeys, svals))
+            for idx in suffix:
+                y = apply_layer(idx, env, y)
+            return loss_fn(y, labels_all[mb])
+
+        def model_fn(params, x_mb, labels_mb):
+            last_args = (labels_mb,) + tuple(params[k] for k in skeys)
+            lspecs = ((P(*([None, DATA_AXIS] + [None] * (labels_mb.ndim - 2))),)
+                      + tuple(P() for _ in skeys))
+            return pipeline_apply(
+                stage_fn, params[STACKED_KEY], x_mb, mesh=mesh,
+                last_stage_fn=last_fn, last_stage_args=last_args,
+                first_stage_fn=first_fn,
+                first_stage_args=tuple(params[k] for k in pkeys),
+                last_stage_args_specs=lspecs,
+                first_stage_args_specs=tuple(P() for _ in pkeys))
+
+        return model_fn
+
+    # canonical (layer-keyed) <-> runtime layout for checkpoints; reference parity:
+    # pipeline checkpoints reload under a different stage count (module.py:536-567)
+    def _map_opt(self, opt, fn, params_treedef):
+        def conv(field):
+            return (fn(field)
+                    if jax.tree_util.tree_structure(field) == params_treedef else field)
+        if hasattr(opt, "_fields"):
+            return type(opt)(*[conv(f) for f in opt])
+        return conv(opt)
+
+    def _ckpt_export(self, tree, kind):
+        if not self._spmd:
+            return tree
+        if kind == "opt":
+            return self._map_opt(tree, self._spmd_to_canonical, self._spmd_treedef)
+        return self._spmd_to_canonical(tree)
+
+    def _ckpt_import(self, tree, kind):
+        if not self._spmd:
+            return tree
+        if kind == "opt":
+            return self._map_opt(tree, self._canonical_to_spmd, self._canonical_treedef)
+        return self._canonical_to_spmd(tree)
+
+    def canonical_master_params(self):
+        """fp32 master params keyed by layer (the checkpoint representation)
+        regardless of executor mode — SPMD mode stores core stages pipe-stacked."""
+        return self._ckpt_export(self.master_params, "master")
+
     def _apply_layer(self, idx: int, params, x):
         layer = self.pipe_module._built_layers[idx]
         key = self._layer_keys[idx]
@@ -113,7 +432,10 @@ class PipelineEngine(DeepSpeedEngine):
         return layer.apply(params[key], x)
 
     def _whole_model_fn(self, params, *batch):
-        """Sequential full-model apply (eval path / reference semantics)."""
+        """Sequential full-model apply (eval path / reference semantics; accepts
+        either the canonical or the SPMD params layout)."""
+        if getattr(self, "_spmd", False) and STACKED_KEY in params:
+            params = self._spmd_to_canonical(params)
         x = batch[0]
         for idx in range(self.pipe_module.num_layers()):
             x = self._apply_layer(idx, params, x)
@@ -207,9 +529,41 @@ class PipelineEngine(DeepSpeedEngine):
             return tuple(self.shard_batch(b) for b in batch)
         return (self.shard_batch(batch),)
 
+    def _stack_window(self, data_iter):
+        """Pull the accumulation window's micro-batches and stack them on a leading
+        M axis, sharded over ``data`` on the batch dim (dim 1) — the layout
+        pipeline_apply streams through the scan."""
+        xs, ys = [], []
+        for _ in range(self.micro_batches):
+            batch = next(data_iter)
+            if not (isinstance(batch, (tuple, list)) and len(batch) >= 2):
+                raise PipelineError(
+                    "SPMD pipeline mode expects (inputs, labels) batches; pass "
+                    '{"pipeline": {"spmd": false}} for the instruction executor')
+            xs.append(np.asarray(batch[0]))
+            ys.append(np.asarray(batch[1]))
+
+        def put(a):
+            spec = P(*([None, DATA_AXIS] + [None] * (a.ndim - 2)))
+            return jax.device_put(a, NamedSharding(self.mesh, spec))
+
+        return put(np.stack(xs)), put(np.stack(ys))
+
+    def _train_batch_spmd(self, data_iter):
+        """One optimizer step: the ENTIRE micro-batch window runs inside one jitted
+        forward/backward (scan + ppermute over the pipe axis of the mesh); the base
+        engine's fp16/ZeRO/monitoring machinery applies unchanged."""
+        x, y = self._stack_window(data_iter)
+        loss = DeepSpeedEngine.forward(self, x, y)
+        DeepSpeedEngine.backward(self, loss)
+        DeepSpeedEngine.step(self)
+        self.agg_train_loss = loss
+        return loss
+
     def train_batch(self, data_iter=None):
-        """Run one full 1F1B schedule over gradient_accumulation_steps micro-batches
-        (reference pipe/engine.py:229-303)."""
+        """Run one full micro-batch window to an optimizer step (reference
+        pipe/engine.py:229-303): the SPMD scan executor when routed there, else the
+        1F1B instruction stream."""
         if data_iter is None:
             if self.training_dataloader is None:
                 raise PipelineError("train_batch() requires a data iterator or training_data")
@@ -217,6 +571,8 @@ class PipelineEngine(DeepSpeedEngine):
                 from ..dataloader import RepeatingLoader
                 self._repeating_iter = iter(RepeatingLoader(self.training_dataloader))
             data_iter = self._repeating_iter
+        if self._spmd:
+            return self._train_batch_spmd(data_iter)
 
         mb = self.micro_batches
         S = self.num_stages
@@ -398,7 +754,11 @@ class PipelineEngine(DeepSpeedEngine):
         """Forward-only evaluation executing the InferenceSchedule instruction stream
         through the per-stage jitted forwards (reference pipe/engine.py:305-372 runs
         InferenceSchedule through _exec_schedule; the two-buffer ring and the even/odd
-        send/recv ordering of schedule.InferenceSchedule are preserved)."""
+        send/recv ordering of schedule.InferenceSchedule are preserved). SPMD mode
+        evaluates the same jitted pipeline forward loss-only."""
+        if self._spmd:
+            x, y = self._stack_window(data_iter)
+            return self._jit_eval(self.params, x, y)
         mb = self.micro_batches
         S = self.num_stages
         scheds = [schedule.InferenceSchedule(micro_batches=mb, stages=S, stage_id=s)

@@ -58,6 +58,23 @@ def zero_sharding(mesh: Mesh, tree, stage: int, min_size: int = 1024):
     return jax.tree_util.tree_map(leaf, tree)
 
 
+def sharding_coverage(shardings_tree, tree):
+    """(sharded_bytes, total_bytes) over the tree — how much state the ZeRO layout
+    actually partitioned vs left replicated. zero_spec legitimately leaves a leaf
+    replicated (no dp-divisible axis, or under min_size), but a user at dp=32 with
+    awkward shapes could believe they run ZeRO-2 while most state is replicated;
+    the engine logs this at construction and tests pin >90% for flagship configs."""
+    import jax
+    total = sharded = 0
+    for sh, a in zip(jax.tree_util.tree_leaves(shardings_tree),
+                     jax.tree_util.tree_leaves(tree)):
+        nbytes = int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+        total += nbytes
+        if not sh.is_fully_replicated:
+            sharded += nbytes
+    return sharded, total
+
+
 def replicated_sharding(mesh: Mesh, tree):
     import jax
     return jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), tree)

@@ -1,0 +1,62 @@
+"""Optimized-HLO collective inspection.
+
+The framework's multi-chip claims are of the form "XLA emits the collective the
+reference called NCCL/MPI for" (zero/sharding.py, pipeline_spmd.py, ring_attention.py,
+custom_collectives.py). This module is the shared audit surface for that claim: it
+parses a compiled program's text for collective instructions so tests
+(tests/unit/test_collectives_hlo.py), the driver dry-run (__graft_entry__.py), and
+users debugging shardings can count them and account wire bytes from ONE parser.
+"""
+
+import re
+from collections import Counter
+
+COLLECTIVE_OPS = ("all-to-all", "all-gather", "all-reduce", "reduce-scatter",
+                  "collective-permute")
+
+# `%name = TYPE op(...)` where TYPE is a shaped type or a tuple of them
+# (all-to-all returns a tuple). Matches the -start variants' base names too.
+_OP_RE = re.compile(r"= (\([^)]*\)|\S+) (" + "|".join(COLLECTIVE_OPS) + r")\(")
+
+_DTYPE_BYTES = {"s8": 1, "u8": 1, "pred": 1, "s16": 2, "u16": 2, "bf16": 2,
+                "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+                "f64": 8}
+
+
+def optimized_hlo(jitted, *args):
+    """Optimized (post-SPMD-partitioner) HLO text of ``jitted`` on ``args``."""
+    return jitted.lower(*args).compile().as_text()
+
+
+def collective_counts(hlo_text):
+    """{collective op name -> instruction count} over the optimized HLO."""
+    counts = Counter()
+    for _result_ty, op in _OP_RE.findall(hlo_text):
+        counts[op] += 1
+    return dict(counts)
+
+
+def collective_result_types(hlo_text, op):
+    """Element-type strings of every ``op`` instruction's results (tuples flattened)."""
+    out = []
+    for result_ty, found in _OP_RE.findall(hlo_text):
+        if found == op:
+            out.extend(re.findall(r"([a-z0-9]+)\[", result_ty))
+    return out
+
+
+def collective_bytes(hlo_text):
+    """Approximate per-device collective wire bytes: for each collective
+    instruction, bytes = result size (what each participant receives). The basis
+    for the 1-bit Adam comm-volume accounting in PERF.md."""
+    total = 0
+    for result_ty, _op in _OP_RE.findall(hlo_text):
+        for dt, dims in re.findall(r"([a-z0-9]+)\[([0-9,]*)\]", result_ty):
+            if dt not in _DTYPE_BYTES:
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            total += n * _DTYPE_BYTES[dt]
+    return total

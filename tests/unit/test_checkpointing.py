@@ -228,7 +228,10 @@ def test_checkpoint_pipe_topology_change(tmp_path):
         engine2 = build(num_stages=new_stages)
         path, _ = engine2.load_checkpoint(str(tmp_path))
         assert path is not None, f"reload at {new_stages} stages failed"
-        trees_equal(engine.master_params, engine2.master_params)
+        # compare in the canonical layer-keyed representation: the SPMD executor
+        # stores core stages pipe-stacked, and stage counts differ across engines
+        trees_equal(engine.canonical_master_params(),
+                    engine2.canonical_master_params())
         # training continues identically after the re-partition
         e1_it, e2_it = data_iter(), data_iter()
         l1 = float(jax.device_get(engine.eval_batch(e1_it)))

@@ -1,0 +1,182 @@
+"""Compiled-collective audit (VERDICT r3 #3).
+
+The design stance throughout the framework is "XLA emits the collective the reference
+called NCCL/MPI for" (zero/sharding.py vs stage2.py:682-745,1441-1472; pipeline_spmd /
+ring_attention vs p2p.py; custom_collectives.py vs the MPI compressed allreduce).
+On the one axis this environment cannot run for real — multi-chip — compiled-program
+inspection is the available proxy: these tests lower the flagship multi-device
+programs on the virtual 8-device mesh and assert the expected collective ops appear
+in the optimized HLO, failing on regression.
+
+Backend note: XLA's CPU pipeline does not run the all-reduce+dynamic-slice →
+reduce-scatter rewrite the TPU pipeline applies, so ZeRO's gradient scatter shows up
+as ``all-reduce`` + sharded outputs here; the assertion therefore checks BOTH the
+reduction collective and the scattered output sharding (which is what forces the
+TPU partitioner to emit reduce-scatter).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel.mesh import DATA_AXIS, build_mesh
+from deepspeed_tpu.utils.hlo import (collective_bytes, collective_counts,
+                                     collective_result_types,
+                                     optimized_hlo as optimized_text)
+
+from simple_model import SimpleModel, simple_config
+
+
+# --------------------------------------------------------------------------- ZeRO-2
+def test_zero2_train_step_reduces_and_scatters_grads():
+    """ZeRO-2: the grad path must cross the data axis with a reduction collective and
+    STORE grads scattered (per-rank partitions — reference stage2.py:682-745), and
+    the update must all-gather the new params (stage2.py:1441-1472)."""
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    model = SimpleModel(64)
+    eng = DeepSpeedEngine(model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+                          config_params=simple_config(batch=8,
+                                                      zero_optimization={"stage": 2}))
+    x = jnp.ones((8, 64))
+    y = jnp.ones((8, 64))
+    txt = optimized_text(eng._jit_loss_and_grad, eng.params,
+                         eng.scaler_state.cur_scale, x, y)
+    counts = collective_counts(txt)
+    assert counts.get("reduce-scatter", 0) + counts.get("all-reduce", 0) >= 1, \
+        f"no cross-data grad reduction in the ZeRO-2 backward: {counts}"
+    # grads leave the jit scattered over 'data' (this sharding is what makes the TPU
+    # partitioner emit reduce-scatter instead of all-reduce)
+    scattered = sum(not s.is_fully_replicated
+                    for s in jax.tree_util.tree_leaves(eng._grad_shardings))
+    assert scattered >= 2, "ZeRO-2 grad shardings are not scattered"
+
+    # optimizer update: scattered master -> replicated compute params needs all-gather
+    grads = jax.device_put(
+        jax.tree_util.tree_map(lambda a: jnp.zeros(a.shape, eng._acc_dtype),
+                               eng.master_params),
+        eng._grad_shardings)
+    step = jnp.asarray(1, jnp.int32)
+    txt2 = optimized_text(eng._jit_apply_update, eng.master_params, eng.opt_state,
+                          eng.scaler_state, grads, eng.params, step,
+                          eng.optimizer.current_hyper())
+    counts2 = collective_counts(txt2)
+    assert counts2.get("all-gather", 0) >= 1, \
+        f"no all-gather re-materializing params from ZeRO partitions: {counts2}"
+
+
+# --------------------------------------------------------------------------- ring
+def test_ring_attention_emits_collective_permute():
+    from deepspeed_tpu.parallel.ring_attention import ring_attention_sharded
+
+    mesh = build_mesh(data=8)
+    q = jnp.zeros((1, 2, 256, 32), jnp.float32)
+    j = jax.jit(lambda q, k, v: ring_attention_sharded(q, k, v, mesh, causal=True,
+                                                       interpret=True))
+    txt = optimized_text(j, q, q, q)
+    counts = collective_counts(txt)
+    assert counts.get("collective-permute", 0) >= 7, \
+        f"8-rank ring should rotate k/v via collective-permute: {counts}"
+
+    # the backward ring too: ppermute transposes to the reverse rotation
+    g = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
+        ring_attention_sharded(q, k, v, mesh, interpret=True) ** 2), argnums=(0, 1, 2)))
+    txt_b = optimized_text(g, q, q, q)
+    assert collective_counts(txt_b).get("collective-permute", 0) >= 7
+
+
+# --------------------------------------------------------------------------- pipeline
+def test_public_api_pipeline_train_step_emits_collective_permute():
+    """deepspeed.initialize(model=PipelineModule) routes homogeneous stages onto the
+    SPMD executor: the jitted train step must move activations over the pipe axis
+    with collective-permute (the reference's p2p.send/recv, pipe/p2p.py)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.parallel.pipe import LayerSpec, PipelineModule
+
+    class Linear:
+        def __init__(self, dim):
+            self.dim = dim
+
+        def init(self, rng, x):
+            return {"w": jax.random.normal(rng, (x.shape[-1], self.dim),
+                                           jnp.float32) * 0.3}
+
+        def apply(self, p, x):
+            return jnp.tanh(x @ p["w"].astype(x.dtype))
+
+    def mse(out, tgt):
+        return jnp.mean(jnp.square(out - tgt))
+
+    module = PipelineModule(layers=[LayerSpec(Linear, 16) for _ in range(4)],
+                            num_stages=4, loss_fn=mse)
+    params = module.init_params(jax.random.PRNGKey(0), jnp.zeros((4, 16)))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=module, model_parameters=params,
+        config_params={"train_batch_size": 16, "gradient_accumulation_steps": 4,
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+    assert engine._spmd, "homogeneous 4-stage stack must route onto the SPMD executor"
+    x = jax.device_put(np.zeros((4, 4, 16), np.float32),
+                       NamedSharding(engine.mesh, P(None, DATA_AXIS)))
+    txt = optimized_text(engine._jit_loss_and_grad, engine.params,
+                         engine.scaler_state.cur_scale, x, x)
+    counts = collective_counts(txt)
+    assert counts.get("collective-permute", 0) >= 1, \
+        f"SPMD pipeline train step has no collective-permute: {counts}"
+
+
+# --------------------------------------------------------------------- 1-bit Adam
+def test_compressed_allreduce_ships_int8_on_the_wire():
+    """The compressed allreduce's phase-1 exchange must be an all-to-all whose
+    operand/result element type is s8 — int8 on the ICI wire, fp32 only after
+    receipt (reference custom_collectives.py:23-50 shipped compressed cupy/MPI
+    buffers)."""
+    from deepspeed_tpu.runtime.custom_collectives import compressed_allreduce
+
+    mesh = build_mesh(data=8)
+    n = 8 * 128
+    x = jax.device_put(jnp.ones((8, n), jnp.float32),
+                       NamedSharding(mesh, P(DATA_AXIS, None)))
+    we = jax.device_put(jnp.zeros((8, n), jnp.float32),
+                        NamedSharding(mesh, P(DATA_AXIS, None)))
+    se = jax.device_put(jnp.zeros((8, n // 8), jnp.float32),
+                        NamedSharding(mesh, P(DATA_AXIS, None)))
+    j = jax.jit(lambda x, we, se: compressed_allreduce(mesh, x, we, se))
+    txt = optimized_text(j, x, we, se)
+    counts = collective_counts(txt)
+    assert counts.get("all-to-all", 0) >= 1, f"no all-to-all in phase 1: {counts}"
+    a2a_types = collective_result_types(txt, "all-to-all")
+    assert a2a_types and set(a2a_types) == {"s8"}, \
+        f"phase-1 all-to-all is not int8 on the wire: {a2a_types}"
+    assert counts.get("all-gather", 0) >= 1, f"no phase-2 all-gather: {counts}"
+    # phase-2 payload includes the int8 server signs
+    ag_types = collective_result_types(txt, "all-gather")
+    assert "s8" in ag_types, f"phase-2 all-gather ships no int8 payload: {ag_types}"
+
+
+def test_onebit_comm_volume_vs_fp32_allreduce():
+    """Byte-accounting for the reference's headline '5x less communication'
+    (README.md:18,37): the compressed allreduce's collective bytes per device must
+    be well under the fp32 ring-allreduce equivalent (2 * 4n bytes). We ship int8
+    signs (XLA has no sub-byte wire type), so the design factor is ~4x on the sign
+    payload; scales/metadata cost a little back."""
+    from deepspeed_tpu.runtime.custom_collectives import compressed_allreduce
+
+    mesh = build_mesh(data=8)
+    dp, n = 8, 64 * 1024
+    sh = NamedSharding(mesh, P(DATA_AXIS, None))
+    x = jax.device_put(jnp.ones((dp, n), jnp.float32), sh)
+    we = jax.device_put(jnp.zeros((dp, n), jnp.float32), sh)
+    se = jax.device_put(jnp.zeros((dp, n // dp), jnp.float32), sh)
+    txt = optimized_text(jax.jit(lambda x, we, se: compressed_allreduce(mesh, x, we, se)),
+                         x, we, se)
+    compressed = collective_bytes(txt)
+
+    # fp32 ring allreduce reference: reduce-scatter + all-gather, each (dp-1)/dp * 4n
+    # bytes received per device => ~2 * 4n for large dp
+    fp32_ring = 2 * (dp - 1) / dp * 4 * n
+    ratio = fp32_ring / compressed
+    # int8 signs: 2n bytes total vs 7n fp32 -> expect >= 2.5x with headroom for the
+    # scale vectors and the replicated output gather
+    assert ratio >= 2.5, (compressed, fp32_ring, ratio)
