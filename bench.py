@@ -94,8 +94,8 @@ def _shard_optimizer(dp):
     """Client (init, apply) pair for DeepSpeedEngine doing exactly one v5e-32 ZeRO-2
     rank's optimizer work: Adam over a 1/dp fp32 shard of the gradient stream. The
     apply is marked ``external_master``: the fp32 master shard it owns lives in
-    opt_state, so the engine keeps its dp=1 FULL fp32 master as host cold storage
-    (zero HBM — a real 1/32 rank never holds it) and skips the full-params re-cast
+    opt_state, so the engine holds NO dp=1 full fp32 master at all (zero HBM — a
+    real 1/32 rank never holds it) and skips the full-params re-cast
     (a real rank refreshes params from the 32-way all-gather, which needs the other
     31 chips and is excluded here like every cross-chip collective)."""
     import jax
@@ -131,9 +131,10 @@ def bench_1p5b_engine(remat_policy="dots", batch=8):
     """The 1.5B metric measured THROUGH DeepSpeedEngine: the real jitted
     value_and_grad, grad adoption, apply_update with donated buffers,
     monitor/report path — with the per-rank optimizer work supplied as an
-    external-master client pair (the fp32 shard lives in opt_state; the engine's
-    dp=1 full fp32 master is host cold storage, matching a real 1/32 rank's HBM
-    footprint). The only remaining difference vs a real v5e-32 rank: cross-chip
+    external-master client pair: the fp32 shard lives in opt_state, the engine
+    holds NO dp=1 master at all, and at gas==1 the engine's fused single-jit step
+    keeps the grad tree internal to the program — matching a real 1/32 rank's HBM
+    footprint. The only remaining difference vs a real v5e-32 rank: cross-chip
     collectives are excluded (they need the other 31 chips)."""
     import jax
     import jax.numpy as jnp

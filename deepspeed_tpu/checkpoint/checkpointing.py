@@ -276,6 +276,7 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
     if writer:
         np.savez(os.path.join(ckpt_dir, model_states_name() + ".npz"), **params_flat)
     meta = {
+        "external_master": bool(getattr(engine, "_external_master", False)),
         "global_steps": engine.global_steps,
         "micro_steps": engine.micro_steps,
         "skipped_steps": engine.skipped_steps,
@@ -300,9 +301,15 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
 
     if offload is None:
         # --- optimizer + master states, one file per DP rank (elastic layout) ---
+        # external-master engines hold no master (it is byte-for-byte derivable as
+        # the fp32 upcast of the saved params — writing it would triple the
+        # checkpoint and materialize a full fp32 tree on device for nothing)
         dp = engine.dp_size
-        master_flat = _flatten_with_paths(engine._ckpt_export(engine.master_params, "master"),
-                                          materialize=writer)
+        if getattr(engine, "_external_master", False):
+            master_flat = {}
+        else:
+            master_flat = _flatten_with_paths(
+                engine._ckpt_export(engine.master_params, "master"), materialize=writer)
         opt_flat = _flatten_with_paths(engine._ckpt_export(engine.opt_state, "opt"),
                                        materialize=writer)
         if writer:
@@ -406,13 +413,14 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                                    _unflatten_like(t, eas_flat, numpy=True))
             else:
                 master_flat, ea_flat, eas_flat = _load_offload_regions(ckpt_dir)
-                master = _unflatten_like(engine._ckpt_export(engine.master_params, "master"),
-                                         master_flat)
+                if not getattr(engine, "_external_master", False):
+                    master = _unflatten_like(
+                        engine._ckpt_export(engine.master_params, "master"), master_flat)
+                    engine.master_params = engine._place_master(
+                        engine._ckpt_import(master, "master"))
                 opt_flat = {f"exp_avg/{k}": v for k, v in ea_flat.items()}
                 opt_flat.update({f"exp_avg_sq/{k}": v for k, v in eas_flat.items()})
                 opt = _unflatten_like(engine._ckpt_export(engine.opt_state, "opt"), opt_flat)
-                engine.master_params = engine._place_master(
-                    engine._ckpt_import(master, "master"))
                 engine.opt_state = jax.device_put(
                     engine._ckpt_import(opt, "opt"), engine._opt_shardings)
         else:
@@ -436,11 +444,22 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                                    _unflatten_like(t, ea, numpy=True),
                                    _unflatten_like(t, eas, numpy=True))
             else:
-                master = _unflatten_like(engine._ckpt_export(engine.master_params, "master"),
-                                         master_flat)
+                if getattr(engine, "_external_master", False):
+                    pass  # no master storage; the view re-derives from params
+                elif master_flat:
+                    master = _unflatten_like(
+                        engine._ckpt_export(engine.master_params, "master"), master_flat)
+                    engine.master_params = engine._place_master(
+                        engine._ckpt_import(master, "master"))
+                else:
+                    # an external-master checkpoint loaded into a standard engine:
+                    # the master is BY DEFINITION the fp32 upcast of the restored
+                    # params (that is why it was not written)
+                    engine.master_params = jax.device_put(
+                        jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32),
+                                               engine.params),
+                        engine._master_shardings)
                 opt = _unflatten_like(engine._ckpt_export(engine.opt_state, "opt"), opt_flat)
-                engine.master_params = engine._place_master(
-                    engine._ckpt_import(master, "master"))
                 engine.opt_state = jax.device_put(
                     engine._ckpt_import(opt, "opt"), engine._opt_shardings)
     else:

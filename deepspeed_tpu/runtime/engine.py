@@ -140,6 +140,11 @@ def make_engine(args=None, model=None, optimizer=None, model_parameters=None, tr
                            config_params=config_params)
 
 
+# sentinel marking a fused-step window in the pending-grads / grad-acc slots
+# (the gradient tree never exists outside the fused jit)
+_FUSED = object()
+
+
 class DeepSpeedEngine:
 
     def __init__(self, args=None, model=None, optimizer=None, model_parameters=None,
@@ -200,9 +205,10 @@ class DeepSpeedEngine:
         # declares that it OWNS the parameter state it updates (e.g. the bench's
         # emulated ZeRO-2 rank, whose fp32 shard lives in opt_state and whose param
         # refresh would come from the missing ranks' all-gather): the engine then
-        # keeps its full fp32 master as HOST-RESIDENT cold storage (numpy — zero
-        # HBM) and does not re-derive compute params after the update. At dp=1 this
-        # removes the 4-bytes/param master burden a real 1/dp rank never carries.
+        # holds NO master storage — master_params becomes a derived fp32 view of
+        # the compute params (checkpoint save only) — and does not re-derive
+        # compute params after the update. At dp=1 this removes the 4-bytes/param
+        # master burden a real 1/dp rank never carries.
         client_apply = (optimizer[1] if isinstance(optimizer, tuple)
                         and len(optimizer) == 2 else None)
         self._external_master = bool(getattr(client_apply, "external_master", False))
@@ -305,8 +311,12 @@ class DeepSpeedEngine:
                                              adamw=(_offload_name == ADAMW_OPTIMIZER),
                                              shardings=self._master_shardings)
         elif self._external_master:
-            self.master_params = jax.tree_util.tree_map(
-                lambda p: np.asarray(jax.device_get(p), np.float32), master_fp32)
+            # no engine-held master at all: the optimizer owns parameter state, and
+            # the master_params property derives an fp32 VIEW of the compute params
+            # on access (checkpoint save). Keeping a real copy would either occupy
+            # 4 bytes/param of HBM (the exact dp=1 burden this mode removes) or
+            # require a full-model D2H at construction (minutes over the relay).
+            pass
         else:
             self.master_params = jax.device_put(master_fp32, self._master_shardings)
         self.params = jax.device_put(
@@ -378,10 +388,19 @@ class DeepSpeedEngine:
     def master_params(self):
         if getattr(self, "_offload", None) is not None:
             return self._offload.params_tree()
+        if getattr(self, "_external_master", False):
+            # The optimizer owns parameter state (its fp32 shard lives in
+            # opt_state, checkpointed with it); the engine-level master is a
+            # DERIVED fp32 view of the compute params, materialized on access for
+            # checkpoint save. There is no separate storage to restore into —
+            # the setter is a no-op (a loaded master equals this view upcast).
+            return jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), self.params)
         return self._master_params_store
 
     @master_params.setter
     def master_params(self, value):
+        if getattr(self, "_external_master", False):
+            return
         self._master_params_store = value
 
     @property
@@ -525,10 +544,21 @@ class DeepSpeedEngine:
             self.optimizer = OptimizerHandle(name, self.config.optimizer_params or {},
                                              group_specs=specs)
         init = self._opt_init
-        opt_state_zero = jax.eval_shape(init, self.master_params)
+        if self._external_master:
+            # the master is a derived view (see the master_params property) — never
+            # materialize it here. init sees an ABSTRACT fp32 master for shapes and
+            # a zero master for values: an external-master optimizer owns its own
+            # state, so by contract its init reads master SIZES, not values.
+            abstract_master = jax.tree_util.tree_map(
+                lambda p: jax.ShapeDtypeStruct(p.shape, jnp.float32), self.params)
+            opt_state_zero = jax.eval_shape(init, abstract_master)
+            params_treedef = jax.tree_util.tree_structure(abstract_master)
+        else:
+            abstract_master = None
+            opt_state_zero = jax.eval_shape(init, self.master_params)
+            params_treedef = jax.tree_util.tree_structure(self.master_params)
         # optimizer states mirror the master-param tree (Adam moments, momentum buffers):
         # give each params-shaped field the master sharding so ZeRO/pipe layouts carry over
-        params_treedef = jax.tree_util.tree_structure(self.master_params)
 
         def field_shardings(field):
             if jax.tree_util.tree_structure(field) == params_treedef:
@@ -547,7 +577,17 @@ class DeepSpeedEngine:
             logger.warning("client optimizer state does not mirror the param tree; "
                            "optimizer state will be replicated")
             self._opt_shardings = replicated_sharding(self.mesh, opt_state_zero)
-        self.opt_state = jax.jit(init, out_shardings=self._opt_shardings)(self.master_params)
+        if self._external_master:
+            # init sees the REAL master values (master == params at construction):
+            # the fp32 upcast happens inside the jit, so leaves are freed as init
+            # consumes them (and fold away entirely for size-only inits) — no
+            # resident fp32 master tree is ever created.
+            self.opt_state = jax.jit(
+                lambda p: init(jax.tree_util.tree_map(
+                    lambda x: x.astype(jnp.float32), p)),
+                out_shardings=self._opt_shardings)(self.params)
+        else:
+            self.opt_state = jax.jit(init, out_shardings=self._opt_shardings)(self.master_params)
         log_dist(f"Using DeepSpeed Optimizer param name {self.optimizer.name}", ranks=[0])
 
     def _configure_lr_scheduler(self, client_lr_scheduler):
@@ -570,6 +610,8 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------------ jitted step functions
     def _compile_steps(self):
+        self._jit_fused_step = None   # set on the external-master gas==1 path below
+        self._fused_pending = None
         grad_acc_steps = self.gradient_accumulation_steps()
         fp16 = self.fp16_enabled()
         clip = float(self.gradient_clipping() or 0.0)
@@ -794,11 +836,12 @@ class DeepSpeedEngine:
             return  # no jitted optimizer update; Adam runs on the host tier
 
         scalar_shard = NamedSharding(self.mesh, P())
+        scaler_shards = jax.tree_util.tree_map(lambda _: scalar_shard, self.scaler_state)
         if self._external_master:
             # The optimizer owns its parameter state: the update touches only
-            # opt_state (the fp32 master is host cold storage and compute params
-            # are not re-derived — a real ZeRO rank refreshes them from the
-            # all-gather of every rank's updated shard).
+            # opt_state (there is no engine master, and compute params are not
+            # re-derived — a real ZeRO rank refreshes them from the all-gather of
+            # every rank's updated shard).
             def apply_update_ext(opt_state, scaler_state, acc_grads, step, hyper):
                 grads, overflow, norm = prep_grads(acc_grads, scaler_state)
 
@@ -815,14 +858,44 @@ class DeepSpeedEngine:
 
             self._jit_apply_update = jax.jit(
                 apply_update_ext,
-                out_shardings=(self._opt_shardings,
-                               jax.tree_util.tree_map(lambda _: scalar_shard,
-                                                      self.scaler_state),
+                out_shardings=(self._opt_shardings, scaler_shards,
                                scalar_shard, scalar_shard),
                 # donate the grad buffer too (the standard path donates arg 3): at
                 # 1.5B the undonated fp32 grad tree would raise peak HBM through
                 # the update by a full param-tree
                 donate_argnums=(0, 2))
+
+            # Fused single-jit train step (gas == 1): forward+backward+update in ONE
+            # program, so the full gradient tree never materializes as jit outputs —
+            # XLA frees each grad leaf as soon as the optimizer consumed it. The
+            # two-jit split must hold params + activations + the ENTIRE grad tree
+            # simultaneously, which is exactly the ~1 param-tree of HBM that keeps a
+            # 1.5B dp=1 run off the remat=dots policy (measured: dots@8 OOMs split,
+            # fits fused — the same structure as a hand-rolled one-jit rank step).
+            # Semantics: the update runs at forward() and is COMMITTED at step();
+            # forward/backward/step must rotate strictly (enforced in forward()).
+            if grad_acc_steps == 1 and loss_and_grad is local_loss_and_grad:
+                def fused_step(opt_state, scaler_state, params, step, hyper, *batch):
+                    loss, grads = local_loss_and_grad(params, scaler_state.cur_scale,
+                                                      *batch)
+                    grads, overflow, norm = prep_grads(grads, scaler_state)
+
+                    def do_update(_):
+                        _, new_state = opt_apply(grads, opt_state, None, step, hyper)
+                        return new_state
+
+                    new_opt = jax.lax.cond(overflow, lambda _: opt_state, do_update,
+                                           operand=None)
+                    new_scaler = ls.update(scaler_state, overflow, dynamic=dynamic,
+                                           scale_window=scale_window,
+                                           min_scale=min_scale, hysteresis=hysteresis)
+                    return loss, new_opt, new_scaler, overflow, norm
+
+                self._jit_fused_step = jax.jit(
+                    fused_step,
+                    out_shardings=(scalar_shard, self._opt_shardings, scaler_shards,
+                                   scalar_shard, scalar_shard),
+                    donate_argnums=(0,))
             return
 
         self._jit_apply_update = jax.jit(
@@ -901,9 +974,32 @@ class DeepSpeedEngine:
             self.timers("forward_microstep").start()
         batch = tuple(self.shard_batch(x) if not isinstance(x, jax.Array) else x for x in inputs)
         if self._in_training:
-            loss, grads = self._jit_loss_and_grad(self.params, self.scaler_state.cur_scale, *batch)
-            self._pending_grads = grads
-            self._pending_loss = loss
+            if self._jit_fused_step is not None:
+                # fused single-jit step (external-master, gas==1): the update runs
+                # HERE and is committed at step() — see _compile_steps
+                if self._fused_pending is not None:
+                    raise RuntimeError(
+                        "fused external-master step: the previous forward()'s update "
+                        "was never committed — call backward() and step() before the "
+                        "next forward() (strict forward/backward/step rotation)")
+                step_no = jnp.asarray(self.global_steps + 1 - self.skipped_steps,
+                                      jnp.int32)
+                (loss, new_opt, new_scaler, overflow, norm) = self._jit_fused_step(
+                    self.opt_state, self.scaler_state, self.params, step_no,
+                    self.optimizer.current_hyper(), *batch)
+                # the old opt_state buffers were DONATED into the jit — adopt the
+                # new state immediately (a checkpoint between forward and step must
+                # never see deleted buffers); step() commits only the bookkeeping
+                self.opt_state = new_opt
+                self.scaler_state = new_scaler
+                self._fused_pending = (overflow, norm)
+                self._pending_grads = _FUSED
+                self._pending_loss = loss
+            else:
+                loss, grads = self._jit_loss_and_grad(self.params,
+                                                      self.scaler_state.cur_scale, *batch)
+                self._pending_grads = grads
+                self._pending_loss = loss
         else:
             loss = self._jit_eval(self.params, *batch)
             self._pending_grads = None
@@ -917,6 +1013,17 @@ class DeepSpeedEngine:
             "backward() called without a preceding forward() in training mode"
         if self.wall_clock_breakdown():
             self.timers("backward_microstep").start()
+        if self._pending_grads is _FUSED:
+            # fused step: grads were consumed inside the forward's jit; mark the
+            # window ready for step() to commit
+            self._pending_grads = None
+            self._grad_acc = _FUSED
+            if self._pending_loss is not None:
+                self._window_losses.append(self._pending_loss)
+            self.micro_steps += 1
+            if self.wall_clock_breakdown():
+                self.timers("backward_microstep").stop()
+            return loss
         if self._grad_acc is None:
             # First micro-batch of the window: adopt the grads directly (they already have
             # the right sharding/dtype) instead of paying a zeros+add pass. With
@@ -942,6 +1049,10 @@ class DeepSpeedEngine:
 
     def zero_grad(self):
         self._grad_acc = None
+        # Fused-step window (external-master, gas==1): the optimizer update was
+        # already applied at forward() (its inputs were donated and cannot be
+        # restored); zeroing mid-window abandons only the step bookkeeping.
+        self._fused_pending = None
 
     def step(self):
         """Apply the optimizer at the gradient-accumulation boundary (engine.py:903-985)."""
@@ -952,6 +1063,14 @@ class DeepSpeedEngine:
     def _take_model_step(self):
         if self.wall_clock_breakdown():
             self.timers("step_microstep").start()
+        if self._fused_pending is not None:
+            # state was adopted at forward() (its buffers were donated); commit the
+            # host-side bookkeeping here
+            overflow, norm = self._fused_pending
+            self._fused_pending = None
+            self._last_grad_norm = norm
+            self._finish_step(self.fp16_enabled() and bool(jax.device_get(overflow)))
+            return
         if self._offload is not None:
             overflow_bool = self._offload_step()
             self._finish_step(overflow_bool)
@@ -1084,9 +1203,11 @@ class DeepSpeedEngine:
 
     def _place_master(self, tree):
         """Put a restored master tree where this engine keeps it: device shards
-        normally, host numpy under an external-master optimizer (cold storage)."""
+        normally; under an external-master optimizer there is no master storage
+        (the master_params setter is a no-op — the view re-derives from params),
+        so skip the device transfer entirely."""
         if getattr(self, "_external_master", False):
-            return jax.tree_util.tree_map(lambda a: np.asarray(a, np.float32), tree)
+            return tree
         return jax.device_put(tree, self._master_shardings)
 
     def save_checkpoint(self, save_dir, tag=None, client_state={}, save_latest=True):

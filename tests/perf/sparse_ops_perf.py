@@ -1,0 +1,87 @@
+"""Standalone sparse MatMul (sdd/dsd/dds) + Softmax ops vs dense XLA and the fused
+Pallas kernel on the real TPU (slope-timed; VERDICT r3 #6).
+
+These ops are the API-parity analogs of the reference's Triton matmul/softmax
+(ops/sparse_attention/matmul.py:595-729, softmax.py:207-292). Their dsd/dds and
+segmented-softmax paths use `.at[...].add` scatter-adds, which on TPU can be far
+off the fused kernel — this runner measures exactly how far, so the docs can say
+whether a hot path may be built on them.
+
+    python tests/perf/sparse_ops_perf.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from devtime import timeit_slope_stats  # noqa: E402
+from deepspeed_tpu.ops.pallas.block_sparse_attention import block_sparse_attention  # noqa: E402
+from deepspeed_tpu.ops.sparse_attention.matmul import MatMul  # noqa: E402
+from deepspeed_tpu.ops.sparse_attention.softmax import Softmax  # noqa: E402
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import BigBirdSparsityConfig  # noqa: E402
+
+
+def main():
+    B, H, D, BLOCK = 1, 16, 64, 128
+    rng = np.random.default_rng(0)
+    for T in (4096, 8192):
+        cfg = BigBirdSparsityConfig(num_heads=H, block=BLOCK)
+        layout = np.asarray(cfg.make_layout(T))
+        density = float(layout.mean())
+        q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
+        k = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
+        v = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
+        n1, n2 = (20, 100) if T <= 4096 else (10, 50)
+        print(f"== T={T} density={density:.3f} (BigBird, block {BLOCK}) ==")
+
+        # composed op-by-op attention: sdd scores -> sparse softmax -> dsd @ v
+        sdd = MatMul(layout, BLOCK, "sdd", trans_b=True)
+        dsd = MatMul(layout, BLOCK, "dsd")
+        smax = Softmax(layout, BLOCK)
+        scale = 1.0 / np.sqrt(D)
+
+        def composed(q, k, v):
+            s = sdd(q, k)
+            p = smax(s, scale=scale)
+            return dsd(p.astype(q.dtype), v)
+
+        def dense(q, k, v):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                           preferred_element_type=jnp.float32) * scale
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v,
+                              preferred_element_type=jnp.float32).astype(q.dtype)
+
+        def fused(q, k, v):
+            return block_sparse_attention(q, k, v, layout, BLOCK)
+
+        for name, fn, (a1, a2) in (("composed sdd+softmax+dsd", composed, (n1, n2)),
+                                   ("dense XLA attention", dense, (n1, n2)),
+                                   ("fused pallas kernel", fused, (n1, n2))):
+            dt, sp, sc = timeit_slope_stats(fn, q, k, v, n1=a1, n2=a2)
+            print(f"  {name:28s}: {dt*1e3:8.3f} ms ±{sp:.1%} (x{sc})")
+
+        # individual ops (their own slope rows, for the docs table)
+        s_vals = sdd(q, k)
+        dt, sp, _ = timeit_slope_stats(lambda a, b: sdd(a, b), q, k, n1=n1, n2=n2)
+        print(f"  {'MatMul sdd (q@k^T)':28s}: {dt*1e3:8.3f} ms ±{sp:.1%}")
+        dt, sp, _ = timeit_slope_stats(lambda s: smax(s, scale=scale), s_vals,
+                                       n1=n1, n2=n2)
+        print(f"  {'Softmax (segmented)':28s}: {dt*1e3:8.3f} ms ±{sp:.1%}")
+        p_vals = smax(s_vals, scale=scale).astype(q.dtype)
+        dt, sp, _ = timeit_slope_stats(lambda p, b: dsd(p, b), p_vals, v, n1=n1, n2=n2)
+        print(f"  {'MatMul dsd (p@v)':28s}: {dt*1e3:8.3f} ms ±{sp:.1%}")
+        dds = MatMul(layout, BLOCK, "dds", trans_a=True)
+        dt, sp, _ = timeit_slope_stats(lambda a, b: dds(a, b), q, s_vals, n1=n1, n2=n2)
+        print(f"  {'MatMul dds (q^T@s)':28s}: {dt*1e3:8.3f} ms ±{sp:.1%}")
+
+
+if __name__ == "__main__":
+    main()

@@ -245,10 +245,15 @@ def test_external_master_optimizer(tmp_path):
         model=model, model_parameters=params, optimizer=(init, apply),
         config_params=simple_config(zero_optimization={"stage": 2}))
     assert engine._external_master
-    master_leaves = jax.tree_util.tree_leaves(engine.master_params)
-    assert all(isinstance(l, np.ndarray) for l in master_leaves), \
-        "external-master fp32 master must be host numpy (cold storage)"
-    before_master = jax.tree_util.tree_map(np.copy, engine.master_params)
+    # no separate master storage exists: master_params is a derived fp32 view of
+    # the compute params (zero extra HBM — the whole point at dp=1/1.5B)
+    assert not hasattr(engine, "_master_params_store")
+    jax.tree_util.tree_map(
+        lambda m, p: np.testing.assert_allclose(np.asarray(jax.device_get(m)),
+                                                np.asarray(jax.device_get(p), np.float32),
+                                                rtol=1e-6),
+        engine.master_params, engine.params)
+    before_master = jax.device_get(engine.master_params)
     before_params = jax.device_get(engine.params)
     shard0 = np.asarray(jax.device_get(engine.opt_state["shard"]))
 
@@ -258,16 +263,61 @@ def test_external_master_optimizer(tmp_path):
         engine.backward(loss)
         engine.step()
     assert engine.global_steps == 2
-    # opt state moved; master and compute params did not (the optimizer owns them)
+    # opt state moved; master view and compute params did not (the optimizer owns them)
     assert np.abs(np.asarray(jax.device_get(engine.opt_state["shard"])) - shard0).max() > 0
-    jax.tree_util.tree_map(lambda a, b: np.testing.assert_array_equal(a, b),
-                           engine.master_params, before_master)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        jax.device_get(engine.master_params), before_master)
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
         jax.device_get(engine.params), before_params)
 
-    # checkpoint roundtrip keeps the host-resident master host-resident
+    # checkpoint roundtrip: the optimizer-owned shard survives; no master storage
+    shard_now = np.asarray(jax.device_get(engine.opt_state["shard"]))
     engine.save_checkpoint(str(tmp_path))
     engine.load_checkpoint(str(tmp_path))
-    assert all(isinstance(l, np.ndarray)
-               for l in jax.tree_util.tree_leaves(engine.master_params))
+    np.testing.assert_allclose(np.asarray(jax.device_get(engine.opt_state["shard"])),
+                               shard_now, rtol=1e-6)
+    assert not hasattr(engine, "_master_params_store")
+
+
+def test_external_master_unfused_accumulation_and_rotation_contract():
+    """gas>1 external-master engines use the two-jit path (accumulated grads ->
+    apply_update_ext); at gas==1 the fused step enforces strict
+    forward/backward/step rotation."""
+    import jax.numpy as jnp
+
+    def init(master):
+        n = sum(l.size for l in jax.tree_util.tree_leaves(master))
+        return {"shard": jnp.zeros((n // 4,), jnp.float32)}
+
+    def apply(grads, state, master, step, hyper):
+        g = jnp.concatenate([x.reshape(-1) for x in jax.tree_util.tree_leaves(grads)])
+        return master, {"shard": state["shard"] - hyper["lr"] * g[: state["shard"].size]}
+
+    apply.external_master = True
+    model = SimpleModel(HIDDEN)
+    x = np.random.default_rng(1).normal(size=(8, HIDDEN)).astype(np.float32)
+
+    # gas = 2: unfused (grad accumulation needs materialized grads)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+        optimizer=(init, apply),
+        config_params=simple_config(batch=16, gradient_accumulation_steps=2))
+    assert engine._jit_fused_step is None
+    shard0 = np.asarray(jax.device_get(engine.opt_state["shard"]))
+    for _ in range(2):
+        loss = engine(x, np.tanh(x))
+        engine.backward(loss)
+        engine.step()
+    assert engine.global_steps == 1
+    assert np.abs(np.asarray(jax.device_get(engine.opt_state["shard"])) - shard0).max() > 0
+
+    # gas = 1: fused; a second forward before step() must fail loudly
+    engine2, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+        optimizer=(init, apply), config_params=simple_config())
+    assert engine2._jit_fused_step is not None
+    engine2(x, np.tanh(x))
+    with pytest.raises(RuntimeError, match="rotation"):
+        engine2(x, np.tanh(x))
