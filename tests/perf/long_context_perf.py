@@ -1,0 +1,49 @@
+"""Single-chip long-context flash (chunked tile path) with and without in-kernel
+attention dropout, slope-timed (PERF.md long-context rows; VERDICT r3 #4 asked for
+the dropout-on re-measurement once global-coordinate dropout landed).
+
+    python tests/perf/long_context_perf.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from devtime import timeit_slope_stats  # noqa: E402
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402
+
+
+def tf(t, T, B, H, D, causal, bwd):
+    flops = 4.0 * B * H * T * T * D * (0.5 if causal else 1.0) * (2.5 if bwd else 1.0)
+    return flops / t / 1e12
+
+
+def main():
+    B, H, D = 1, 8, 64
+    rng = np.random.default_rng(0)
+    for T, causal in ((16384, False), (16384, True), (32768, True)):
+        q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
+        k = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
+        v = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
+        for rate in (0.0, 0.1):
+            kw = {} if rate == 0 else {"dropout_rate": rate, "dropout_seed": 7}
+
+            def fwd_bwd(q, k, v):
+                return jax.grad(lambda q: jnp.sum(flash_attention(
+                    q, k, v, causal=causal, **kw).astype(jnp.float32)))(q)
+
+            dt, sp, sc = timeit_slope_stats(fwd_bwd, q, k, v, n1=3, n2=12, reps=3,
+                                            max_scale=4)
+            print(f"T={T} causal={causal} dropout={rate}: {dt*1e3:7.2f} ms ±{sp:.1%} "
+                  f"(x{sc}) fwd+bwd -> {tf(dt, T, B, H, D, causal, True):.0f} TF/s")
+
+
+if __name__ == "__main__":
+    main()
