@@ -100,29 +100,31 @@ class GPT2Model:
         single-chip flash kernel's whole-K/V VMEM cap."""
         assert self.tp_axis is None, \
             "sequence parallelism does not compose with manual TP yet"
-        assert self.config.dropout == 0.0, \
-            "the ring attention path has no in-kernel dropout; set dropout=0"
         m = GPT2Model(self.config)
         m.seq_axis = axis
         return m
 
     def sequence_parallel_loss_fn(self, mesh, axis: str):
-        """``model_fn(params, tokens, labels) -> loss`` for the engine: shard_map
-        over ``axis`` with the sequence dim of tokens/labels sharded and ring
-        attention inside. ``labels`` must be globally next-token-shifted BEFORE
-        sharding (the shift crosses chunk boundaries)."""
+        """``model_fn(params, tokens, labels, rng=None) -> loss`` for the engine:
+        shard_map over ``axis`` with the sequence dim of tokens/labels sharded and
+        ring attention inside. ``labels`` must be globally next-token-shifted
+        BEFORE sharding (the shift crosses chunk boundaries). Pass ``rng`` to
+        enable dropout (config.dropout > 0): attention dropout runs in-ring with
+        global-coordinate masks; hidden dropout decorrelates per rank."""
         from jax.sharding import PartitionSpec as P
         sp = self.with_sequence_parallel(axis)
         tok_spec = P(None, axis)
 
-        def model_fn(params, tokens, labels):
-            def local(params, tokens, labels):
+        def model_fn(params, tokens, labels, rng=None):
+            def local(params, tokens, labels, *r):
                 # equal shards: global token mean = mean of per-rank means
-                return jax.lax.pmean(sp.apply(params, tokens, labels), axis)
+                return jax.lax.pmean(
+                    sp.apply(params, tokens, labels, rng=(r[0] if r else None)), axis)
 
-            return jax.shard_map(local, mesh=mesh,
-                                 in_specs=(P(), tok_spec, tok_spec),
-                                 out_specs=P(), check_vma=False)(params, tokens, labels)
+            args = (params, tokens, labels) + (() if rng is None else (rng,))
+            in_specs = (P(), tok_spec, tok_spec) + (() if rng is None else (P(),))
+            return jax.shard_map(local, mesh=mesh, in_specs=in_specs,
+                                 out_specs=P(), check_vma=False)(*args)
 
         return model_fn
 
@@ -198,6 +200,11 @@ class GPT2Model:
         explicitly, so recompute-under-remat reproduces identical masks — the TPU analog
         of the reference's CUDA RNG state tracker (checkpointing.py:147-262)."""
         keep = 1.0 - self.config.dropout
+        if self.seq_axis is not None:
+            # sequence-parallel: each rank sees only its LOCAL chunk shape, so an
+            # unfolded (replicated) key would repeat the same mask on every chunk —
+            # fold the rank in to decorrelate
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(self.seq_axis))
         mask = jax.random.bernoulli(rng, keep, x.shape)
         return jnp.where(mask, x / jnp.asarray(keep, x.dtype), jnp.zeros((), x.dtype))
 
@@ -212,26 +219,29 @@ class GPT2Model:
         k = k.reshape(B, T, nh, c.head_dim).transpose(0, 2, 1, 3)
         v = v.reshape(B, T, nh, c.head_dim).transpose(0, 2, 1, 3)
 
+        # in-kernel attention dropout: the seed is a traced operand so remat replays
+        # identical masks. Under sequence parallelism every rank derives the SAME
+        # seed from the replicated rng — the ring hashes GLOBAL coordinates, so the
+        # sampled mask is exactly the single-chip kernel's for that seed.
+        rate, seed = 0.0, None
+        if dropout_rng is not None and c.dropout > 0:
+            seed = jax.random.randint(dropout_rng, (), 0,
+                                      jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+            rate = float(c.dropout)
         if self.seq_axis is not None:
             # sequence-parallel ring: T here is the LOCAL chunk; global causality is
             # handled by chunk ordering + the diagonal chunk's in-kernel mask
             from ..parallel.ring_attention import ring_attention
-            y = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
+            y = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True,
+                               dropout_rate=rate, dropout_seed=seed)
         elif c.use_flash_attention:
             from ..ops.pallas.flash_attention import flash_attention
-            rate, seed = 0.0, None
-            if dropout_rng is not None and c.dropout > 0:
-                # in-kernel attention dropout: the seed is a traced operand so remat
-                # replays identical masks
-                seed = jax.random.randint(dropout_rng, (), 0,
-                                          jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
-                if self.tp_axis is not None:
-                    # the kernel hashes the LOCAL head index; decorrelate the
-                    # model-parallel ranks (which see the same program_ids) by
-                    # folding the tp rank into the seed (int32 wraparound is fine)
-                    seed = seed + (jax.lax.axis_index(self.tp_axis) + 1) \
-                        * jnp.int32(-1640531527)  # 2654435761 as int32
-                rate = float(c.dropout)
+            if seed is not None and self.tp_axis is not None:
+                # the kernel hashes the LOCAL head index; decorrelate the
+                # model-parallel ranks (which see the same program_ids) by
+                # folding the tp rank into the seed (int32 wraparound is fine)
+                seed = seed + (jax.lax.axis_index(self.tp_axis) + 1) \
+                    * jnp.int32(-1640531527)  # 2654435761 as int32
             y = flash_attention(q, k, v, True, dropout_rate=rate, dropout_seed=seed)
         else:
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
