@@ -250,7 +250,12 @@ class GPT2Model:
             scores = jnp.where(mask, scores, jnp.float32(-1e9))
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             if dropout_rng is not None and c.dropout > 0:
-                # attention-probability dropout
+                # attention-probability dropout; under manual TP fold the rank in —
+                # a replicated key would give different GLOBAL heads (same local
+                # slot on different ranks) byte-identical masks
+                if self.tp_axis is not None:
+                    dropout_rng = jax.random.fold_in(
+                        dropout_rng, jax.lax.axis_index(self.tp_axis))
                 probs = self._dropout(probs, dropout_rng)
             y = jnp.einsum("bhqk,bhkd->bhqd", probs, v,
                            preferred_element_type=jnp.float32).astype(x.dtype)

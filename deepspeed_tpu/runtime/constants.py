@@ -105,6 +105,12 @@ COMMUNICATION_DATA_TYPE_DEFAULT = None
 PRESCALE_GRADIENTS = "prescale_gradients"
 PRESCALE_GRADIENTS_DEFAULT = False
 
+# Fused single-jit train step (forward+backward+optimizer in one program;
+# requires gradient_accumulation_steps == 1). TPU-native extension: buys
+# ~1 param-tree of HBM headroom by never materializing the grad tree.
+FUSED_STEP = "fused_step"
+FUSED_STEP_DEFAULT = False
+
 GRADIENT_PREDIVIDE_FACTOR = "gradient_predivide_factor"
 GRADIENT_PREDIVIDE_FACTOR_DEFAULT = 1.0
 

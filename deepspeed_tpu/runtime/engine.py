@@ -610,7 +610,7 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------------ jitted step functions
     def _compile_steps(self):
-        self._jit_fused_step = None   # set on the external-master gas==1 path below
+        self._run_fused_step = None   # set on the fused gas==1 paths below
         self._fused_pending = None
         grad_acc_steps = self.gradient_accumulation_steps()
         fp16 = self.fp16_enabled()
@@ -720,6 +720,18 @@ class DeepSpeedEngine:
                 reduce_sparse, jax.tree_util.tree_map(lambda _: P(), self.params))
         else:
             loss_and_grad = local_loss_and_grad
+
+        if self.config.fused_step and not (
+                grad_acc_steps == 1 and loss_and_grad is local_loss_and_grad
+                and self._offload is None):
+            # warn HERE (the offload path returns early below and would otherwise
+            # swallow the flag silently): the user must not believe the fused
+            # step's HBM saving is active when it is not
+            logger.warning(
+                "[deepspeed_tpu] fused_step requested but ineligible (it needs "
+                "gradient_accumulation_steps == 1 and the plain local grad path — "
+                "no 1-bit Adam stacked grads, sparse-gradient reduction, or "
+                "ZeRO-Offload); using the two-jit step")
 
         # Inputs carry their shardings (params/batch were device_put with the right
         # layouts); out_shardings on the grads is what makes stage-2 store them
@@ -891,11 +903,23 @@ class DeepSpeedEngine:
                                            min_scale=min_scale, hysteresis=hysteresis)
                     return loss, new_opt, new_scaler, overflow, norm
 
-                self._jit_fused_step = jax.jit(
+                jit_fused = jax.jit(
                     fused_step,
                     out_shardings=(scalar_shard, self._opt_shardings, scaler_shards,
                                    scalar_shard, scalar_shard),
                     donate_argnums=(0,))
+
+                def run_fused(batch):
+                    step_no = jnp.asarray(self.global_steps + 1 - self.skipped_steps,
+                                          jnp.int32)
+                    loss, new_opt, new_scaler, overflow, norm = jit_fused(
+                        self.opt_state, self.scaler_state, self.params, step_no,
+                        self.optimizer.current_hyper(), *batch)
+                    self.opt_state = new_opt
+                    self.scaler_state = new_scaler
+                    return loss, (overflow, norm)
+
+                self._run_fused_step = run_fused
             return
 
         self._jit_apply_update = jax.jit(
@@ -904,6 +928,46 @@ class DeepSpeedEngine:
                            jax.tree_util.tree_map(lambda _: scalar_shard, self.scaler_state),
                            self._param_shardings, scalar_shard, scalar_shard),
             donate_argnums=(0, 1, 3, 4))
+
+        # Opt-in fused step for STANDARD engines ({"fused_step": true}, gas == 1):
+        # same single-program structure as the external-master fused step — the
+        # grad tree never materializes as jit outputs, buying ~1 param-tree of HBM
+        # headroom (the margin that decides the remat policy for large dp=1 runs).
+        # The update executes at forward() with master/opt/params adopted
+        # immediately (their buffers are donated); step() commits bookkeeping, and
+        # strict forward/backward/step rotation is enforced in forward().
+        if (self.config.fused_step and grad_acc_steps == 1
+                and loss_and_grad is local_loss_and_grad):
+            def fused_step_std(master, opt_state, scaler_state, params, step, hyper,
+                               *batch):
+                # the whole two-jit pipeline inlined: value_and_grad feeds the
+                # SAME apply_update body (overflow skip, scaler, param re-cast)
+                loss, grads = local_loss_and_grad(params, scaler_state.cur_scale,
+                                                  *batch)
+                return (loss,) + apply_update(master, opt_state, scaler_state,
+                                              grads, params, step, hyper)
+
+            jit_fused_std = jax.jit(
+                fused_step_std,
+                out_shardings=(scalar_shard, self._master_shardings,
+                               self._opt_shardings, scaler_shards,
+                               self._param_shardings, scalar_shard, scalar_shard),
+                donate_argnums=(0, 1, 3))
+
+            def run_fused_std(batch):
+                step_no = jnp.asarray(self.global_steps + 1 - self.skipped_steps,
+                                      jnp.int32)
+                (loss, new_master, new_opt, new_scaler, new_params, overflow,
+                 norm) = jit_fused_std(
+                    self.master_params, self.opt_state, self.scaler_state,
+                    self.params, step_no, self.optimizer.current_hyper(), *batch)
+                self.master_params = new_master
+                self.opt_state = new_opt
+                self.scaler_state = new_scaler
+                self.params = new_params
+                return loss, (overflow, norm)
+
+            self._run_fused_step = run_fused_std
 
     # ------------------------------------------------------------------ train API
     def shard_batch(self, batch):
@@ -974,25 +1038,17 @@ class DeepSpeedEngine:
             self.timers("forward_microstep").start()
         batch = tuple(self.shard_batch(x) if not isinstance(x, jax.Array) else x for x in inputs)
         if self._in_training:
-            if self._jit_fused_step is not None:
-                # fused single-jit step (external-master, gas==1): the update runs
-                # HERE and is committed at step() — see _compile_steps
+            if self._run_fused_step is not None:
+                # fused single-jit step (gas==1): the update runs HERE — the old
+                # state buffers are donated into the jit and the new state adopted
+                # immediately (a checkpoint between forward and step must never see
+                # deleted buffers); step() commits only the bookkeeping
                 if self._fused_pending is not None:
                     raise RuntimeError(
-                        "fused external-master step: the previous forward()'s update "
-                        "was never committed — call backward() and step() before the "
-                        "next forward() (strict forward/backward/step rotation)")
-                step_no = jnp.asarray(self.global_steps + 1 - self.skipped_steps,
-                                      jnp.int32)
-                (loss, new_opt, new_scaler, overflow, norm) = self._jit_fused_step(
-                    self.opt_state, self.scaler_state, self.params, step_no,
-                    self.optimizer.current_hyper(), *batch)
-                # the old opt_state buffers were DONATED into the jit — adopt the
-                # new state immediately (a checkpoint between forward and step must
-                # never see deleted buffers); step() commits only the bookkeeping
-                self.opt_state = new_opt
-                self.scaler_state = new_scaler
-                self._fused_pending = (overflow, norm)
+                        "fused step: the previous forward()'s update was never "
+                        "committed — call backward() and step() before the next "
+                        "forward() (strict forward/backward/step rotation)")
+                loss, self._fused_pending = self._run_fused_step(batch)
                 self._pending_grads = _FUSED
                 self._pending_loss = loss
             else:

@@ -304,7 +304,7 @@ def test_external_master_unfused_accumulation_and_rotation_contract():
         model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
         optimizer=(init, apply),
         config_params=simple_config(batch=16, gradient_accumulation_steps=2))
-    assert engine._jit_fused_step is None
+    assert engine._run_fused_step is None
     shard0 = np.asarray(jax.device_get(engine.opt_state["shard"]))
     for _ in range(2):
         loss = engine(x, np.tanh(x))
@@ -317,7 +317,60 @@ def test_external_master_unfused_accumulation_and_rotation_contract():
     engine2, _, _, _ = deepspeed_tpu.initialize(
         model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
         optimizer=(init, apply), config_params=simple_config())
-    assert engine2._jit_fused_step is not None
+    assert engine2._run_fused_step is not None
     engine2(x, np.tanh(x))
     with pytest.raises(RuntimeError, match="rotation"):
         engine2(x, np.tanh(x))
+
+
+def test_fused_step_config_matches_two_jit_path():
+    """{"fused_step": true}: the standard engine's single-jit step must produce the
+    SAME losses and master weights as the two-jit step — including fp16 overflow
+    skip behavior — and enforce the rotation contract."""
+    model = SimpleModel(HIDDEN)
+    data = random_dataset(64, HIDDEN, seed=5)
+    results = {}
+    for fused in (False, True):
+        params = model.init(jax.random.PRNGKey(2))
+        cfg = simple_config(fused_step=fused)
+        engine, _, loader, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params, training_data=data,
+            config_params=cfg)
+        assert (engine._run_fused_step is not None) == fused
+        it = iter(loader)
+        losses = []
+        for _ in range(6):
+            x, y = next(it)
+            loss = engine(x, y)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(jax.device_get(loss)))
+        results[fused] = (losses, jax.device_get(engine.master_params))
+    np.testing.assert_allclose(results[True][0], results[False][0], rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7),
+        results[True][1], results[False][1])
+
+
+def test_fused_step_fp16_overflow_parity():
+    """Overflow under the fused step must skip the master update, halve the scale,
+    and count a skipped step — exactly like the two-jit path."""
+    model = SimpleModel(HIDDEN)
+    params = model.init(jax.random.PRNGKey(0))
+    cfg = simple_config(fused_step=True,
+                        fp16={"enabled": True, "loss_scale": 0,
+                              "initial_scale_power": 4, "hysteresis": 1})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                               config_params=cfg)
+    assert engine._run_fused_step is not None
+    s0 = float(engine.loss_scale())
+    before = jax.device_get(engine.master_params)
+    x = np.ones((8, HIDDEN), np.float32)
+    y = np.full((8, HIDDEN), 1e30, np.float32)  # cotangents overflow fp16
+    loss = engine(x, y)
+    engine.backward(loss)
+    engine.step()
+    assert engine.skipped_steps == 1
+    assert float(engine.loss_scale()) == s0 / 2
+    jax.tree_util.tree_map(lambda a, b: np.testing.assert_array_equal(a, b),
+                           jax.device_get(engine.master_params), before)

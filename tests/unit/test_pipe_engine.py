@@ -294,3 +294,37 @@ def test_instruction_path_buffer_bound_m_much_greater_than_s():
     it = data_iter(batch=8)
     losses = [float(jax.device_get(engine.train_batch(it))) for _ in range(2)]
     assert np.isfinite(losses).all()
+
+
+def test_spmd_pipe_composes_with_zero2():
+    """Public-API pipeline + ZeRO-2: merge_zero_into claims a free data-divisible
+    axis on the pipe-stacked master/optimizer state, so 2-D (pipe x data) state
+    sharding happens under deepspeed.initialize with a JSON config."""
+    hidden = 64  # [2, 64, 64] stacked weights: above min_size, 64 % dp(4) == 0
+    layers = [LayerSpec(Linear, hidden) for _ in range(4)]
+    module = PipelineModule(layers=layers, num_stages=2, loss_fn=mse_loss)
+    params = module.init_params(jax.random.PRNGKey(3),
+                                jnp.zeros((4, hidden), jnp.float32))
+    cfg = pipe_config()
+    cfg["zero_optimization"] = {"stage": 2}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=module, model_parameters=params,
+                                               config_params=cfg)
+    assert engine._spmd
+    from deepspeed_tpu.runtime.pipe.engine import STACKED_KEY
+    # stacked core master WEIGHTS are sharded on BOTH pipe (leading) and data axes
+    w = engine.master_params[STACKED_KEY][0]["w"]
+    spec = w.sharding.spec
+    flat = [ax for e in spec if e for ax in ((e,) if isinstance(e, str) else e)]
+    assert "pipe" in flat, spec
+    assert "data" in flat, spec
+
+    def it():
+        rng = np.random.default_rng(19)
+        w_true = np.random.default_rng(7).normal(size=(hidden, hidden)).astype(np.float32) * 0.3
+        while True:
+            x = rng.normal(size=(16, hidden)).astype(np.float32)
+            yield x, np.tanh(x @ w_true)
+
+    gen = it()
+    losses = [float(jax.device_get(engine.train_batch(gen))) for _ in range(10)]
+    assert losses[-1] < losses[0] * 0.9, losses
