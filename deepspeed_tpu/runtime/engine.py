@@ -723,15 +723,16 @@ class DeepSpeedEngine:
 
         if self.config.fused_step and not (
                 grad_acc_steps == 1 and loss_and_grad is local_loss_and_grad
-                and self._offload is None):
+                and self._offload is None and not self._cpu_checkpointing_active()):
             # warn HERE (the offload path returns early below and would otherwise
             # swallow the flag silently): the user must not believe the fused
             # step's HBM saving is active when it is not
             logger.warning(
                 "[deepspeed_tpu] fused_step requested but ineligible (it needs "
                 "gradient_accumulation_steps == 1 and the plain local grad path — "
-                "no 1-bit Adam stacked grads, sparse-gradient reduction, or "
-                "ZeRO-Offload); using the two-jit step")
+                "no 1-bit Adam stacked grads, sparse-gradient reduction, "
+                "ZeRO-Offload, or cpu activation checkpointing); using the "
+                "two-jit step")
 
         # Inputs carry their shardings (params/batch were device_put with the right
         # layouts); out_shardings on the grads is what makes stage-2 store them
@@ -937,7 +938,8 @@ class DeepSpeedEngine:
         # immediately (their buffers are donated); step() commits bookkeeping, and
         # strict forward/backward/step rotation is enforced in forward().
         if (self.config.fused_step and grad_acc_steps == 1
-                and loss_and_grad is local_loss_and_grad):
+                and loss_and_grad is local_loss_and_grad
+                and not self._cpu_checkpointing_active()):
             def fused_step_std(master, opt_state, scaler_state, params, step, hyper,
                                *batch):
                 # the whole two-jit pipeline inlined: value_and_grad feeds the
@@ -1038,7 +1040,18 @@ class DeepSpeedEngine:
             self.timers("forward_microstep").start()
         batch = tuple(self.shard_batch(x) if not isinstance(x, jax.Array) else x for x in inputs)
         if self._in_training:
-            if self._run_fused_step is not None:
+            use_fused = self._run_fused_step is not None
+            if use_fused and self._cpu_checkpointing_active():
+                # a post-construction act_ckpt.configure(checkpoint_in_cpu=True):
+                # the fused jit's explicit out_shardings cannot combine with
+                # host-placement custom-calls (see _jit_loss_and_grad) — fall back
+                if not getattr(self, "_warned_fused_cpu_ckpt", False):
+                    self._warned_fused_cpu_ckpt = True
+                    logger.warning("[deepspeed_tpu] fused_step disabled: cpu "
+                                   "activation checkpointing was enabled after "
+                                   "engine construction; using the two-jit step")
+                use_fused = False
+            if use_fused:
                 # fused single-jit step (gas==1): the update runs HERE — the old
                 # state buffers are donated into the jit and the new state adopted
                 # immediately (a checkpoint between forward and step must never see

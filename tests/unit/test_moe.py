@@ -1,0 +1,134 @@
+"""Mixture-of-Experts + expert parallelism on the 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.parallel.moe import MoELayer, moe_apply_sharded
+
+H, F, E = 16, 32, 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh(data=1, model=8, pipe=1)
+
+
+def oracle(layer, params, x2):
+    """Per-token reference: each token through its argmax expert's MLP, weighted
+    by the gate prob (assumes capacity large enough that nothing drops)."""
+    logits = x2.astype(np.float32) @ np.asarray(params["gate_w"])
+    probs = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+    idx = np.argmax(np.asarray(probs), axis=-1)
+    out = np.zeros_like(np.asarray(x2, np.float32))
+    for n, e in enumerate(idx):
+        h = np.asarray(x2[n], np.float32) @ np.asarray(params["w_in"][e]) + \
+            np.asarray(params["b_in"][e])
+        h = np.asarray(jax.nn.gelu(jnp.asarray(h)))
+        y = h @ np.asarray(params["w_out"][e]) + np.asarray(params["b_out"][e])
+        out[n] = float(np.asarray(probs)[n, e]) * y
+    return out
+
+
+def test_dense_dispatch_matches_per_token_oracle():
+    layer = MoELayer(H, F, E, capacity_factor=8.0)  # no drops
+    params = layer.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (24, H), jnp.float32)
+    y, aux = layer.apply(params, x)
+    np.testing.assert_allclose(np.asarray(y), oracle(layer, params, x),
+                               rtol=1e-4, atol=1e-5)
+    assert float(aux) > 0  # E * sum f*p >= 1 by Cauchy-Schwarz, > 0 always
+
+
+def test_capacity_drops_overflow_tokens():
+    """With capacity 1 per expert, later tokens routed to a full expert must
+    produce ZERO output (they ride the residual in a real block)."""
+    layer = MoELayer(H, F, E, capacity_factor=1e-9)  # capacity clamps to 1
+    params = layer.init(jax.random.PRNGKey(0))
+    # two identical tokens route to the same expert; the second must drop
+    x = jnp.tile(jax.random.normal(jax.random.PRNGKey(2), (1, H)), (2, 1))
+    y, _ = layer.apply(params, x)
+    assert not np.allclose(np.asarray(y[0]), 0.0)
+    np.testing.assert_allclose(np.asarray(y[1]), 0.0, atol=1e-7)
+
+
+def test_expert_parallel_matches_dense_dispatch(mesh):
+    """8-way expert-parallel (all_to_all dispatch) must equal the single-program
+    dense dispatch bit-for-bit at fp32 — fwd AND grads."""
+    dense = MoELayer(H, F, E, capacity_factor=8.0)
+    ep = MoELayer(H, F, E, capacity_factor=8.0, expert_axis="model")
+    params = dense.init(jax.random.PRNGKey(3))
+    x = jax.random.normal(jax.random.PRNGKey(4), (4, 8, H), jnp.float32)
+
+    y_d, aux_d = dense.apply(params, x)
+    y_p, aux_p = moe_apply_sharded(ep, mesh, params, x)
+    np.testing.assert_allclose(np.asarray(y_p), np.asarray(y_d), rtol=2e-5,
+                               atol=2e-6)
+    np.testing.assert_allclose(float(aux_p), float(aux_d), rtol=1e-5)
+
+    def loss_d(p):
+        y, aux = dense.apply(p, x)
+        return jnp.sum(y ** 2) + 0.01 * aux
+
+    def loss_p(p):
+        y, aux = moe_apply_sharded(ep, mesh, p, x)
+        return jnp.sum(y ** 2) + 0.01 * aux
+
+    g_d = jax.grad(loss_d)(params)
+    g_p = jax.grad(loss_p)(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=5e-4, atol=1e-5),
+        g_p, g_d)
+
+
+def test_expert_parallel_emits_all_to_all(mesh):
+    from deepspeed_tpu.utils.hlo import collective_counts, optimized_hlo
+
+    ep = MoELayer(H, F, E, capacity_factor=2.0, expert_axis="model")
+    params = ep.init(jax.random.PRNGKey(5))
+    x = jnp.zeros((4, 8, H), jnp.float32)
+    j = jax.jit(lambda p, x: moe_apply_sharded(ep, mesh, p, x)[0])
+    counts = collective_counts(optimized_hlo(j, params, x))
+    assert counts.get("all-to-all", 0) >= 2, \
+        f"EP dispatch+return should be two all_to_alls: {counts}"
+
+
+def test_moe_trains_through_engine(mesh):
+    """A 2-layer MoE MLP regression model trains through DeepSpeedEngine with the
+    aux loss added — loss decreases (experts + gate learn)."""
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    ep = MoELayer(H, F, E, capacity_factor=2.0, expert_axis="model")
+
+    class Model:
+        def init(self, rng):
+            k1, k2 = jax.random.split(rng)
+            return {"moe": ep.init(k1),
+                    "head": jax.random.normal(k2, (H, H), jnp.float32) * 0.3}
+
+        def apply(self, params, x, y):
+            h, aux = moe_apply_sharded(ep, mesh, params["moe"], x)
+            pred = jnp.tanh(h) @ params["head"]
+            return jnp.mean((pred - y) ** 2) + 0.01 * aux
+
+    model = Model()
+    engine = DeepSpeedEngine(
+        model=model, model_parameters=model.init(jax.random.PRNGKey(6)), mesh=mesh,
+        config_params={"train_batch_size": 32, "train_micro_batch_size_per_gpu": 32,
+                       "gradient_accumulation_steps": 1, "steps_per_print": 100,
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=(H, H)).astype(np.float32) * 0.4
+    losses = []
+    for _ in range(50):
+        x = rng.normal(size=(32, H)).astype(np.float32)
+        y = np.tanh(x @ w_true)
+        loss = engine(jnp.asarray(x), jnp.asarray(y))
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
