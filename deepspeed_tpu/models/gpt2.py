@@ -36,6 +36,16 @@ class GPT2Config:
     remat_policy: Any = None       # None=full recompute; "dots"=save matmul outputs
     loss_chunk: int = 128          # seq-chunked fused CE (0 = materialize full logits)
     compute_dtype: Any = jnp.bfloat16
+    # Mixture-of-Experts (parallel/moe.py): 0 = dense FFN everywhere. When > 0,
+    # every ``moe_every``-th block replaces its MLP with a switch-style MoE FFN;
+    # the training loss gains ``moe_aux_weight`` x the Switch load-balancing term.
+    # Expert parallelism comes from param_shardings(mesh): expert weights shard
+    # their leading E axis over the ``model`` mesh axis and GSPMD partitions the
+    # batched expert einsums across it.
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     # named sizes for convenience
     @property
@@ -80,12 +90,23 @@ class GPT2Model:
         self.tp_axis = None   # set via with_tp() for manual-collective (shard_map) TP
         self.tp_size = 1
         self.seq_axis = None  # set via with_sequence_parallel() for ring attention
+        self._moe = None
+        if config.moe_experts > 0:
+            from ..parallel.moe import MoELayer
+            # single-program dense dispatch; expert PARALLELISM comes from
+            # param_shardings' leading-E layouts (GSPMD partitions the batched
+            # expert einsums over the model axis)
+            self._moe = MoELayer(config.n_embd, 4 * config.n_embd,
+                                 config.moe_experts,
+                                 capacity_factor=config.moe_capacity_factor)
 
     def with_tp(self, axis: str, size: int) -> "GPT2Model":
         """A copy configured for manual tensor parallelism over mesh axis ``axis``."""
         assert self.config.n_head % size == 0, \
             f"n_head={self.config.n_head} must divide by tp size {size}"
         assert (4 * self.config.n_embd) % size == 0
+        assert self.config.moe_experts == 0, \
+            "MoE blocks do not compose with manual TP (use GSPMD expert sharding)"
         m = GPT2Model(self.config)
         m.tp_axis = axis
         m.tp_size = size
@@ -100,6 +121,8 @@ class GPT2Model:
         single-chip flash kernel's whole-K/V VMEM cap."""
         assert self.tp_axis is None, \
             "sequence parallelism does not compose with manual TP yet"
+        assert self.config.moe_experts == 0, \
+            "MoE blocks do not compose with sequence parallelism yet"
         m = GPT2Model(self.config)
         m.seq_axis = axis
         return m
@@ -148,8 +171,18 @@ class GPT2Model:
             "mlp": {"c_fc_w": ns(None, MODEL_AXIS), "c_fc_b": ns(MODEL_AXIS),
                     "c_proj_w": ns(MODEL_AXIS, None), "c_proj_b": repl},
         }
+        if self._moe is not None:
+            moe_block = {k: v for k, v in block.items() if k != "mlp"}
+            moe_block["moe"] = self._moe.param_shardings(mesh, MODEL_AXIS)
+            return {"wte": ns(MODEL_AXIS, None), "wpe": repl, "ln_f": dict(ln),
+                    "blocks": [moe_block if self._is_moe_block(i) else block
+                               for i in range(self.config.n_layer)]}
         return {"wte": ns(MODEL_AXIS, None), "wpe": repl, "ln_f": dict(ln),
                 "blocks": [block for _ in range(self.config.n_layer)]}
+
+    def _is_moe_block(self, i: int) -> bool:
+        return (self._moe is not None
+                and i % self.config.moe_every == self.config.moe_every - 1)
 
     # ------------------------------------------------------------- init
     def init(self, rng) -> Dict:
@@ -177,13 +210,16 @@ class GPT2Model:
                 },
                 "ln_2": {"scale": jnp.ones((c.n_embd,), jnp.float32),
                          "bias": jnp.zeros((c.n_embd,), jnp.float32)},
-                "mlp": {
+            }
+            if self._is_moe_block(i):
+                block["moe"] = self._moe.init(k[2])
+            else:
+                block["mlp"] = {
                     "c_fc_w": _dense_init(k[2], (c.n_embd, 4 * c.n_embd), c.initializer_range),
                     "c_fc_b": jnp.zeros((4 * c.n_embd,), jnp.float32),
                     "c_proj_w": _dense_init(k[3], (4 * c.n_embd, c.n_embd), proj_scale),
                     "c_proj_b": jnp.zeros((c.n_embd,), jnp.float32),
-                },
-            }
+                }
             params["blocks"].append(block)
         return params
 
@@ -289,10 +325,14 @@ class GPT2Model:
         if k_res1 is not None:
             a = self._dropout(a, k_res1)
         x = x + a
-        m = self._mlp(self._layer_norm(x, bp["ln_2"], c.layer_norm_epsilon), bp["mlp"])
+        h = self._layer_norm(x, bp["ln_2"], c.layer_norm_epsilon)
+        if "moe" in bp:
+            m, aux = self._moe.apply(bp["moe"], h)
+        else:
+            m, aux = self._mlp(h, bp["mlp"]), jnp.zeros((), jnp.float32)
         if k_res2 is not None:
             m = self._dropout(m, k_res2)
-        return x + m
+        return x + m, aux
 
     # ------------------------------------------------------------- apply
     def _backbone(self, params, tokens, rng=None):
@@ -315,16 +355,18 @@ class GPT2Model:
             # config-aware remat: honors partition_activations / cpu_checkpointing
             from ..runtime.activation_checkpointing.checkpointing import checkpoint_wrapper
             block_fn = checkpoint_wrapper(block_fn, policy=c.remat_policy)
+        aux_total = jnp.zeros((), jnp.float32)
         for bp in params["blocks"]:
             if use_dropout:
                 rng, kb = jax.random.split(rng)
-                x = block_fn(x, bp, kb)
+                x, aux = block_fn(x, bp, kb)
             else:
-                x = block_fn(x, bp)
-        return self._layer_norm(x, params["ln_f"], c.layer_norm_epsilon)
+                x, aux = block_fn(x, bp)
+            aux_total = aux_total + aux
+        return self._layer_norm(x, params["ln_f"], c.layer_norm_epsilon), aux_total
 
     def logits(self, params, tokens, rng=None):
-        x = self._backbone(params, tokens, rng=rng)
+        x, _ = self._backbone(params, tokens, rng=rng)
         # tied LM head: logits = x @ wte.T
         return jnp.dot(x, params["wte"].T.astype(x.dtype), preferred_element_type=jnp.float32)
 
@@ -355,17 +397,18 @@ class GPT2Model:
         if labels is None:
             return self.logits(params, tokens, rng=rng)
         c = self.config
-        x = self._backbone(params, tokens, rng=rng)
+        x, aux = self._backbone(params, tokens, rng=rng)
+        aux = c.moe_aux_weight * aux if self._moe is not None else 0.0
         T = x.shape[1]
         if c.loss_chunk:
             # largest divisor of T not exceeding loss_chunk (static shapes for XLA)
             chunk = next(cc for cc in range(min(c.loss_chunk, T), 0, -1) if T % cc == 0)
             if chunk < T:
-                return self._chunked_ce(x, params["wte"], labels, chunk)
+                return self._chunked_ce(x, params["wte"], labels, chunk) + aux
         logits = jnp.dot(x, params["wte"].T.astype(x.dtype), preferred_element_type=jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-        return -jnp.mean(ll)
+        return -jnp.mean(ll) + aux
 
     def param_count(self, params) -> int:
         from ..runtime.utils import param_count

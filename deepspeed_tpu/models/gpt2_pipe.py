@@ -46,6 +46,9 @@ class GPT2Pipe:
 
     def __init__(self, config: GPT2Config, num_stages: int, tp: int = 1):
         assert config.n_layer % num_stages == 0, "n_layer must divide evenly into stages"
+        assert config.moe_experts == 0, \
+            "MoE blocks do not compose with the SPMD pipeline yet (heterogeneous " \
+            "block pytrees cannot stack over the pipe axis)"
         # the tied vocab table shards over pipe: pad it to a stage multiple internally
         # (padded logit columns are masked out of the vocab-parallel softmax)
         self.vocab_pad = (config.vocab_size + num_stages - 1) // num_stages * num_stages
@@ -135,11 +138,14 @@ class GPT2Pipe:
         dense = self._dense
 
         def body(xx, layer_params):
-            return jax.checkpoint(dense._block)(xx, layer_params) if c.remat \
-                else dense._block(xx, layer_params), None
+            # _block returns (hidden, moe_aux); aux is always 0 here (the pipe
+            # model asserts moe_experts == 0) — drop it from the scan carry
+            blk = jax.checkpoint(dense._block) if c.remat else dense._block
+            out, _aux = blk(xx, layer_params)
+            return out, None
 
         # scan over this stage's layers ([L/S, ...] leaves)
-        x, _ = jax.lax.scan(lambda xx, lp: body(xx, lp), x, stage_params)
+        x, _ = jax.lax.scan(body, x, stage_params)
         return x
 
     def _vp_embed(self, tokens, io_params):
