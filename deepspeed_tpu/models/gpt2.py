@@ -92,13 +92,17 @@ class GPT2Model:
         self.seq_axis = None  # set via with_sequence_parallel() for ring attention
         self._moe = None
         if config.moe_experts > 0:
+            assert config.moe_every >= 1, \
+                f"moe_every must be >= 1 (got {config.moe_every})"
             from ..parallel.moe import MoELayer
-            # single-program dense dispatch; expert PARALLELISM comes from
-            # param_shardings' leading-E layouts (GSPMD partitions the batched
-            # expert einsums over the model axis)
+            # single-program dense dispatch, routed PER SEQUENCE ROW (the GShard
+            # group convention — ungrouped dispatch is O((B*T)^2) memory); expert
+            # PARALLELISM comes from param_shardings' leading-E layouts (GSPMD
+            # partitions the batched expert einsums over the model axis)
             self._moe = MoELayer(config.n_embd, 4 * config.n_embd,
                                  config.moe_experts,
-                                 capacity_factor=config.moe_capacity_factor)
+                                 capacity_factor=config.moe_capacity_factor,
+                                 group_size=config.n_positions)
 
     def with_tp(self, axis: str, size: int) -> "GPT2Model":
         """A copy configured for manual tensor parallelism over mesh axis ``axis``."""
@@ -121,8 +125,9 @@ class GPT2Model:
         single-chip flash kernel's whole-K/V VMEM cap."""
         assert self.tp_axis is None, \
             "sequence parallelism does not compose with manual TP yet"
-        assert self.config.moe_experts == 0, \
-            "MoE blocks do not compose with sequence parallelism yet"
+        # MoE composes: the dense dispatch routes each rank's LOCAL sequence chunk
+        # (per-chunk capacity; experts replicated inside the shard_map) and the aux
+        # term folds into the pmean'd loss
         m = GPT2Model(self.config)
         m.seq_axis = axis
         return m
@@ -174,11 +179,12 @@ class GPT2Model:
         if self._moe is not None:
             moe_block = {k: v for k, v in block.items() if k != "mlp"}
             moe_block["moe"] = self._moe.param_shardings(mesh, MODEL_AXIS)
-            return {"wte": ns(MODEL_AXIS, None), "wpe": repl, "ln_f": dict(ln),
-                    "blocks": [moe_block if self._is_moe_block(i) else block
-                               for i in range(self.config.n_layer)]}
+            blocks = [moe_block if self._is_moe_block(i) else block
+                      for i in range(self.config.n_layer)]
+        else:
+            blocks = [block for _ in range(self.config.n_layer)]
         return {"wte": ns(MODEL_AXIS, None), "wpe": repl, "ln_f": dict(ln),
-                "blocks": [block for _ in range(self.config.n_layer)]}
+                "blocks": blocks}
 
     def _is_moe_block(self, i: int) -> bool:
         return (self._moe is not None

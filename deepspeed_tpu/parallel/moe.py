@@ -45,16 +45,23 @@ class MoELayer:
       capacity_factor: per-expert capacity multiplier (1.0 = perfectly balanced).
       expert_axis: mesh axis name experts shard over when applied inside
         shard_map (None = single-program dense dispatch, still capacity-based).
+      group_size: route tokens in fixed-size groups (the GShard convention, e.g.
+        one sequence row per group). The dense dispatch/combine tensors are
+        [N, E, C] with C ∝ N·cf/E — UNGROUPED that is O(N²·cf) elements and
+        exhausts HBM at real batch·seq sizes; grouping bounds it at
+        O(N·group_size·cf). None = one group (fine for small N / unit tests).
     """
 
     def __init__(self, hidden: int, ffn_dim: int, num_experts: int,
                  capacity_factor: float = 1.25,
-                 expert_axis: Optional[str] = None):
+                 expert_axis: Optional[str] = None,
+                 group_size: Optional[int] = None):
         self.hidden = hidden
         self.ffn_dim = ffn_dim
         self.num_experts = num_experts
         self.capacity_factor = float(capacity_factor)
         self.expert_axis = expert_axis
+        self.group_size = group_size
 
     # ------------------------------------------------------------------ params
     def init(self, rng, x=None):
@@ -125,13 +132,27 @@ class MoELayer:
         N = x2.shape[0]
 
         if self.expert_axis is None:
-            capacity = max(1, int(math.ceil(N / E * self.capacity_factor)))
-            dispatch, combine, (f, p) = self._route(x2, params["gate_w"], capacity)
-            buf = jnp.einsum("nec,nh->ech", dispatch.astype(x2.dtype), x2)
+            g = self.group_size if (self.group_size and N % self.group_size == 0
+                                    and N > self.group_size) else N
+            G = N // g
+            capacity = max(1, int(math.ceil(g / E * self.capacity_factor)))
+            xg = x2.reshape(G, g, H)
+
+            def route_group(xr):
+                dispatch, combine, (f, p) = self._route(xr, params["gate_w"],
+                                                        capacity)
+                buf = jnp.einsum("nec,nh->ech", dispatch.astype(xr.dtype), xr)
+                return buf, combine, f, p
+
+            bufs, combines, fs, ps = jax.vmap(route_group)(xg)  # [G, E, C, H], ...
+            stacked = bufs.transpose(1, 0, 2, 3).reshape(E, G * capacity, H)
             out = self._expert_ffn(params["w_in"], params["b_in"],
-                                   params["w_out"], params["b_out"], buf)
-            y = jnp.einsum("nec,ech->nh", combine.astype(out.dtype), out)
-            aux = E * jnp.sum(f * p)
+                                   params["w_out"], params["b_out"], stacked)
+            out = out.reshape(E, G, capacity, H).transpose(1, 0, 2, 3)
+            y = jnp.einsum("gnec,gech->gnh", combines.astype(out.dtype), out)
+            # mean over groups of the per-group balancing term (Switch eq. 4
+            # computed per routing group, the same convention a sharded run uses)
+            aux = E * jnp.mean(jnp.sum(fs * ps, axis=-1))
             return y.reshape(orig_shape), aux
 
         axis = self.expert_axis
