@@ -1,0 +1,55 @@
+"""MoE layer vs dense MLP of the same per-token FLOPs on the real TPU
+(slope-timed): what the switch routing + grouped dispatch costs over the pure
+expert compute. python tests/perf/moe_perf.py"""
+
+import os
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from devtime import timeit_slope_stats  # noqa: E402
+from deepspeed_tpu.parallel.moe import MoELayer  # noqa: E402
+
+
+def main():
+    H, F, E = 1024, 4096, 8
+    B, T = 8, 1024
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, T, H)), jnp.bfloat16)
+
+    layer = MoELayer(H, F, E, capacity_factor=1.25, group_size=T)
+    params = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16),
+                                    layer.init(jax.random.PRNGKey(0)))
+
+    w1 = jnp.asarray(rng.normal(size=(H, F)) * 0.02, jnp.bfloat16)
+    w2 = jnp.asarray(rng.normal(size=(F, H)) * 0.02, jnp.bfloat16)
+
+    def dense_mlp(x):
+        h = jax.nn.gelu(jnp.einsum("bth,hf->btf", x, w1,
+                                   preferred_element_type=jnp.float32).astype(x.dtype))
+        return jnp.einsum("btf,fh->bth", h, w2, preferred_element_type=jnp.float32)
+
+    def moe(x):
+        y, aux = layer.apply(params, x)
+        return y.astype(jnp.float32) + aux
+
+    dt_d, sp_d, _ = timeit_slope_stats(dense_mlp, x, n1=20, n2=100)
+    dt_m, sp_m, _ = timeit_slope_stats(moe, x, n1=20, n2=100)
+    n_tok = B * T
+    flops = 4.0 * n_tok * H * F  # per-token 2 matmuls (same active FLOPs both paths)
+    print(f"dense MLP   (H={H}, F={F}):        {dt_d*1e3:7.3f} ms ±{sp_d:.1%} "
+          f"-> {flops/dt_d/1e12:.0f} TF/s")
+    print(f"switch MoE  (E={E}, cf=1.25, g={T}): {dt_m*1e3:7.3f} ms ±{sp_m:.1%} "
+          f"-> {flops/dt_m/1e12:.0f} TF/s active")
+    print(f"routing+dispatch overhead: {dt_m/dt_d:.2f}x the dense MLP at equal "
+          f"per-token FLOPs ({E}x the parameters)")
+
+
+if __name__ == "__main__":
+    main()
