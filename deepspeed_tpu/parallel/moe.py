@@ -5,8 +5,10 @@ arrived later) — included because expert parallelism is the 5th parallelism
 dimension a complete TPU framework needs next to dp/tp/pp/sp. The design is the
 GShard/Switch-Transformer recipe expressed TPU-first:
 
-- **Static shapes everywhere**: top-1 (switch) routing with a fixed per-expert
-  capacity ``C = ceil(tokens/E * capacity_factor)``; the dispatch is a dense
+- **Static shapes everywhere**: top-1 (switch) or top-2 (GShard) routing with a
+  fixed per-expert capacity ``C = ceil(top_k * tokens/E * capacity_factor)``
+  (GShard scales capacity with k, else second choices mostly drop); the
+  dispatch is a dense
   scatter into an ``[E, C, H]`` buffer (XLA-friendly one-hot + cumsum position
   assignment, no dynamic shapes), tokens over capacity are DROPPED and ride the
   residual connection (standard switch semantics).
@@ -55,13 +57,16 @@ class MoELayer:
     def __init__(self, hidden: int, ffn_dim: int, num_experts: int,
                  capacity_factor: float = 1.25,
                  expert_axis: Optional[str] = None,
-                 group_size: Optional[int] = None):
+                 group_size: Optional[int] = None,
+                 top_k: int = 1):
+        assert top_k in (1, 2), "top_k must be 1 (switch) or 2 (GShard)"
         self.hidden = hidden
         self.ffn_dim = ffn_dim
         self.num_experts = num_experts
         self.capacity_factor = float(capacity_factor)
         self.expert_axis = expert_axis
         self.group_size = group_size
+        self.top_k = top_k
 
     # ------------------------------------------------------------------ params
     def init(self, rng, x=None):
@@ -86,29 +91,56 @@ class MoELayer:
                 "w_in": ex, "b_in": ex, "w_out": ex, "b_out": ex}
 
     # ---------------------------------------------------------------- routing
-    def _route(self, x2, gate_w, capacity):
-        """Top-1 dispatch plan for flat tokens ``x2 [N, H]``.
+    @staticmethod
+    def _queue_positions(onehot, capacity, base=None):
+        """0-based per-expert queue position of each chosen token ([N, E] one-hot),
+        optionally starting after ``base`` already-filled slots per expert.
+        Returns (dispatch [N, E, C] slot one-hot, keep [N, E])."""
+        pos = jnp.cumsum(onehot, axis=0) * onehot - onehot
+        if base is not None:
+            pos = pos + base[None, :] * onehot
+        keep = (pos < capacity) * onehot
+        dispatch = keep[..., None] * jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                                                    dtype=jnp.float32)
+        return dispatch, keep
 
-        Returns (dispatch [N, E, C] one-hot, combine [N, E, C] prob-weighted,
-        aux_loss scalar). All shapes static."""
+    def _route(self, x2, gate_w, capacity):
+        """Dispatch plan for flat tokens ``x2 [N, H]``: top-1 (switch) or top-2
+        (GShard — second choices queue after ALL first choices per expert, gate
+        weights normalized over the two picks).
+
+        Returns (dispatch [N, E, C] slot one-hot, combine [N, E, C] prob-weighted,
+        (f, p) balancing statistics). All shapes static."""
         E = self.num_experts
         logits = jnp.dot(x2.astype(jnp.float32), gate_w.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)                     # [N, E]
-        expert = jnp.argmax(probs, axis=-1)                         # [N]
-        onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)       # [N, E]
-        # position of each token within its expert's queue (0-based; non-chosen
-        # entries read 0 but are masked by ``keep`` below)
-        pos = jnp.cumsum(onehot, axis=0) * onehot - onehot          # [N, E]
-        keep = (pos < capacity) * onehot                            # drop overflow
-        dispatch = keep[..., None] * jax.nn.one_hot(pos.astype(jnp.int32), capacity,
-                                                    dtype=jnp.float32)  # [N,E,C]
-        gate_p = jnp.sum(probs * onehot, axis=-1)                   # [N]
-        combine = dispatch * gate_p[:, None, None]
-        # Switch load-balancing loss over the LOCAL shard; callers under
-        # shard_map psum the (f, p) statistics so the term is global
-        f = jnp.mean(onehot, axis=0)                                # [E]
+        expert1 = jnp.argmax(probs, axis=-1)                        # [N]
+        onehot1 = jax.nn.one_hot(expert1, E, dtype=jnp.float32)     # [N, E]
+        d1, keep1 = self._queue_positions(onehot1, capacity)
+        p1 = jnp.sum(probs * onehot1, axis=-1)                      # [N]
+        # Switch load-balancing statistics over first choices; callers under
+        # shard_map pmean the (f, p) pair so the term is global
+        f = jnp.mean(onehot1, axis=0)                               # [E]
         p = jnp.mean(probs, axis=0)                                 # [E]
-        return dispatch, combine, (f, p)
+        if self.top_k == 1:
+            return d1, d1 * p1[:, None, None], (f, p)
+
+        probs2 = probs * (1.0 - onehot1)                            # mask the winner
+        expert2 = jnp.argmax(probs2, axis=-1)
+        onehot2 = jax.nn.one_hot(expert2, E, dtype=jnp.float32)
+        # a saturated router (p(winner) == 1.0 in fp32) leaves probs2 all-zero and
+        # argmax would fabricate expert 0 as a phantom second choice that burns a
+        # real capacity slot — mask zero-probability picks
+        onehot2 = onehot2 * (jnp.max(probs2, axis=-1) > 0)[:, None]
+        # second choices fill slots AFTER every first-choice token of that expert
+        # (GShard's two-pass assignment; keeps first choices drop-free longest)
+        first_counts = jnp.sum(keep1, axis=0)                       # [E]
+        d2, _ = self._queue_positions(onehot2, capacity, base=first_counts)
+        p2 = jnp.sum(probs * onehot2, axis=-1)
+        denom = jnp.maximum(p1 + p2, 1e-9)
+        combine = (d1 * (p1 / denom)[:, None, None]
+                   + d2 * (p2 / denom)[:, None, None])
+        return d1 + d2, combine, (f, p)
 
     @staticmethod
     def _expert_ffn(w_in, b_in, w_out, b_out, buf):
@@ -135,7 +167,8 @@ class MoELayer:
             g = self.group_size if (self.group_size and N % self.group_size == 0
                                     and N > self.group_size) else N
             G = N // g
-            capacity = max(1, int(math.ceil(g / E * self.capacity_factor)))
+            capacity = max(1, int(math.ceil(
+                g / E * self.capacity_factor * self.top_k)))
             xg = x2.reshape(G, g, H)
 
             def route_group(xr):
@@ -164,7 +197,7 @@ class MoELayer:
         # to C of its local tokens to any expert; an expert processes ep*C slots
         # total (= the global capacity). Local overflow drops even if other ranks
         # underuse their slots — the standard static-shape trade.
-        capacity = max(1, int(math.ceil(N / E * self.capacity_factor)))
+        capacity = max(1, int(math.ceil(N / E * self.capacity_factor * self.top_k)))
         # shard_map hands the expert-sharded leaves as [E_local, ...] slices
         gate_w = params["gate_w"]
         dispatch, combine, (f, p) = self._route(x2, gate_w, capacity)

@@ -111,6 +111,12 @@ PRESCALE_GRADIENTS_DEFAULT = False
 FUSED_STEP = "fused_step"
 FUSED_STEP_DEFAULT = False
 
+# Persistent XLA compilation cache directory (TPU-native extension). Cuts large-
+# model recompiles across processes/restarts to seconds; measured 13.0s -> 1.4s
+# for a warm cross-process compile through the remote-compile relay.
+COMPILATION_CACHE_DIR = "compilation_cache_dir"
+COMPILATION_CACHE_DIR_DEFAULT = None
+
 GRADIENT_PREDIVIDE_FACTOR = "gradient_predivide_factor"
 GRADIENT_PREDIVIDE_FACTOR_DEFAULT = 1.0
 

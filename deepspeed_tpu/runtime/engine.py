@@ -178,6 +178,12 @@ class DeepSpeedEngine:
             assert config_file is not None, "DeepSpeed requires --deepspeed_config or config_params"
             self.config = DeepSpeedConfig(config_file, world_size=self.dp_size)
 
+        # ---- persistent compilation cache (opt-in; see constants.py) ----
+        if self.config.compilation_cache_dir:
+            jax.config.update("jax_compilation_cache_dir",
+                              str(self.config.compilation_cache_dir))
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
         # ---- model function + params ----
         assert model is not None, "deepspeed.initialize requires a model"
         if hasattr(model, "apply"):
