@@ -416,6 +416,121 @@ class GPT2Model:
         ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         return -jnp.mean(ll) + aux
 
+    # ------------------------------------------------------------- generation
+    def generate(self, params, tokens, max_new_tokens: int,
+                 temperature: float = 0.0, rng=None):
+        """Autoregressive decode with per-layer KV caches: one jitted prefill over
+        the prompt, then a ``lax.scan`` of single-token steps that append to
+        static-length caches (no recompilation per step, no O(T²) re-forward).
+        ``temperature == 0`` is greedy; otherwise categorical sampling with ``rng``.
+        Eval semantics (no dropout). Dense configs decode EXACTLY as the full
+        re-forward would; MoE configs route each decode step's B tokens with a
+        per-step capacity, so outputs match the full forward only while capacity
+        does not bind (raise moe_capacity_factor for decode if exactness matters).
+        Not for manual-TP / sequence-parallel model copies. The jitted prefill and
+        decode programs are cached on the model per (shape, temperature) signature."""
+        assert self.tp_axis is None and self.seq_axis is None, \
+            "generate() supports the plain (non-shard_map) model"
+        assert max_new_tokens >= 1, f"max_new_tokens must be >= 1 (got {max_new_tokens})"
+        c = self.config
+        B, T0 = tokens.shape
+        max_len = T0 + int(max_new_tokens)
+        assert max_len <= c.n_positions, \
+            f"prompt {T0} + {max_new_tokens} new tokens exceeds n_positions {c.n_positions}"
+        nh, hd = c.n_head, c.head_dim
+        if temperature > 0:
+            assert rng is not None, "temperature > 0 requires an rng key"
+
+        def attn_cached(x, bp, kc, vc, pos):
+            """x [B, Tn, E]; kc/vc [B, nh, max_len, hd]; ``pos`` tokens cached."""
+            B_, Tn, _ = x.shape
+            qkv = jnp.dot(x, bp["c_attn_w"].astype(x.dtype),
+                          preferred_element_type=jnp.float32).astype(x.dtype) \
+                + bp["c_attn_b"].astype(x.dtype)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B_, Tn, nh, hd).transpose(0, 2, 1, 3)
+            k = k.reshape(B_, Tn, nh, hd).transpose(0, 2, 1, 3)
+            v = v.reshape(B_, Tn, nh, hd).transpose(0, 2, 1, 3)
+            kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, 0, pos, 0))
+            vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, 0, pos, 0))
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
+                           preferred_element_type=jnp.float32) / math.sqrt(hd)
+            j = jnp.arange(max_len)[None, :]
+            i = pos + jnp.arange(Tn)[:, None]
+            s = jnp.where(j <= i, s, jnp.float32(-1e9))  # causal + not-yet-written mask
+            p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+            y = jnp.einsum("bhqk,bhkd->bhqd", p, vc,
+                           preferred_element_type=jnp.float32).astype(x.dtype)
+            y = y.transpose(0, 2, 1, 3).reshape(B_, Tn, nh * hd)
+            return (jnp.dot(y, bp["c_proj_w"].astype(x.dtype),
+                            preferred_element_type=jnp.float32).astype(x.dtype)
+                    + bp["c_proj_b"].astype(x.dtype)), kc, vc
+
+        def forward(p, toks, pos, kcs, vcs):
+            """toks [B, Tn] -> (last-position logits, updated caches)."""
+            Tn = toks.shape[1]
+            positions = pos + jnp.arange(Tn)
+            x = p["wte"][toks].astype(c.compute_dtype) \
+                + p["wpe"][positions].astype(c.compute_dtype)
+            new_k, new_v = [], []
+            for li, bp in enumerate(p["blocks"]):
+                a, kc, vc = attn_cached(
+                    self._layer_norm(x, bp["ln_1"], c.layer_norm_epsilon),
+                    bp["attn"], kcs[li], vcs[li], pos)
+                x = x + a
+                h = self._layer_norm(x, bp["ln_2"], c.layer_norm_epsilon)
+                m = (self._moe.apply(bp["moe"], h)[0] if "moe" in bp
+                     else self._mlp(h, bp["mlp"]))
+                x = x + m
+                new_k.append(kc)
+                new_v.append(vc)
+            x = self._layer_norm(x, p["ln_f"], c.layer_norm_epsilon)
+            logits = jnp.dot(x[:, -1], p["wte"].T.astype(x.dtype),
+                             preferred_element_type=jnp.float32)
+            return logits, jnp.stack(new_k), jnp.stack(new_v)
+
+        out_dtype = tokens.dtype
+
+        def sample(logits, key):
+            if temperature == 0:
+                return jnp.argmax(logits, axis=-1).astype(out_dtype)
+            return jax.random.categorical(
+                key, logits / jnp.float32(temperature), axis=-1).astype(out_dtype)
+
+        def decode(p, first, kcs, vcs, keys):
+            def step(carry, key):
+                tok, pos, kcs, vcs = carry
+                logits, kcs, vcs = forward(p, tok[:, None], pos, kcs, vcs)
+                nxt = sample(logits, key)
+                return (nxt, pos + 1, kcs, vcs), tok
+
+            (last, _, _, _), outs = jax.lax.scan(
+                step, (first, jnp.asarray(T0, jnp.int32), kcs, vcs), keys)
+            # outs collects each step's INPUT token; the final sample is `last`
+            return jnp.concatenate([outs.T, last[:, None]], axis=1)
+
+        # one compile per (shape, temperature) signature, reused across calls —
+        # params are explicit jit arguments, not closure captures
+        sig = (B, T0, int(max_new_tokens), float(temperature), str(out_dtype))
+        cache = getattr(self, "_gen_jit_cache", None)
+        if cache is None:
+            cache = self._gen_jit_cache = {}
+        if sig not in cache:
+            cache[sig] = (jax.jit(forward), jax.jit(decode))
+        jit_forward, jit_decode = cache[sig]
+
+        cache_shape = (c.n_layer, B, nh, max_len, hd)
+        kcs = jnp.zeros(cache_shape, c.compute_dtype)
+        vcs = jnp.zeros(cache_shape, c.compute_dtype)
+        logits, kcs, vcs = jit_forward(params, tokens, 0, kcs, vcs)
+        keys = jax.random.split(rng if rng is not None else jax.random.PRNGKey(0),
+                                max_new_tokens)
+        first = sample(logits, keys[0])
+        if max_new_tokens == 1:
+            return jnp.concatenate([tokens, first[:, None]], axis=1)
+        gen = jit_decode(params, first, kcs, vcs, keys[1:])
+        return jnp.concatenate([tokens, gen], axis=1)
+
     def param_count(self, params) -> int:
         from ..runtime.utils import param_count
         return param_count(params)
