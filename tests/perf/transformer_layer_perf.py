@@ -18,7 +18,7 @@ import jax.numpy as jnp
 sys.path.insert(0, ".")
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from devtime import timeit_slope  # noqa: E402
+from devtime import timeit_slope_stats  # noqa: E402
 from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,  # noqa: E402
                                            DeepSpeedTransformerLayer)
 
@@ -50,9 +50,10 @@ def main():
             return jnp.sum(out.astype(jnp.float32) ** 2)
 
         g = lambda x, params: jax.grad(loss, argnums=(0, 1))(x, params)[0]
-        dt = timeit_slope(g, x, params, n1=10, n2=50)
+        dt, sp, sc = timeit_slope_stats(g, x, params, n1=10, n2=50)
         fl = layer_flops(batch, seq, H, I, NH)
-        print(f"seq={seq} batch={batch}: {dt*1e3:.3f} ms  {fl/dt/1e12:.1f} TF/s "
+        print(f"seq={seq} batch={batch}: {dt*1e3:.3f} ms ±{sp:.1%} (x{sc})  "
+              f"{fl/dt/1e12:.1f} TF/s "
               f"(reference V100 claim: {64 if seq == 128 else 53} TFLOPS)")
 
 
