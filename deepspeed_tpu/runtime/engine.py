@@ -243,7 +243,15 @@ class DeepSpeedEngine:
         # positional input is something else.
         self._sparse_tokens_fn = getattr(model, "sparse_grad_tokens", None)
         if (self.config.sparse_gradients_enabled and not self._use_stacked_grads
-                and param_shardings is None):
+                and zero_stage >= 3):
+            # the sparse-reduction shard_map pins replicated param in_specs, which
+            # would all-gather the stage-3 sharded params every step — dense
+            # reduction keeps the gather at use points only
+            logger.warning("[deepspeed_tpu] sparse_gradients is inactive under ZeRO "
+                           "stage 3 (sharded parameters); using dense gradient "
+                           "reduction")
+        if (self.config.sparse_gradients_enabled and not self._use_stacked_grads
+                and param_shardings is None and zero_stage < 3):
             patterns = tuple(getattr(model, "sparse_grad_paths", lambda: ())())
             if patterns:
                 from .sparse_tensor import match_sparse_paths
@@ -267,14 +275,24 @@ class DeepSpeedEngine:
             # caller-provided layout (pipe-stacked stages, TP-sharded weights, ...);
             # ZeRO composes on top by claiming a free data-divisible axis per leaf
             from .zero.sharding import merge_zero_into
-            self._param_shardings = param_shardings
             self._master_shardings = merge_zero_into(self.mesh, param_shardings, master_fp32,
                                                      zero_stage)
+            # stage 3: compute params adopt the merged (caller + data-axis) layout —
+            # full parameter sharding on top of pipe/TP
+            self._param_shardings = (self._master_shardings if zero_stage >= 3
+                                     else param_shardings)
             self._grad_shardings = (self._master_shardings if zero_stage >= 2
                                     else param_shardings)
         else:
             self._master_shardings = zero_sharding(self.mesh, master_fp32, zero_stage)
-            self._param_shardings = replicated_sharding(self.mesh, master_fp32)
+            # stage 3 (parameter sharding — beyond the v0.3.0 reference, which stops
+            # at stage 2): the bf16 compute params themselves carry the data-axis
+            # layout; XLA all-gathers each leaf at its use point in forward/backward
+            # (the later ZeRO-3's gather-on-use, as a GSPMD annotation) and the
+            # updated master casts back to the SAME sharded layout — per-device
+            # param HBM scales as 1/dp.
+            self._param_shardings = (self._master_shardings if zero_stage >= 3
+                                     else replicated_sharding(self.mesh, master_fp32))
             if self._use_stacked_grads:
                 self._grad_shardings = jax.tree_util.tree_map(
                     lambda _: NamedSharding(self.mesh, P(DATA_AXIS)), master_fp32)
@@ -292,8 +310,9 @@ class DeepSpeedEngine:
             self._zero_sharded_fraction = sharded_b / max(total_b, 1)
             log_dist(
                 f"ZeRO-{zero_stage}: {sharded_b / 2**20:.1f}/{total_b / 2**20:.1f} MiB "
-                f"({self._zero_sharded_fraction:.1%}) of master+optimizer state sharded "
-                f"over data={self.dp_size}"
+                f"({self._zero_sharded_fraction:.1%}) of master+optimizer"
+                + ("+parameter" if zero_stage >= 3 else "")
+                + f" state sharded over data={self.dp_size}"
                 + ("" if self._zero_sharded_fraction > 0.9 else
                    " — mostly REPLICATED (no dp-divisible axes / leaves under min_size);"
                    " per-rank memory will not scale as 1/dp"),

@@ -1,0 +1,117 @@
+"""ZeRO Stage 3 — full parameter sharding (beyond the v0.3.0 reference).
+
+The reference stops at stage 2 (runtime/zero/constants.py MAX_STAGE = gradients);
+stage 3 (the later ZeRO-3 / FSDP) shards the compute parameters themselves over the
+data axis. On TPU that is a GSPMD layout: ``zero_spec`` annotates the bf16 params,
+XLA all-gathers each leaf at its use point in forward/backward, grads live
+reduce-scattered (stage-2 layout), and the updated fp32 master casts back into the
+sharded param layout — per-device parameter HBM scales as 1/dp with no hand-rolled
+gather/partition machinery (the reference's stage2.py flatten/partition analog).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel.mesh import DATA_AXIS, build_mesh
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.utils.hlo import collective_counts, optimized_hlo
+
+from simple_model import SimpleModel, random_dataset, simple_config
+
+
+H = 64  # dp=8-divisible so every weight matrix shards
+
+
+def _engine(stage, hidden=H, batch=8, **cfg):
+    model = SimpleModel(hidden)
+    params = model.init(jax.random.PRNGKey(0))
+    return DeepSpeedEngine(
+        model=model, model_parameters=params,
+        config_params=simple_config(batch=batch, zero_optimization={"stage": stage},
+                                    bf16={"enabled": True}, **cfg))
+
+
+def _run_steps(eng, n=5, hidden=H, batch=8):
+    data = random_dataset(batch * n, hidden)
+    losses = []
+    for i in range(n):
+        xs = np.stack([data[i * batch + j][0] for j in range(batch)])
+        ys = np.stack([data[i * batch + j][1] for j in range(batch)])
+        loss = eng.forward(xs, ys)
+        eng.backward(loss)
+        eng.step()
+        losses.append(float(loss))
+    return losses
+
+
+def test_zero3_shards_compute_params():
+    eng = _engine(3)
+    mats = [(k, v) for k, v in eng.params.items() if v.ndim == 2]
+    assert mats
+    for name, leaf in mats:
+        assert not leaf.sharding.is_fully_replicated, f"{name} not sharded under stage 3"
+        # per-device shard holds 1/dp of the leaf
+        local = leaf.addressable_shards[0].data.size
+        assert local * 8 == leaf.size, (name, local, leaf.size)
+    # stage 2 leaves compute params replicated — the stage-3 delta is exactly the params
+    eng2 = _engine(2)
+    for _, leaf in [(k, v) for k, v in eng2.params.items() if v.ndim == 2]:
+        assert leaf.sharding.is_fully_replicated
+
+
+def test_zero3_trains_and_matches_stage0():
+    """Same init + data: stage 3 is a layout, not an algorithm — losses must track
+    the replicated stage-0 run to float tolerance."""
+    l3 = _run_steps(_engine(3))
+    l0 = _run_steps(_engine(0))
+    assert l3[-1] < l3[0], l3
+    np.testing.assert_allclose(l3, l0, rtol=2e-2, atol=2e-3)
+
+
+def test_zero3_forward_all_gathers_params():
+    """The compiled train step must materialize sharded params via all-gather at use
+    (ZeRO-3's gather-on-use, emitted by the partitioner instead of hand-rolled)."""
+    eng = _engine(3)
+    x = jnp.ones((8, H))
+    txt = optimized_hlo(eng._jit_loss_and_grad, eng.params,
+                        eng.scaler_state.cur_scale, x, x)
+    counts = collective_counts(txt)
+    assert counts.get("all-gather", 0) >= 1, \
+        f"stage-3 forward/backward has no param all-gather: {counts}"
+
+
+def test_zero3_checkpoint_roundtrip(tmp_path):
+    eng = _engine(3)
+    _run_steps(eng, n=3)
+    eng.save_checkpoint(str(tmp_path), tag="z3")
+    ref = jax.tree_util.tree_map(np.asarray, eng.params)
+
+    eng2 = _engine(3)
+    eng2.load_checkpoint(str(tmp_path), tag="z3")
+    for a, b in zip(jax.tree_util.tree_leaves(ref),
+                    jax.tree_util.tree_leaves(eng2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # restored params keep the stage-3 sharded layout
+    for k, v in eng2.params.items():
+        if v.ndim == 2:
+            assert not v.sharding.is_fully_replicated
+
+
+def test_zero3_config_validation():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "bf16": {"enabled": True},
+                           "zero_optimization": {"stage": 3}}, world_size=8)
+    assert cfg.zero_optimization_stage == 3
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig({"train_batch_size": 8, "bf16": {"enabled": True},
+                         "zero_optimization": {"stage": 4}}, world_size=8)
+    with pytest.raises(AssertionError):
+        # cpu_offload remains a stage-2 feature (reference parity)
+        DeepSpeedConfig({"train_batch_size": 8, "bf16": {"enabled": True},
+                         "zero_optimization": {"stage": 3, "cpu_offload": True}},
+                        world_size=8)
