@@ -418,17 +418,22 @@ class GPT2Model:
 
     # ------------------------------------------------------------- generation
     def generate(self, params, tokens, max_new_tokens: int,
-                 temperature: float = 0.0, rng=None):
+                 temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+                 rng=None):
         """Autoregressive decode with per-layer KV caches: one jitted prefill over
         the prompt, then a ``lax.scan`` of single-token steps that append to
         static-length caches (no recompilation per step, no O(T²) re-forward).
-        ``temperature == 0`` is greedy; otherwise categorical sampling with ``rng``.
+        ``temperature == 0`` is greedy; otherwise categorical sampling with ``rng``,
+        optionally truncated to the ``top_k`` highest-probability tokens and/or the
+        nucleus of smallest-count tokens whose cumulative probability reaches
+        ``top_p`` (both filters compose; at least the argmax token always survives).
         Eval semantics (no dropout). Dense configs decode EXACTLY as the full
         re-forward would; MoE configs route each decode step's B tokens with a
         per-step capacity, so outputs match the full forward only while capacity
         does not bind (raise moe_capacity_factor for decode if exactness matters).
         Not for manual-TP / sequence-parallel model copies. The jitted prefill and
-        decode programs are cached on the model per (shape, temperature) signature."""
+        decode programs are cached on the model per (shape, temperature, top_k,
+        top_p) signature."""
         assert self.tp_axis is None and self.seq_axis is None, \
             "generate() supports the plain (non-shard_map) model"
         assert max_new_tokens >= 1, f"max_new_tokens must be >= 1 (got {max_new_tokens})"
@@ -440,6 +445,8 @@ class GPT2Model:
         nh, hd = c.n_head, c.head_dim
         if temperature > 0:
             assert rng is not None, "temperature > 0 requires an rng key"
+        assert top_k >= 0, f"top_k must be >= 0 (got {top_k})"
+        assert 0.0 < top_p <= 1.0, f"top_p must be in (0, 1] (got {top_p})"
 
         def attn_cached(x, bp, kc, vc, pos):
             """x [B, Tn, E]; kc/vc [B, nh, max_len, hd]; ``pos`` tokens cached."""
@@ -494,8 +501,22 @@ class GPT2Model:
         def sample(logits, key):
             if temperature == 0:
                 return jnp.argmax(logits, axis=-1).astype(out_dtype)
-            return jax.random.categorical(
-                key, logits / jnp.float32(temperature), axis=-1).astype(out_dtype)
+            logits = logits / jnp.float32(temperature)
+            if top_k > 0 and top_k < c.vocab_size:
+                kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+                logits = jnp.where(logits < kth, jnp.float32(-jnp.inf), logits)
+            if top_p < 1.0:
+                sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+                probs = jax.nn.softmax(sorted_logits, axis=-1)
+                # exclusive cumulative mass BEFORE each token: a token stays while
+                # the mass ahead of it is under top_p, so the kept set is the
+                # smallest prefix reaching top_p (the argmax always stays)
+                mass_before = jnp.cumsum(probs, axis=-1) - probs
+                kept = mass_before < top_p
+                cutoff = jnp.sum(kept, axis=-1, keepdims=True) - 1
+                threshold = jnp.take_along_axis(sorted_logits, cutoff, axis=-1)
+                logits = jnp.where(logits < threshold, jnp.float32(-jnp.inf), logits)
+            return jax.random.categorical(key, logits, axis=-1).astype(out_dtype)
 
         def decode(p, first, kcs, vcs, keys):
             def step(carry, key):
@@ -511,7 +532,8 @@ class GPT2Model:
 
         # one compile per (shape, temperature) signature, reused across calls —
         # params are explicit jit arguments, not closure captures
-        sig = (B, T0, int(max_new_tokens), float(temperature), str(out_dtype))
+        sig = (B, T0, int(max_new_tokens), float(temperature), int(top_k),
+               float(top_p), str(out_dtype))
         cache = getattr(self, "_gen_jit_cache", None)
         if cache is None:
             cache = self._gen_jit_cache = {}

@@ -243,10 +243,12 @@ class DeepSpeedEngine:
         # positional input is something else.
         self._sparse_tokens_fn = getattr(model, "sparse_grad_tokens", None)
         if (self.config.sparse_gradients_enabled and not self._use_stacked_grads
-                and zero_stage >= 3):
+                and param_shardings is None and zero_stage >= 3):
             # the sparse-reduction shard_map pins replicated param in_specs, which
             # would all-gather the stage-3 sharded params every step — dense
-            # reduction keeps the gather at use points only
+            # reduction keeps the gather at use points only. (With caller-provided
+            # param_shardings sparse reduction was never available; don't blame
+            # the stage there.)
             logger.warning("[deepspeed_tpu] sparse_gradients is inactive under ZeRO "
                            "stage 3 (sharded parameters); using dense gradient "
                            "reduction")

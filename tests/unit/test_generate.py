@@ -54,6 +54,43 @@ def test_generate_sampling_and_bounds():
     assert one.shape == (3, 6)
 
 
+def test_top_k_samples_stay_in_the_top_k_set():
+    """Teacher-forcing check: every sampled token must be among the top-k of the
+    full-forward oracle logits for its prefix (and in the nucleus for top_p)."""
+    cfg = GPT2Config(vocab_size=64, n_positions=32, n_embd=32, n_layer=2, n_head=2,
+                     compute_dtype=jnp.float32)
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(8))
+    prompt = jnp.asarray(np.random.default_rng(9).integers(0, 64, (2, 4)), jnp.int32)
+    k = 5
+    out = np.asarray(model.generate(params, prompt, max_new_tokens=8, temperature=1.0,
+                                    top_k=k, rng=jax.random.PRNGKey(10)))
+    for t in range(4, 12):
+        logits = np.asarray(model.apply(params, jnp.asarray(out[:, :t])))[:, -1]
+        topk = np.argsort(logits, axis=-1)[:, -k:]
+        for b in range(out.shape[0]):
+            assert out[b, t] in topk[b], (b, t, out[b, t], topk[b])
+
+
+def test_top_p_tiny_nucleus_is_greedy():
+    """top_p small enough that only the argmax survives -> sampling == greedy,
+    regardless of temperature; same for top_k=1."""
+    cfg = GPT2Config(vocab_size=64, n_positions=32, n_embd=32, n_layer=2, n_head=2,
+                     compute_dtype=jnp.float32)
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(11))
+    prompt = jnp.asarray(np.random.default_rng(12).integers(0, 64, (2, 4)), jnp.int32)
+    greedy = np.asarray(model.generate(params, prompt, max_new_tokens=6))
+    nucleus = np.asarray(model.generate(params, prompt, max_new_tokens=6,
+                                        temperature=1.3, top_p=1e-6,
+                                        rng=jax.random.PRNGKey(13)))
+    np.testing.assert_array_equal(greedy, nucleus)
+    topk1 = np.asarray(model.generate(params, prompt, max_new_tokens=6,
+                                      temperature=0.7, top_k=1,
+                                      rng=jax.random.PRNGKey(14)))
+    np.testing.assert_array_equal(greedy, topk1)
+
+
 def test_generate_reuses_compiled_programs():
     cfg = GPT2Config(vocab_size=64, n_positions=32, n_embd=32, n_layer=2, n_head=2,
                      compute_dtype=jnp.float32)
