@@ -242,16 +242,19 @@ class DeepSpeedEngine:
         # tensor, which silently mis-sizes the row capacity for models whose first
         # positional input is something else.
         self._sparse_tokens_fn = getattr(model, "sparse_grad_tokens", None)
-        if (self.config.sparse_gradients_enabled and not self._use_stacked_grads
-                and param_shardings is None and zero_stage >= 3):
-            # the sparse-reduction shard_map pins replicated param in_specs, which
-            # would all-gather the stage-3 sharded params every step — dense
-            # reduction keeps the gather at use points only. (With caller-provided
-            # param_shardings sparse reduction was never available; don't blame
-            # the stage there.)
-            logger.warning("[deepspeed_tpu] sparse_gradients is inactive under ZeRO "
-                           "stage 3 (sharded parameters); using dense gradient "
-                           "reduction")
+        if self.config.sparse_gradients_enabled and not self._use_stacked_grads:
+            # the sparse-reduction shard_map pins replicated param in_specs, so it
+            # is unavailable whenever params are sharded: under stage 3 (it would
+            # all-gather the sharded params every step — dense reduction keeps the
+            # gather at use points only) and under caller-provided layouts
+            if param_shardings is not None:
+                logger.warning("[deepspeed_tpu] sparse_gradients is inactive with "
+                               "caller-provided param_shardings; using dense "
+                               "gradient reduction")
+            elif zero_stage >= 3:
+                logger.warning("[deepspeed_tpu] sparse_gradients is inactive under "
+                               "ZeRO stage 3 (sharded parameters); using dense "
+                               "gradient reduction")
         if (self.config.sparse_gradients_enabled and not self._use_stacked_grads
                 and param_shardings is None and zero_stage < 3):
             patterns = tuple(getattr(model, "sparse_grad_paths", lambda: ())())
@@ -639,6 +642,7 @@ class DeepSpeedEngine:
     def _compile_steps(self):
         self._run_fused_step = None   # set on the fused gas==1 paths below
         self._fused_pending = None
+        self._jit_fused = None        # the fused jit object, for flops_profile
         grad_acc_steps = self.gradient_accumulation_steps()
         fp16 = self.fp16_enabled()
         clip = float(self.gradient_clipping() or 0.0)
@@ -936,6 +940,7 @@ class DeepSpeedEngine:
                     out_shardings=(scalar_shard, self._opt_shardings, scaler_shards,
                                    scalar_shard, scalar_shard),
                     donate_argnums=(0,))
+                self._jit_fused = jit_fused  # exposed for flops_profile
 
                 def run_fused(batch):
                     step_no = jnp.asarray(self.global_steps + 1 - self.skipped_steps,
@@ -982,6 +987,7 @@ class DeepSpeedEngine:
                                self._opt_shardings, scaler_shards,
                                self._param_shardings, scalar_shard, scalar_shard),
                 donate_argnums=(0, 1, 3))
+            self._jit_fused = jit_fused_std  # exposed for flops_profile
 
             def run_fused_std(batch):
                 step_no = jnp.asarray(self.global_steps + 1 - self.skipped_steps,
@@ -1305,6 +1311,66 @@ class DeepSpeedEngine:
         if getattr(self, "_external_master", False):
             return tree
         return jax.device_put(tree, self._master_shardings)
+
+    def flops_profile(self, *inputs, peak_tflops=None):
+        """Cost analysis of THIS engine's compiled train step (fwd + bwd + update)
+        from XLA's own numbers — see ``utils/flops_profiler.py``. ``inputs`` is one
+        micro-batch (host arrays fine; shapes are what matter). Under ZeRO-Offload
+        the optimizer update runs on the host tier and only the device programs are
+        counted. Returns the report dict (add ``peak_tflops`` for the roofline step
+        time). ``report["flops"]`` covers one micro-batch plus one optimizer
+        update; for gradient_accumulation_steps > 1 aggregate from
+        ``report["program_flops"]``: ``gas * loss_and_grad + apply_update``
+        (the update runs once per window)."""
+        from ..utils.flops_profiler import profile as _profile
+        batch = tuple(self.shard_batch(x) if not isinstance(x, jax.Array) else x
+                      for x in inputs)
+        step_no = jnp.asarray(1, jnp.int32)
+        hyper = self.optimizer.current_hyper()
+        if self._jit_fused is not None:
+            if self._external_master:
+                args = (self.opt_state, self.scaler_state, self.params, step_no,
+                        hyper) + batch
+            else:
+                args = (self.master_params, self.opt_state, self.scaler_state,
+                        self.params, step_no, hyper) + batch
+            report = _profile(self._jit_fused, *args, peak_tflops=peak_tflops)
+            report["programs"] = ["fused_step"]
+            report["program_flops"] = {"fused_step": report["flops"]}
+        else:
+            report = _profile(self._jit_loss_and_grad, self.params,
+                              self.scaler_state.cur_scale, *batch,
+                              peak_tflops=peak_tflops)
+            report["programs"] = ["loss_and_grad"]
+            report["program_flops"] = {"loss_and_grad": report["flops"]}
+            if self._offload is None:
+                # shapes from self.params (identical tree), NOT the master_params
+                # property — under external-master that property materializes a
+                # full fp32 view on device, the exact HBM spike the mode avoids
+                grads = jax.tree_util.tree_map(
+                    lambda sh, l: jax.ShapeDtypeStruct(l.shape, self._acc_dtype,
+                                                       sharding=sh),
+                    self._grad_shardings, self.params)
+                if self._external_master:
+                    upd = _profile(self._jit_apply_update, self.opt_state,
+                                   self.scaler_state, grads, step_no, hyper)
+                else:
+                    upd = _profile(self._jit_apply_update, self.master_params,
+                                   self.opt_state, self.scaler_state, grads,
+                                   self.params, step_no, hyper)
+                for k in ("flops", "bytes_accessed"):
+                    report[k] += upd[k]
+                report["program_flops"]["apply_update"] = upd["flops"]
+                report["temp_bytes"] = max(report["temp_bytes"], upd["temp_bytes"])
+                report["arithmetic_intensity"] = (
+                    report["flops"] / report["bytes_accessed"]
+                    if report["bytes_accessed"] else 0.0)
+                if peak_tflops:
+                    report["optimal_seconds"] = report["flops"] / (peak_tflops * 1e12)
+                report["programs"].append("apply_update")
+        from .utils import param_count
+        report["params"] = param_count(self.params)
+        return report
 
     def save_checkpoint(self, save_dir, tag=None, client_state={}, save_latest=True):
         from ..checkpoint.checkpointing import save_checkpoint as _save
