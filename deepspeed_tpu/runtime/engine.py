@@ -1323,8 +1323,8 @@ class DeepSpeedEngine:
         ``report["program_flops"]``: ``gas * loss_and_grad + apply_update``
         (the update runs once per window)."""
         from ..utils.flops_profiler import profile as _profile
-        batch = tuple(self.shard_batch(x) if not isinstance(x, jax.Array) else x
-                      for x in inputs)
+        batch = tuple(x if isinstance(x, (jax.Array, jax.ShapeDtypeStruct))
+                      else self.shard_batch(x) for x in inputs)
         step_no = jnp.asarray(1, jnp.int32)
         hyper = self.optimizer.current_hyper()
         if self._jit_fused is not None:
@@ -1346,9 +1346,12 @@ class DeepSpeedEngine:
             if self._offload is None:
                 # shapes from self.params (identical tree), NOT the master_params
                 # property — under external-master that property materializes a
-                # full fp32 view on device, the exact HBM spike the mode avoids
+                # full fp32 view on device, the exact HBM spike the mode avoids.
+                # 1-bit Adam stacked grads carry a leading per-worker dp axis.
+                lead = (self.dp_size,) if self._use_stacked_grads else ()
                 grads = jax.tree_util.tree_map(
-                    lambda sh, l: jax.ShapeDtypeStruct(l.shape, self._acc_dtype,
+                    lambda sh, l: jax.ShapeDtypeStruct(lead + l.shape,
+                                                       self._acc_dtype,
                                                        sharding=sh),
                     self._grad_shardings, self.params)
                 if self._external_master:

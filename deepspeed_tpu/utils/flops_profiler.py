@@ -16,14 +16,6 @@ Works for any jittable fn, including the engine's compiled train step
 
 from typing import Any, Dict, Optional
 
-import numpy as np
-
-
-def _leaf_count(tree) -> int:
-    import jax
-    return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(tree)
-               if hasattr(l, "shape"))
-
 
 def profile(fn, *args, peak_tflops: Optional[float] = None,
             static_argnums=()) -> Dict[str, Any]:

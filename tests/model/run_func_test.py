@@ -19,6 +19,7 @@ CONFIGS = [
     "ds_config_func_bs8_no_zero.json",
     "ds_config_func_bs8_zero1.json",
     "ds_config_func_bs8_zero2.json",
+    "ds_config_func_bs8_zero3.json",
     "ds_config_func_bs16_zero2.json",
     "ds_config_func_bs16_zero2_gas2.json",
     "ds_config_func_bs8_zero2_offload.json",
@@ -51,10 +52,11 @@ def test_loss_decreases(config_name, tmp_path_factory):
 
 
 def test_zero_stages_agree(tmp_path_factory):
-    """ZeRO-1/2 and ZeRO-2+offload are pure memory optimizations: same data + seed must
-    give the same loss trajectory as the unpartitioned baseline (fp32 exact-ish)."""
+    """ZeRO-1/2/3 and ZeRO-2+offload are pure memory optimizations: same data + seed
+    must give the same loss trajectory as the unpartitioned baseline (fp32 exact-ish)."""
     base = [r["loss"] for r in _run("ds_config_func_bs8_no_zero.json", tmp_path_factory)[0]]
     for name in ("ds_config_func_bs8_zero1.json", "ds_config_func_bs8_zero2.json",
+                 "ds_config_func_bs8_zero3.json",
                  "ds_config_func_bs8_zero2_offload.json"):
         other = [r["loss"] for r in _run(name, tmp_path_factory)[0]]
         assert other == pytest.approx(base, rel=2e-3, abs=2e-3), \

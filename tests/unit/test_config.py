@@ -90,9 +90,13 @@ def test_zero_requires_mixed_precision_ok_with_bf16_default():
     assert cfg.zero_enabled and cfg.bf16_enabled
 
 
-def test_zero_stage3_rejected():
+def test_zero_stage_bounds():
+    # stage 3 (parameter sharding) is supported — beyond the v0.3.0 reference;
+    # stage 4 does not exist
+    cfg = DeepSpeedConfig(base_dict(zero_optimization={"stage": 3}), world_size=1)
+    assert cfg.zero_optimization_stage == 3
     with pytest.raises(AssertionError):
-        DeepSpeedConfig(base_dict(zero_optimization={"stage": 3}), world_size=1)
+        DeepSpeedConfig(base_dict(zero_optimization={"stage": 4}), world_size=1)
 
 
 def test_cpu_offload_requires_stage2():

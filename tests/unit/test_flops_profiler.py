@@ -57,6 +57,16 @@ def test_engine_flops_profile_two_jit():
     assert rpt["temp_bytes"] >= 0 and rpt["bytes_accessed"] > 0
 
 
+def test_engine_flops_profile_onebit_stacked_grads():
+    """1-bit Adam keeps per-worker grads stacked with a leading dp axis — the
+    profiler's gradient shape structs must carry it (regression: review r4)."""
+    eng = _engine(optimizer={"type": "OneBitAdam",
+                             "params": {"lr": 1e-3, "freeze_step": 4}})
+    x = np.zeros((B, H), np.float32)
+    rpt = eng.flops_profile(x, x)
+    assert rpt["flops"] > 0 and "apply_update" in rpt["programs"]
+
+
 def test_engine_flops_profile_fused():
     eng = _engine(fused_step=True, bf16={"enabled": True})
     assert eng._jit_fused is not None

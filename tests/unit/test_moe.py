@@ -269,6 +269,41 @@ def test_top2_gshard_matches_per_token_oracle():
     assert float(aux) > 0
 
 
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_scatter_dispatch_matches_einsum(mesh, top_k):
+    """The scatter/gather dispatch (row scatter-add + row gather — flops-cheap,
+    but slower than the default einsum on TPU, see PERF.md)
+    must reproduce the dense one-hot einsum dispatch bit-for-bit in fp32 —
+    dense apply, tight capacity (drops exercised), and the expert-parallel
+    all_to_all path; gradients too."""
+    cf = 0.6  # tight: forces capacity drops both modes must agree on
+    kw = dict(hidden=H, ffn_dim=F, num_experts=E, capacity_factor=cf, top_k=top_k)
+    l_sc = MoELayer(**kw, dispatch="scatter")
+    l_ei = MoELayer(**kw, dispatch="einsum")
+    params = l_sc.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, H), jnp.float32)
+
+    y_sc, aux_sc = l_sc.apply(params, x)
+    y_ei, aux_ei = l_ei.apply(params, x)
+    np.testing.assert_allclose(np.asarray(y_sc), np.asarray(y_ei),
+                               rtol=1e-5, atol=1e-5)
+    assert float(aux_sc) == pytest.approx(float(aux_ei))
+
+    g_sc = jax.grad(lambda p: jnp.sum(l_sc.apply(p, x)[0] ** 2))(params)
+    g_ei = jax.grad(lambda p: jnp.sum(l_ei.apply(p, x)[0] ** 2))(params)
+    for k in g_sc:
+        np.testing.assert_allclose(np.asarray(g_sc[k]), np.asarray(g_ei[k]),
+                                   rtol=1e-4, atol=1e-4)
+
+    # expert-parallel: both modes through the all_to_all path
+    l_sc_ep = MoELayer(**kw, dispatch="scatter", expert_axis="model")
+    l_ei_ep = MoELayer(**kw, dispatch="einsum", expert_axis="model")
+    y_sc_ep, _ = moe_apply_sharded(l_sc_ep, mesh, params, x)
+    y_ei_ep, _ = moe_apply_sharded(l_ei_ep, mesh, params, x)
+    np.testing.assert_allclose(np.asarray(y_sc_ep), np.asarray(y_ei_ep),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_top2_second_choice_queues_after_first(mesh):
     """Expert-parallel top-2 equals the dense-dispatch top-2 (the all_to_all path
     is routing-agnostic), and grads stay finite."""
