@@ -417,6 +417,167 @@ class GPT2Model:
         return -jnp.mean(ll) + aux
 
     # ------------------------------------------------------------- generation
+    def _build_cached_forward(self, max_len: int):
+        """Incremental forward over per-layer KV caches, shared by ``generate``
+        and ``beam_search``: ``forward(p, toks [B, Tn], pos, kcs, vcs) ->
+        (last-position logits [B, vocab] fp32, new_kcs, new_vcs)`` where
+        kcs/vcs are ``[n_layer, B, nh, max_len, hd]`` and ``pos`` counts the
+        tokens already cached."""
+        c = self.config
+        nh, hd = c.n_head, c.head_dim
+
+        def attn_cached(x, bp, kc, vc, pos):
+            B_, Tn, _ = x.shape
+            qkv = jnp.dot(x, bp["c_attn_w"].astype(x.dtype),
+                          preferred_element_type=jnp.float32).astype(x.dtype) \
+                + bp["c_attn_b"].astype(x.dtype)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B_, Tn, nh, hd).transpose(0, 2, 1, 3)
+            k = k.reshape(B_, Tn, nh, hd).transpose(0, 2, 1, 3)
+            v = v.reshape(B_, Tn, nh, hd).transpose(0, 2, 1, 3)
+            kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, 0, pos, 0))
+            vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, 0, pos, 0))
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
+                           preferred_element_type=jnp.float32) / math.sqrt(hd)
+            j = jnp.arange(max_len)[None, :]
+            i = pos + jnp.arange(Tn)[:, None]
+            s = jnp.where(j <= i, s, jnp.float32(-1e9))  # causal + not-yet-written mask
+            p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+            y = jnp.einsum("bhqk,bhkd->bhqd", p, vc,
+                           preferred_element_type=jnp.float32).astype(x.dtype)
+            y = y.transpose(0, 2, 1, 3).reshape(B_, Tn, nh * hd)
+            return (jnp.dot(y, bp["c_proj_w"].astype(x.dtype),
+                            preferred_element_type=jnp.float32).astype(x.dtype)
+                    + bp["c_proj_b"].astype(x.dtype)), kc, vc
+
+        def forward(p, toks, pos, kcs, vcs):
+            Tn = toks.shape[1]
+            positions = pos + jnp.arange(Tn)
+            x = p["wte"][toks].astype(c.compute_dtype) \
+                + p["wpe"][positions].astype(c.compute_dtype)
+            new_k, new_v = [], []
+            for li, bp in enumerate(p["blocks"]):
+                a, kc, vc = attn_cached(
+                    self._layer_norm(x, bp["ln_1"], c.layer_norm_epsilon),
+                    bp["attn"], kcs[li], vcs[li], pos)
+                x = x + a
+                h = self._layer_norm(x, bp["ln_2"], c.layer_norm_epsilon)
+                m = (self._moe.apply(bp["moe"], h)[0] if "moe" in bp
+                     else self._mlp(h, bp["mlp"]))
+                x = x + m
+                new_k.append(kc)
+                new_v.append(vc)
+            x = self._layer_norm(x, p["ln_f"], c.layer_norm_epsilon)
+            logits = jnp.dot(x[:, -1], p["wte"].T.astype(x.dtype),
+                             preferred_element_type=jnp.float32)
+            return logits, jnp.stack(new_k), jnp.stack(new_v)
+
+        return forward
+
+    def beam_search(self, params, tokens, max_new_tokens: int, num_beams: int = 4,
+                    eos_token_id=None, length_penalty: float = 1.0):
+        """KV-cached beam search: prefill once, expand to ``num_beams`` beams per
+        batch row, then a ``lax.scan`` of single-token steps that keeps the K
+        highest-scoring hypotheses (summed token log-probs). With
+        ``eos_token_id`` a finished beam is frozen (only the EOS continuation at
+        zero cost survives) and padded with EOS; scores are length-normalized by
+        ``len**length_penalty`` (GNMT convention) for the final ranking.
+        Returns ``(sequences [B, T0 + max_new_tokens], scores [B])`` — the best
+        beam per row. Same caching/compile discipline as ``generate``."""
+        assert self.tp_axis is None and self.seq_axis is None, \
+            "beam_search() supports the plain (non-shard_map) model"
+        assert max_new_tokens >= 1 and num_beams >= 1
+        c = self.config
+        B, T0 = tokens.shape
+        K = int(num_beams)
+        L = int(max_new_tokens)
+        max_len = T0 + L
+        assert max_len <= c.n_positions, \
+            f"prompt {T0} + {L} new tokens exceeds n_positions {c.n_positions}"
+        forward = self._build_cached_forward(max_len)
+        V = c.vocab_size
+        NEG = jnp.float32(-1e9)
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+
+        def step_scores(logits, scores, live):
+            """Per-beam next-token scores [B, K, V]: log-probs added to the beam
+            score; a finished beam admits only the EOS continuation, at no cost."""
+            logp = jax.nn.log_softmax(logits.reshape(B, K, V), axis=-1)
+            cand = scores[:, :, None] + logp
+            if eos >= 0:
+                frozen = jnp.full((B, K, V), NEG).at[:, :, eos].set(scores)
+                cand = jnp.where(live[:, :, None], cand, frozen)
+            return cand
+
+        def decode(p, first_logits, kcs, vcs):
+            # beam init: top-K first tokens per row from the prefill logits
+            logp0 = jax.nn.log_softmax(first_logits, axis=-1)      # [B, V]
+            scores, tok0 = jax.lax.top_k(logp0, K)                  # [B, K]
+            live = (tok0 != eos) if eos >= 0 else jnp.ones((B, K), bool)
+            # caches replicate per beam: [nl, B, ...] -> [nl, B*K, ...]
+            kcs, vcs = (jnp.repeat(t, K, axis=1) for t in (kcs, vcs))
+            seqs = jnp.full((B, K, L), eos if eos >= 0 else 0, jnp.int32)
+            seqs = seqs.at[:, :, 0].set(tok0)
+
+            def step(carry, t):
+                seqs, scores, live, kcs, vcs = carry
+                # each beam's newest token is seqs[:, :, t] (written last round)
+                prev = jax.lax.dynamic_slice_in_dim(seqs, t, 1, axis=2)
+                logits, kcs, vcs = forward(p, prev.reshape(B * K, 1),
+                                           T0 + t, kcs, vcs)
+                cand = step_scores(logits, scores, live)            # [B, K, V]
+                flat = cand.reshape(B, K * V)
+                scores, idx = jax.lax.top_k(flat, K)                # [B, K]
+                parent = idx // V                                   # [B, K]
+                tok = (idx % V).astype(jnp.int32)
+                # reorder: sequences + caches follow their parent beam
+                seqs = jnp.take_along_axis(seqs, parent[:, :, None], axis=1)
+                seqs = jax.lax.dynamic_update_slice_in_dim(
+                    seqs, tok[:, :, None], t + 1, axis=2)
+                flatp = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+                kcs = kcs[:, flatp]
+                vcs = vcs[:, flatp]
+                live = jnp.take_along_axis(live, parent, axis=1)
+                if eos >= 0:
+                    live = live & (tok != eos)
+                return (seqs, scores, live, kcs, vcs), ()
+
+            (seqs, scores, live, _, _), _ = jax.lax.scan(
+                step, (seqs, scores, live, kcs, vcs), jnp.arange(L - 1))
+            # GNMT length normalization: finished beams count tokens up to and
+            # including EOS; an unfinished beam counts exactly L (clamped — the
+            # +1 for EOS must not credit beams that never emitted one)
+            if eos >= 0:
+                lengths = jnp.minimum(jnp.sum(jnp.cumprod(
+                    (seqs != eos).astype(jnp.float32), axis=2), axis=2) + 1.0,
+                    float(L))
+            else:
+                lengths = jnp.full((B, K), float(L))
+            final = scores / jnp.power(lengths, jnp.float32(length_penalty))
+            best = jnp.argmax(final, axis=1)                        # [B]
+            return (jnp.take_along_axis(seqs, best[:, None, None], axis=1)[:, 0],
+                    jnp.take_along_axis(final, best[:, None], axis=1)[:, 0])
+
+        # the prefill program depends only on shapes — key it separately so
+        # varying num_beams/eos/length_penalty reuses the expensive prompt jit
+        pre_sig = ("beam-prefill", B, T0, max_len)
+        sig = ("beam", B, T0, L, K, eos, float(length_penalty))
+        cache = getattr(self, "_gen_jit_cache", None)
+        if cache is None:
+            cache = self._gen_jit_cache = {}
+        if pre_sig not in cache:
+            cache[pre_sig] = jax.jit(forward)
+        if sig not in cache:
+            cache[sig] = jax.jit(decode)
+        jit_forward, jit_decode = cache[pre_sig], cache[sig]
+
+        cache_shape = (c.n_layer, B, c.n_head, max_len, c.head_dim)
+        kcs = jnp.zeros(cache_shape, c.compute_dtype)
+        vcs = jnp.zeros(cache_shape, c.compute_dtype)
+        first_logits, kcs, vcs = jit_forward(params, tokens, 0, kcs, vcs)
+        gen, scores = jit_decode(params, first_logits, kcs, vcs)
+        return jnp.concatenate([tokens, gen.astype(tokens.dtype)], axis=1), scores
+
     def generate(self, params, tokens, max_new_tokens: int,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                  rng=None):
@@ -447,55 +608,7 @@ class GPT2Model:
             assert rng is not None, "temperature > 0 requires an rng key"
         assert top_k >= 0, f"top_k must be >= 0 (got {top_k})"
         assert 0.0 < top_p <= 1.0, f"top_p must be in (0, 1] (got {top_p})"
-
-        def attn_cached(x, bp, kc, vc, pos):
-            """x [B, Tn, E]; kc/vc [B, nh, max_len, hd]; ``pos`` tokens cached."""
-            B_, Tn, _ = x.shape
-            qkv = jnp.dot(x, bp["c_attn_w"].astype(x.dtype),
-                          preferred_element_type=jnp.float32).astype(x.dtype) \
-                + bp["c_attn_b"].astype(x.dtype)
-            q, k, v = jnp.split(qkv, 3, axis=-1)
-            q = q.reshape(B_, Tn, nh, hd).transpose(0, 2, 1, 3)
-            k = k.reshape(B_, Tn, nh, hd).transpose(0, 2, 1, 3)
-            v = v.reshape(B_, Tn, nh, hd).transpose(0, 2, 1, 3)
-            kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, 0, pos, 0))
-            vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, 0, pos, 0))
-            s = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
-                           preferred_element_type=jnp.float32) / math.sqrt(hd)
-            j = jnp.arange(max_len)[None, :]
-            i = pos + jnp.arange(Tn)[:, None]
-            s = jnp.where(j <= i, s, jnp.float32(-1e9))  # causal + not-yet-written mask
-            p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
-            y = jnp.einsum("bhqk,bhkd->bhqd", p, vc,
-                           preferred_element_type=jnp.float32).astype(x.dtype)
-            y = y.transpose(0, 2, 1, 3).reshape(B_, Tn, nh * hd)
-            return (jnp.dot(y, bp["c_proj_w"].astype(x.dtype),
-                            preferred_element_type=jnp.float32).astype(x.dtype)
-                    + bp["c_proj_b"].astype(x.dtype)), kc, vc
-
-        def forward(p, toks, pos, kcs, vcs):
-            """toks [B, Tn] -> (last-position logits, updated caches)."""
-            Tn = toks.shape[1]
-            positions = pos + jnp.arange(Tn)
-            x = p["wte"][toks].astype(c.compute_dtype) \
-                + p["wpe"][positions].astype(c.compute_dtype)
-            new_k, new_v = [], []
-            for li, bp in enumerate(p["blocks"]):
-                a, kc, vc = attn_cached(
-                    self._layer_norm(x, bp["ln_1"], c.layer_norm_epsilon),
-                    bp["attn"], kcs[li], vcs[li], pos)
-                x = x + a
-                h = self._layer_norm(x, bp["ln_2"], c.layer_norm_epsilon)
-                m = (self._moe.apply(bp["moe"], h)[0] if "moe" in bp
-                     else self._mlp(h, bp["mlp"]))
-                x = x + m
-                new_k.append(kc)
-                new_v.append(vc)
-            x = self._layer_norm(x, p["ln_f"], c.layer_norm_epsilon)
-            logits = jnp.dot(x[:, -1], p["wte"].T.astype(x.dtype),
-                             preferred_element_type=jnp.float32)
-            return logits, jnp.stack(new_k), jnp.stack(new_v)
-
+        forward = self._build_cached_forward(max_len)
         out_dtype = tokens.dtype
 
         def sample(logits, key):

@@ -106,99 +106,71 @@ class MoELayer:
                 "w_in": ex, "b_in": ex, "w_out": ex, "b_out": ex}
 
     # ---------------------------------------------------------------- routing
-    @staticmethod
-    def _queue_positions(onehot, capacity, base=None):
-        """0-based per-expert queue position of each chosen token ([N, E] one-hot),
-        optionally starting after ``base`` already-filled slots per expert.
-        Returns (dispatch [N, E, C] slot one-hot, keep [N, E])."""
-        pos = jnp.cumsum(onehot, axis=0) * onehot - onehot
-        if base is not None:
-            pos = pos + base[None, :] * onehot
-        keep = (pos < capacity) * onehot
-        dispatch = keep[..., None] * jax.nn.one_hot(pos.astype(jnp.int32), capacity,
-                                                    dtype=jnp.float32)
-        return dispatch, keep
+    def _route_plan(self, x2, gate_w, capacity):
+        """ONE source of truth for the slot assignment (both dispatch encodings
+        decode from this): top-1 (switch) or top-2 (GShard — second choices
+        queue after every KEPT first choice per expert; a saturated router's
+        phantom second pick is masked; gate weights normalized by p1+p2 even
+        when the second pick drops, so the first is not re-normalized to 1).
 
-    def _route(self, x2, gate_w, capacity):
-        """Dispatch plan for flat tokens ``x2 [N, H]``: top-1 (switch) or top-2
-        (GShard — second choices queue after ALL first choices per expert, gate
-        weights normalized over the two picks).
-
-        Returns (dispatch [N, E, C] slot one-hot, combine [N, E, C] prob-weighted,
-        (f, p) balancing statistics). All shapes static."""
-        E = self.num_experts
+        Returns (picks, (f, p)) where picks is a list of ``top_k`` tuples
+        ``(expert [N] int32, pos [N] int32, keep [N] bool, weight [N] fp32)``
+        — weight is the gate coefficient for the combine, NOT yet keep-masked —
+        plus the Switch load-balancing statistics (callers under shard_map
+        pmean (f, p) so the aux term is global)."""
+        E, C = self.num_experts, capacity
         logits = jnp.dot(x2.astype(jnp.float32), gate_w.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)                     # [N, E]
         expert1 = jnp.argmax(probs, axis=-1)                        # [N]
         onehot1 = jax.nn.one_hot(expert1, E, dtype=jnp.float32)     # [N, E]
-        d1, keep1 = self._queue_positions(onehot1, capacity)
+        pos1 = jnp.sum(jnp.cumsum(onehot1, axis=0) * onehot1 - onehot1, axis=-1)
+        keep1 = pos1 < C
         p1 = jnp.sum(probs * onehot1, axis=-1)                      # [N]
-        # Switch load-balancing statistics over first choices; callers under
-        # shard_map pmean the (f, p) pair so the term is global
         f = jnp.mean(onehot1, axis=0)                               # [E]
         p = jnp.mean(probs, axis=0)                                 # [E]
+        e1 = expert1.astype(jnp.int32)
+        pos1 = pos1.astype(jnp.int32)
         if self.top_k == 1:
-            return d1, d1 * p1[:, None, None], (f, p)
-
+            return [(e1, pos1, keep1, p1)], (f, p)
         probs2 = probs * (1.0 - onehot1)                            # mask the winner
         expert2 = jnp.argmax(probs2, axis=-1)
         onehot2 = jax.nn.one_hot(expert2, E, dtype=jnp.float32)
-        # a saturated router (p(winner) == 1.0 in fp32) leaves probs2 all-zero and
-        # argmax would fabricate expert 0 as a phantom second choice that burns a
-        # real capacity slot — mask zero-probability picks
         onehot2 = onehot2 * (jnp.max(probs2, axis=-1) > 0)[:, None]
-        # second choices fill slots AFTER every first-choice token of that expert
-        # (GShard's two-pass assignment; keeps first choices drop-free longest)
-        first_counts = jnp.sum(keep1, axis=0)                       # [E]
-        d2, _ = self._queue_positions(onehot2, capacity, base=first_counts)
-        p2 = jnp.sum(probs * onehot2, axis=-1)
-        denom = jnp.maximum(p1 + p2, 1e-9)
-        combine = (d1 * (p1 / denom)[:, None, None]
-                   + d2 * (p2 / denom)[:, None, None])
-        return d1 + d2, combine, (f, p)
-
-    def _route_indexed(self, x2, gate_w, capacity):
-        """Slot-indexed dispatch plan (same assignment as ``_route``, different
-        encoding): each routed pick of token n gets a flat slot id
-        ``expert * C + queue_pos`` in ``[0, E*C)``, with ``E*C`` as the
-        dropped/absent sentinel. Returns (slots [N, k] int32, weights [N, k]
-        fp32 — normalized gate probs, zeroed on drop — and the (f, p)
-        balancing statistics)."""
-        E, C = self.num_experts, capacity
-        logits = jnp.dot(x2.astype(jnp.float32), gate_w.astype(jnp.float32))
-        probs = jax.nn.softmax(logits, axis=-1)
-        expert1 = jnp.argmax(probs, axis=-1)
-        onehot1 = jax.nn.one_hot(expert1, E, dtype=jnp.float32)
-        pos1 = jnp.sum(jnp.cumsum(onehot1, axis=0) * onehot1 - onehot1, axis=-1)
-        keep1 = pos1 < C
-        slot1 = jnp.where(keep1, expert1.astype(jnp.int32) * C
-                          + pos1.astype(jnp.int32), E * C)
-        p1 = jnp.sum(probs * onehot1, axis=-1)
-        f = jnp.mean(onehot1, axis=0)
-        p = jnp.mean(probs, axis=0)
-        if self.top_k == 1:
-            return (slot1[:, None],
-                    (p1 * keep1)[:, None].astype(jnp.float32), (f, p))
-        probs2 = probs * (1.0 - onehot1)
-        expert2 = jnp.argmax(probs2, axis=-1)
-        onehot2 = jax.nn.one_hot(expert2, E, dtype=jnp.float32)
-        onehot2 = onehot2 * (jnp.max(probs2, axis=-1) > 0)[:, None]
-        # second choices queue after every KEPT first choice of that expert
-        first_counts = jnp.sum(onehot1 * keep1[:, None], axis=0)
+        first_counts = jnp.sum(onehot1 * keep1[:, None], axis=0)    # [E]
         pos2 = jnp.sum(jnp.cumsum(onehot2, axis=0) * onehot2 - onehot2
                        + first_counts[None, :] * onehot2, axis=-1)
         valid2 = jnp.sum(onehot2, axis=-1) > 0
         keep2 = (pos2 < C) & valid2
-        slot2 = jnp.where(keep2, expert2.astype(jnp.int32) * C
-                          + pos2.astype(jnp.int32), E * C)
         p2 = jnp.sum(probs * onehot2, axis=-1)
-        # the einsum path's convention: normalize by p1+p2 even when the second
-        # pick drops over capacity (the first pick is NOT re-normalized to 1)
         denom = jnp.maximum(p1 + p2, 1e-9)
-        w1 = (p1 / denom) * keep1
-        w2 = (p2 / denom) * keep2
-        return (jnp.stack([slot1, slot2], axis=1),
-                jnp.stack([w1, w2], axis=1).astype(jnp.float32), (f, p))
+        return [(e1, pos1, keep1, p1 / denom),
+                (expert2.astype(jnp.int32), pos2.astype(jnp.int32), keep2,
+                 p2 / denom)], (f, p)
+
+    def _route(self, x2, gate_w, capacity):
+        """Dense one-hot encoding of the plan: (dispatch [N, E, C] slot one-hot,
+        combine [N, E, C] gate-weighted, (f, p))."""
+        E, C = self.num_experts, capacity
+        picks, fp = self._route_plan(x2, gate_w, capacity)
+        dispatch = combine = 0.0
+        for e, pos, keep, w in picks:
+            d = (jax.nn.one_hot(e, E, dtype=jnp.float32)[:, :, None]
+                 * jax.nn.one_hot(pos, C, dtype=jnp.float32)[:, None, :]
+                 * keep[:, None, None])
+            dispatch = dispatch + d
+            combine = combine + d * w[:, None, None]
+        return dispatch, combine, fp
+
+    def _route_indexed(self, x2, gate_w, capacity):
+        """Flat-slot encoding of the plan: each pick gets slot id
+        ``expert * C + pos`` in ``[0, E*C)`` with ``E*C`` as the dropped/absent
+        sentinel. Returns (slots [N, k] int32, weights [N, k] fp32 — zeroed on
+        drop — and (f, p))."""
+        E, C = self.num_experts, capacity
+        picks, fp = self._route_plan(x2, gate_w, capacity)
+        slots = [jnp.where(keep, e * C + pos, E * C) for e, pos, keep, _ in picks]
+        weights = [(w * keep).astype(jnp.float32) for e, pos, keep, w in picks]
+        return jnp.stack(slots, axis=1), jnp.stack(weights, axis=1), fp
 
     @staticmethod
     def _scatter_buf(x2, slots, n_slots):

@@ -91,6 +91,77 @@ def test_top_p_tiny_nucleus_is_greedy():
     np.testing.assert_array_equal(greedy, topk1)
 
 
+def _teacher_forced_logprob(model, params, full, T0):
+    """Sum of log p(token_t | prefix) over the generated suffix, fp32."""
+    logits = np.asarray(model.logits(params, jnp.asarray(full[:, :-1]))
+                        if hasattr(model, "logits") else
+                        model.apply(params, jnp.asarray(full[:, :-1])))
+    logp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+    tot = np.zeros(full.shape[0])
+    for t in range(T0, full.shape[1]):
+        for b in range(full.shape[0]):
+            tot[b] += logp[b, t - 1, full[b, t]]
+    return tot
+
+
+def test_beam1_equals_greedy():
+    cfg = GPT2Config(vocab_size=64, n_positions=32, n_embd=32, n_layer=2, n_head=2,
+                     compute_dtype=jnp.float32)
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(15))
+    prompt = jnp.asarray(np.random.default_rng(16).integers(0, 64, (2, 5)), jnp.int32)
+    greedy = np.asarray(model.generate(params, prompt, max_new_tokens=7))
+    beam1, _ = model.beam_search(params, prompt, max_new_tokens=7, num_beams=1)
+    np.testing.assert_array_equal(greedy, np.asarray(beam1))
+
+
+def test_beam_search_scores_are_self_consistent_and_beat_greedy():
+    """The returned score must equal the teacher-forced log-prob of the returned
+    sequence (length_penalty=1 -> score*L), and the beam-4 winner's total
+    log-prob must be >= the greedy sequence's."""
+    cfg = GPT2Config(vocab_size=37, n_positions=32, n_embd=32, n_layer=2, n_head=2,
+                     compute_dtype=jnp.float32)
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(17))
+    prompt = jnp.asarray(np.random.default_rng(18).integers(0, 37, (3, 4)), jnp.int32)
+    L = 6
+    seqs, scores = model.beam_search(params, prompt, max_new_tokens=L, num_beams=4)
+    seqs = np.asarray(seqs)
+    want = _teacher_forced_logprob(model, params, seqs, 4)
+    np.testing.assert_allclose(np.asarray(scores) * L, want, rtol=1e-4, atol=1e-4)
+    greedy = np.asarray(model.generate(params, prompt, max_new_tokens=L))
+    g_lp = _teacher_forced_logprob(model, params, greedy, 4)
+    assert (want >= g_lp - 1e-4).all(), (want, g_lp)
+
+
+def test_beam_search_eos_freezes_and_pads():
+    cfg = GPT2Config(vocab_size=16, n_positions=32, n_embd=16, n_layer=1, n_head=2,
+                     compute_dtype=jnp.float32)
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(19))
+    prompt = jnp.asarray(np.random.default_rng(20).integers(0, 16, (2, 3)), jnp.int32)
+    seqs, scores = model.beam_search(params, prompt, max_new_tokens=8, num_beams=3,
+                                     eos_token_id=5, length_penalty=0.8)
+    seqs = np.asarray(seqs)
+    assert seqs.shape == (2, 11) and np.isfinite(np.asarray(scores)).all()
+    for b in range(2):
+        gen = seqs[b, 3:]
+        hits = np.where(gen == 5)[0]
+        if hits.size:  # everything after the first EOS is EOS padding
+            assert (gen[hits[0]:] == 5).all(), gen
+    # normalized score self-consistency: raw log-prob accumulates only up to the
+    # first EOS (frozen continuations are free), length counts it, clamped at L
+    full_logits = np.asarray(model.logits(params, jnp.asarray(seqs[:, :-1])))
+    logp = np.asarray(jax.nn.log_softmax(jnp.asarray(full_logits), axis=-1))
+    for b in range(2):
+        gen = seqs[b, 3:]
+        hits = np.where(gen == 5)[0]
+        n = min(int(hits[0]) + 1 if hits.size else 8, 8)
+        raw = sum(logp[b, 3 - 1 + t, gen[t]] for t in range(n))
+        want = raw / n ** 0.8
+        np.testing.assert_allclose(float(scores[b]), want, rtol=1e-4, atol=1e-4)
+
+
 def test_generate_reuses_compiled_programs():
     cfg = GPT2Config(vocab_size=64, n_positions=32, n_embd=32, n_layer=2, n_head=2,
                      compute_dtype=jnp.float32)
