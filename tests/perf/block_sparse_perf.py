@@ -4,7 +4,10 @@ devtime.py — host-loop timings over the axon relay are fence-noise).
 BigBird layout at long seq; prints sparse/dense time and the speedup vs the
 density-ideal bound.
 
-    python tests/perf/block_sparse_perf.py [--groups 1,2] [--bwd]
+    python tests/perf/block_sparse_perf.py [--groups 1,2] [--bwd] [--local W]
+
+``--local W`` swaps BigBird for a W-block sliding-window band (union-friendly,
+no global rows) — the gap-decomposition probe PERF.md cites.
 """
 
 import os
@@ -29,11 +32,24 @@ def main():
               (sys.argv[sys.argv.index("--groups") + 1].split(",")
                if "--groups" in sys.argv else ["1", "2"])]
     do_bwd = "--bwd" in sys.argv
+    # --local W: sliding-window band of W blocks instead of BigBird — union-
+    # friendly (adjacent q-rows share almost the whole block set, no global
+    # rows in every cell's union), isolating pattern structure from kernel
+    # efficiency in the gap to the density-ideal
+    local_w = (int(sys.argv[sys.argv.index("--local") + 1])
+               if "--local" in sys.argv else 0)
     B, H, D, BLOCK = 1, 16, 64, 128
     rng = np.random.default_rng(0)
     for T in (4096, 8192):
-        cfg = BigBirdSparsityConfig(num_heads=H, block=BLOCK)
-        layout = cfg.make_layout(T)
+        if local_w:
+            nb = T // BLOCK
+            lay = np.zeros((H, nb, nb), np.int64)
+            for i in range(nb):
+                lay[:, i, max(0, i - local_w + 1):i + 1] = 1  # causal-style band
+            layout = lay  # layouts are host-side numpy by module contract
+        else:
+            cfg = BigBirdSparsityConfig(num_heads=H, block=BLOCK)
+            layout = cfg.make_layout(T)
         density = float(np.asarray(layout).mean())
         q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
         k = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
