@@ -38,7 +38,9 @@ def main():
     # efficiency in the gap to the density-ideal
     local_w = (int(sys.argv[sys.argv.index("--local") + 1])
                if "--local" in sys.argv else 0)
-    B, H, D, BLOCK = 1, 16, 64, 128
+    B, H, D = 1, 16, 64
+    BLOCK = (int(sys.argv[sys.argv.index("--block") + 1])
+             if "--block" in sys.argv else 128)
     rng = np.random.default_rng(0)
     for T in (4096, 8192):
         if local_w:
