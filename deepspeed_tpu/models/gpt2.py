@@ -46,6 +46,16 @@ class GPT2Config:
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # Block-sparse attention (ops/sparse_attention + the Pallas kernel): a
+    # SparsityConfig instance (BigBird/Fixed/Variable/BSLongformer...) replaces
+    # dense/flash attention in every block — causal training over the layout's
+    # block pattern (the kernel's causal mask composes with the layout, so
+    # bidirectional layouts are safely clipped to the lower triangle). The
+    # layout is built once per sequence length and cached on the model.
+    # Constraints: no attention dropout (the sparse kernel has no in-kernel
+    # PRNG), not composable with ring sequence parallelism; decode
+    # (generate/beam_search) stays dense-incremental.
+    sparse_attention: Any = None
 
     # named sizes for convenience
     @property
@@ -90,6 +100,10 @@ class GPT2Model:
         self.tp_axis = None   # set via with_tp() for manual-collective (shard_map) TP
         self.tp_size = 1
         self.seq_axis = None  # set via with_sequence_parallel() for ring attention
+        self._sparse_layouts = {}  # seq_len -> block layout (host numpy), built once
+        if config.sparse_attention is not None:
+            assert config.dropout == 0.0, \
+                "sparse_attention has no in-kernel dropout; set dropout=0"
         self._moe = None
         if config.moe_experts > 0:
             assert config.moe_every >= 1, \
@@ -111,6 +125,9 @@ class GPT2Model:
         assert (4 * self.config.n_embd) % size == 0
         assert self.config.moe_experts == 0, \
             "MoE blocks do not compose with manual TP (use GSPMD expert sharding)"
+        assert self.config.sparse_attention is None, \
+            "sparse_attention does not compose with manual TP (per-rank head "\
+            "layouts are not split)"
         m = GPT2Model(self.config)
         m.tp_axis = axis
         m.tp_size = size
@@ -125,6 +142,9 @@ class GPT2Model:
         single-chip flash kernel's whole-K/V VMEM cap."""
         assert self.tp_axis is None, \
             "sequence parallelism does not compose with manual TP yet"
+        assert self.config.sparse_attention is None, \
+            "sparse_attention does not compose with ring sequence parallelism " \
+            "(the ring path would silently ignore the layout)"
         # MoE composes: the dense dispatch routes each rank's LOCAL sequence chunk
         # (per-chunk capacity; experts replicated inside the shard_map) and the aux
         # term folds into the pmean'd loss
@@ -276,6 +296,17 @@ class GPT2Model:
             from ..parallel.ring_attention import ring_attention
             y = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True,
                                dropout_rate=rate, dropout_seed=seed)
+        elif c.sparse_attention is not None:
+            from ..ops.pallas.block_sparse_attention import block_sparse_attention
+            sc = c.sparse_attention
+            if T not in self._sparse_layouts:
+                layout = sc.make_layout(T)
+                assert layout.shape[0] == nh, \
+                    (f"sparse_attention config built for {layout.shape[0]} heads; "
+                     f"model runs {nh} — construct it with num_heads={c.n_head}")
+                self._sparse_layouts[T] = layout
+            y = block_sparse_attention(q, k, v, self._sparse_layouts[T], sc.block,
+                                       causal=True)
         elif c.use_flash_attention:
             from ..ops.pallas.flash_attention import flash_attention
             if seed is not None and self.tp_axis is not None:

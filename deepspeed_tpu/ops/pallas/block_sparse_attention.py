@@ -816,11 +816,33 @@ def block_sparse_attention(q, k, v, layout, block: int, causal: bool = False,
     while nb % group != 0:
         group //= 2
     group = max(1, group)
-    counts, cols = build_grouped_luts(layout, group)
-    counts_t, rows_t = build_grouped_luts(np.transpose(layout, (0, 2, 1)), group)
+    counts, cols, counts_t, rows_t = _cached_luts(layout, group)
     return _bs_attention_core(q, k, v, jnp.asarray(counts), jnp.asarray(cols),
                               jnp.asarray(counts_t), jnp.asarray(rows_t),
                               block, causal, sm_scale, group, interpret)
+
+
+# LUT build is pure host work on a static layout: a deep model calls
+# block_sparse_attention once PER LAYER with the same layout, and without this
+# cache each trace would re-run build_grouped_luts (Python loops over H*ng
+# groups, twice — forward + transposed). Keyed by layout bytes, bounded LRU.
+# The cache holds NUMPY arrays only: jnp.asarray inside an active jit trace
+# stages a device_put and returns a tracer, which must never outlive its trace.
+_LUT_CACHE = {}
+_LUT_CACHE_MAX = 32
+
+
+def _cached_luts(layout: np.ndarray, group: int):
+    key = (layout.shape, layout.tobytes(), group)
+    hit = _LUT_CACHE.pop(key, None)
+    if hit is None:
+        counts, cols = build_grouped_luts(layout, group)
+        counts_t, rows_t = build_grouped_luts(np.transpose(layout, (0, 2, 1)), group)
+        hit = (counts, cols, counts_t, rows_t)
+        while len(_LUT_CACHE) >= _LUT_CACHE_MAX:
+            _LUT_CACHE.pop(next(iter(_LUT_CACHE)))
+    _LUT_CACHE[key] = hit  # re-insert = move to MRU position
+    return hit
 
 
 def dense_blocksparse_attention(q, k, v, layout, block: int, causal: bool = False,

@@ -18,13 +18,27 @@ import numpy as np
 
 
 class SparsityConfig:
-    """Base: holds head count, block size, per-head-layout flag."""
+    """Base: holds head count, block size, per-head-layout flag.
 
-    def __init__(self, num_heads, block=16, different_layout_per_head=False):
+    Layout construction is DETERMINISTIC: patterns with random blocks
+    (BigBird, Variable) draw from ``random.Random(layout_seed)``, so every
+    process — multi-host data-parallel ranks, or a later eval run reloading a
+    checkpoint — realizes the identical layout. (The reference sampled the
+    unseeded global RNG; per-process layouts would bake different LUT
+    constants into each host's compiled program and silently diverge.)"""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 layout_seed=709):
         self.num_heads = num_heads
         self.block = block
         self.different_layout_per_head = different_layout_per_head
         self.num_layout_heads = num_heads if different_layout_per_head else 1
+        self.layout_seed = layout_seed
+
+    def layout_rng(self) -> "random.Random":
+        """Fresh seeded RNG per make_layout call, so repeated builds (and
+        different sequence lengths) are themselves reproducible."""
+        return random.Random(self.layout_seed)
 
     def setup_layout(self, seq_len) -> np.ndarray:
         if seq_len % self.block != 0:
@@ -135,8 +149,10 @@ class VariableSparsityConfig(SparsityConfig):
                  global_block_indices: Optional[List[int]] = None,
                  global_block_end_indices: Optional[List[int]] = None,
                  attention="bidirectional",
-                 horizontal_global_attention=False):
-        super().__init__(num_heads, block, different_layout_per_head)
+                 horizontal_global_attention=False,
+                 layout_seed=709):
+        super().__init__(num_heads, block, different_layout_per_head,
+                         layout_seed=layout_seed)
         self.num_random_blocks = num_random_blocks
         self.local_window_blocks = local_window_blocks or [4]
         self.global_block_indices = global_block_indices if global_block_indices is not None else [0]
@@ -157,13 +173,14 @@ class VariableSparsityConfig(SparsityConfig):
                              "attention='bidirectional'")
         self.horizontal_global_attention = horizontal_global_attention
 
-    def set_random_layout(self, h, layout):
+    def set_random_layout(self, h, layout, rng=None):
         num_blocks = layout.shape[1]
         if num_blocks < self.num_random_blocks:
             raise ValueError(f"sparse layout: num_random_blocks={self.num_random_blocks} "
                              f"exceeds the {num_blocks} blocks per row")
+        rng = rng or self.layout_rng()
         for row in range(num_blocks):
-            rnd_cols = random.sample(range(num_blocks), self.num_random_blocks)
+            rnd_cols = rng.sample(range(num_blocks), self.num_random_blocks)
             layout[h, row, rnd_cols] = 1
         return layout
 
@@ -206,8 +223,9 @@ class VariableSparsityConfig(SparsityConfig):
 
     def make_layout(self, seq_len) -> np.ndarray:
         layout = self.setup_layout(seq_len)
+        rng = self.layout_rng()  # one seeded stream; heads draw sequentially
         for h in range(self.num_layout_heads):
-            layout = self.set_random_layout(h, layout)
+            layout = self.set_random_layout(h, layout, rng)
             layout = self.set_local_layout(h, layout)
             layout = self.set_global_layout(h, layout)
         return self.check_and_propagate_first_head_layout(layout)
@@ -222,19 +240,22 @@ class BigBirdSparsityConfig(SparsityConfig):
                  different_layout_per_head=False,
                  num_random_blocks=1,
                  num_sliding_window_blocks=3,
-                 num_global_blocks=1):
-        super().__init__(num_heads, block, different_layout_per_head)
+                 num_global_blocks=1,
+                 layout_seed=709):
+        super().__init__(num_heads, block, different_layout_per_head,
+                         layout_seed=layout_seed)
         self.num_random_blocks = num_random_blocks
         self.num_sliding_window_blocks = num_sliding_window_blocks
         self.num_global_blocks = num_global_blocks
 
-    def set_random_layout(self, h, layout):
+    def set_random_layout(self, h, layout, rng=None):
         num_blocks = layout.shape[1]
         if num_blocks < self.num_random_blocks:
             raise ValueError(f"sparse layout: num_random_blocks={self.num_random_blocks} "
                              f"exceeds the {num_blocks} blocks per row")
+        rng = rng or self.layout_rng()
         for row in range(num_blocks):
-            rnd_cols = random.sample(range(num_blocks), self.num_random_blocks)
+            rnd_cols = rng.sample(range(num_blocks), self.num_random_blocks)
             layout[h, row, rnd_cols] = 1
         return layout
 
@@ -259,8 +280,9 @@ class BigBirdSparsityConfig(SparsityConfig):
 
     def make_layout(self, seq_len) -> np.ndarray:
         layout = self.setup_layout(seq_len)
+        rng = self.layout_rng()  # one seeded stream; heads draw sequentially
         for h in range(self.num_layout_heads):
-            layout = self.set_random_layout(h, layout)
+            layout = self.set_random_layout(h, layout, rng)
             layout = self.set_sliding_window_layout(h, layout)
             layout = self.set_global_layout_itc(h, layout)
         return self.check_and_propagate_first_head_layout(layout)
