@@ -591,7 +591,7 @@ class GPT2Model:
 
         # the prefill program depends only on shapes — key it separately so
         # varying num_beams/eos/length_penalty reuses the expensive prompt jit
-        pre_sig = ("beam-prefill", B, T0, max_len)
+        pre_sig = ("prefill", B, T0, max_len)
         sig = ("beam", B, T0, L, K, eos, float(length_penalty))
         cache = getattr(self, "_gen_jit_cache", None)
         if cache is None:
@@ -674,16 +674,21 @@ class GPT2Model:
             # outs collects each step's INPUT token; the final sample is `last`
             return jnp.concatenate([outs.T, last[:, None]], axis=1)
 
-        # one compile per (shape, temperature) signature, reused across calls —
-        # params are explicit jit arguments, not closure captures
+        # one compile per signature, reused across calls — params are explicit
+        # jit arguments, not closure captures. The prefill depends only on
+        # shapes (same key beam_search uses), so sampling-parameter variants
+        # share the expensive prompt program.
+        pre_sig = ("prefill", B, T0, max_len)
         sig = (B, T0, int(max_new_tokens), float(temperature), int(top_k),
                float(top_p), str(out_dtype))
         cache = getattr(self, "_gen_jit_cache", None)
         if cache is None:
             cache = self._gen_jit_cache = {}
+        if pre_sig not in cache:
+            cache[pre_sig] = jax.jit(forward)
         if sig not in cache:
-            cache[sig] = (jax.jit(forward), jax.jit(decode))
-        jit_forward, jit_decode = cache[sig]
+            cache[sig] = jax.jit(decode)
+        jit_forward, jit_decode = cache[pre_sig], cache[sig]
 
         cache_shape = (c.n_layer, B, nh, max_len, hd)
         kcs = jnp.zeros(cache_shape, c.compute_dtype)

@@ -243,22 +243,19 @@ class DeepSpeedEngine:
         # positional input is something else.
         self._sparse_tokens_fn = getattr(model, "sparse_grad_tokens", None)
         if self.config.sparse_gradients_enabled and not self._use_stacked_grads:
-            # the sparse-reduction shard_map pins replicated param in_specs, so it
-            # is unavailable whenever params are sharded: under stage 3 (it would
-            # all-gather the sharded params every step — dense reduction keeps the
-            # gather at use points only) and under caller-provided layouts
-            if param_shardings is not None:
-                logger.warning("[deepspeed_tpu] sparse_gradients is inactive with "
-                               "caller-provided param_shardings; using dense "
-                               "gradient reduction")
-            elif zero_stage >= 3:
-                logger.warning("[deepspeed_tpu] sparse_gradients is inactive under "
-                               "ZeRO stage 3 (sharded parameters); using dense "
-                               "gradient reduction")
-        if (self.config.sparse_gradients_enabled and not self._use_stacked_grads
-                and param_shardings is None and zero_stage < 3):
-            patterns = tuple(getattr(model, "sparse_grad_paths", lambda: ())())
-            if patterns:
+            if param_shardings is not None or zero_stage >= 3:
+                # the sparse-reduction shard_map pins replicated param in_specs,
+                # so it is unavailable whenever params are sharded: under stage 3
+                # (it would all-gather the sharded params every step — dense
+                # reduction keeps the gather at use points only) and under
+                # caller-provided layouts
+                reason = ("with caller-provided param_shardings"
+                          if param_shardings is not None
+                          else "under ZeRO stage 3 (sharded parameters)")
+                logger.warning(f"[deepspeed_tpu] sparse_gradients is inactive "
+                               f"{reason}; using dense gradient reduction")
+            elif (patterns := tuple(getattr(model, "sparse_grad_paths",
+                                            lambda: ())())):
                 from .sparse_tensor import match_sparse_paths
                 paths = jax.tree_util.tree_flatten_with_path(master_fp32)[0]
                 flags = []

@@ -169,9 +169,13 @@ def test_generate_reuses_compiled_programs():
     params = model.init(jax.random.PRNGKey(6))
     prompt = jnp.asarray(np.random.default_rng(7).integers(0, 64, (2, 5)), jnp.int32)
     o1 = model.generate(params, prompt, max_new_tokens=4)
-    assert len(model._gen_jit_cache) == 1
+    assert len(model._gen_jit_cache) == 2  # shape-keyed prefill + decode
     o2 = model.generate(params, prompt, max_new_tokens=4)
-    assert len(model._gen_jit_cache) == 1  # same signature -> same programs
+    assert len(model._gen_jit_cache) == 2  # same signature -> same programs
+    # a different sampling config compiles a new decode but REUSES the prefill
+    model.generate(params, prompt, max_new_tokens=4, temperature=0.5,
+                   rng=jax.random.PRNGKey(0))
+    assert len(model._gen_jit_cache) == 3
     np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
     with pytest.raises(AssertionError, match="max_new_tokens"):
         model.generate(params, prompt, max_new_tokens=0)

@@ -2,8 +2,6 @@
 sparse kernel wired into the flagship causal LM, parity-tested against a dense
 oracle that applies the same layout-expanded mask."""
 
-import math
-
 import numpy as np
 
 import jax
@@ -23,13 +21,16 @@ def _sparse_cfg(**kw):
 
 
 class MaskedDenseGPT2(GPT2Model):
-    """Oracle: dense attention masked by (block layout expanded to tokens) ∩ tril."""
+    """Oracle: attention core swapped for the maintained dense-masked reference
+    (``dense_blocksparse_attention``) over the same layout, causal."""
 
     def __init__(self, config, layout):
         super().__init__(config)
         self._oracle_layout = np.asarray(layout)
 
     def _attention(self, x, p, dropout_rng=None):
+        from deepspeed_tpu.ops.pallas.block_sparse_attention import \
+            dense_blocksparse_attention
         c = self.config
         B, T_, _ = x.shape
         nh = c.n_head
@@ -40,14 +41,8 @@ class MaskedDenseGPT2(GPT2Model):
         q = q.reshape(B, T_, nh, c.head_dim).transpose(0, 2, 1, 3)
         k = k.reshape(B, T_, nh, c.head_dim).transpose(0, 2, 1, 3)
         v = v.reshape(B, T_, nh, c.head_dim).transpose(0, 2, 1, 3)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                            preferred_element_type=jnp.float32) / math.sqrt(c.head_dim)
-        mask = np.kron(self._oracle_layout, np.ones((BLOCK, BLOCK))) > 0  # [H, T, T]
-        mask = mask & np.tril(np.ones((T_, T_), bool))[None]
-        scores = jnp.where(jnp.asarray(mask)[None], scores, jnp.float32(-1e9))
-        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        y = jnp.einsum("bhqk,bhkd->bhqd", probs, v,
-                       preferred_element_type=jnp.float32).astype(x.dtype)
+        y = dense_blocksparse_attention(q, k, v, self._oracle_layout, BLOCK,
+                                        causal=True)
         y = y.transpose(0, 2, 1, 3).reshape(B, T_, nh * c.head_dim)
         y = jnp.dot(y, p["c_proj_w"].astype(x.dtype),
                     preferred_element_type=jnp.float32)
