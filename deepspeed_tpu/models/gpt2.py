@@ -422,15 +422,22 @@ class GPT2Model:
             xc, lc = xc_lc
             logits = jnp.dot(xc, w, preferred_element_type=jnp.float32)  # (B, C, V)
             lse = jax.nn.logsumexp(logits, axis=-1)
-            gold = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
-            return tot + jnp.sum(lse - gold), None
+            valid = (lc >= 0).astype(jnp.float32)  # < 0 = ignored (BERT's -100)
+            gold = jnp.take_along_axis(logits, jnp.maximum(lc, 0)[..., None],
+                                       axis=-1)[..., 0]
+            return (tot[0] + jnp.sum((lse - gold) * valid),
+                    tot[1] + jnp.sum(valid)), None
 
-        total, _ = jax.lax.scan(jax.checkpoint(body), jnp.zeros((), jnp.float32), (xs, ls))
-        return total / (B * T)
+        (total, n_valid), _ = jax.lax.scan(
+            jax.checkpoint(body),
+            (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)), (xs, ls))
+        return total / jnp.maximum(n_valid, 1.0)
 
     def apply(self, params, tokens, labels=None, rng=None):
-        """With labels: mean token cross-entropy loss (the training objective).
-        Without: fp32 logits. ``rng`` enables stateless dropout when config.dropout > 0."""
+        """With labels: mean token cross-entropy loss (the training objective);
+        negative labels (the -100 convention) are ignored — mask padding or the
+        roll-wrapped last position with them. Without labels: fp32 logits.
+        ``rng`` enables stateless dropout when config.dropout > 0."""
         if labels is None:
             return self.logits(params, tokens, rng=rng)
         c = self.config
@@ -444,8 +451,10 @@ class GPT2Model:
                 return self._chunked_ce(x, params["wte"], labels, chunk) + aux
         logits = jnp.dot(x, params["wte"].T.astype(x.dtype), preferred_element_type=jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-        return -jnp.mean(ll) + aux
+        valid = (labels >= 0).astype(jnp.float32)  # < 0 = ignored (BERT's -100)
+        ll = jnp.take_along_axis(logp, jnp.maximum(labels, 0)[..., None],
+                                 axis=-1)[..., 0]
+        return -jnp.sum(ll * valid) / jnp.maximum(jnp.sum(valid), 1.0) + aux
 
     # ------------------------------------------------------------- generation
     def _build_cached_forward(self, max_len: int):
@@ -456,6 +465,14 @@ class GPT2Model:
         tokens already cached."""
         c = self.config
         nh, hd = c.n_head, c.head_dim
+        if c.sparse_attention is not None and not getattr(
+                self, "_warned_sparse_decode", False):
+            self._warned_sparse_decode = True
+            from ..utils.logging import logger
+            logger.warning(
+                "[deepspeed_tpu] decode runs DENSE causal attention over the KV "
+                "cache — the sparse_attention layout applies to training "
+                "forwards only, so generated text reflects full attention")
 
         def attn_cached(x, bp, kc, vc, pos):
             B_, Tn, _ = x.shape

@@ -21,9 +21,9 @@ import numpy as np
 
 def synthetic_documents(rng, vocab, batch, seq):
     """Markov-ish synthetic tokens (learnable structure, unlike uniform noise)."""
-    base = rng.integers(0, vocab, size=(batch, seq // 8)).astype(np.int32)
+    base = rng.integers(0, vocab, size=(batch, (seq + 7) // 8)).astype(np.int32)
     toks = np.repeat(base, 8, axis=1)[:, :seq]
-    noise = rng.random((batch, seq)) < 0.1
+    noise = rng.random(toks.shape) < 0.1
     toks[noise] = rng.integers(0, vocab, size=int(noise.sum()))
     return toks
 
@@ -41,7 +41,8 @@ def main():
     p.add_argument("--fp32", action="store_true",
                help="disable the default bf16 compute policy")
     p.add_argument("--sparse", action="store_true",
-                   help="BigBird block-sparse attention (seq must divide 128)")
+                   help="BigBird block-sparse attention (seq must be a multiple "
+                        "of the attention block: 128 on TPU, 16 elsewhere)")
     args = p.parse_args()
 
     import jax
@@ -54,8 +55,9 @@ def main():
         # the compiled TPU kernel needs 128-multiple blocks; BigBird's default
         # window needs >= 4 block rows. CPU interpret mode accepts small blocks.
         block = 128 if jax.default_backend() == "tpu" else 16
-        if args.seq < 4 * block:
-            p.error(f"--sparse on this backend needs --seq >= {4 * block}")
+        if args.seq < 4 * block or args.seq % block:
+            p.error(f"--sparse on this backend needs --seq a multiple of {block}"
+                    f" and >= {4 * block}")
         sparse_cfg = BigBirdSparsityConfig(num_heads=args.heads, block=block)
 
     cfg = GPT2Config(vocab_size=args.vocab, n_positions=args.seq,
@@ -82,6 +84,7 @@ def main():
     for step in range(args.steps):
         tokens = synthetic_documents(rng, args.vocab, args.batch, args.seq)
         labels = np.roll(tokens, -1, axis=1)
+        labels[:, -1] = -100  # no next token for the last position (ignored)
         loss = engine(tokens, labels)
         engine.backward(loss)
         engine.step()

@@ -1,0 +1,54 @@
+"""GPT-2 training-loss semantics: ignore-label (-100) masking in both CE paths."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+V, T, E = 97, 64, 32
+
+
+def _model(loss_chunk):
+    cfg = GPT2Config(vocab_size=V, n_positions=T, n_embd=E, n_layer=2, n_head=2,
+                     compute_dtype=jnp.float32, loss_chunk=loss_chunk)
+    model = GPT2Model(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+@pytest.mark.parametrize("loss_chunk", [0, 16])  # unchunked + seq-chunked CE
+def test_negative_labels_are_ignored(loss_chunk):
+    model, params = _model(loss_chunk)
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(0, V, (2, T)), jnp.int32)
+    labels = np.roll(np.asarray(tokens), -1, axis=1)
+
+    # oracle: per-position log-probs from the logits
+    logp = np.asarray(jax.nn.log_softmax(
+        jnp.asarray(np.asarray(model.logits(params, tokens), np.float32)), axis=-1))
+
+    def oracle(lab):
+        tot = n = 0.0
+        for b in range(2):
+            for t in range(T):
+                if lab[b, t] >= 0:
+                    tot -= logp[b, t, lab[b, t]]
+                    n += 1
+        return tot / max(n, 1)
+
+    # mask the roll-wrapped last position (the documented use) + a random sprinkle
+    lab = labels.copy()
+    lab[:, -1] = -100
+    lab[0, 5] = -100
+    got = float(model.apply(params, tokens, jnp.asarray(lab)))
+    np.testing.assert_allclose(got, oracle(lab), rtol=1e-5, atol=1e-5)
+
+    # no ignored labels: same mean CE as before the masking feature
+    got_full = float(model.apply(params, tokens, jnp.asarray(labels)))
+    np.testing.assert_allclose(got_full, oracle(labels), rtol=1e-5, atol=1e-5)
+
+    # all ignored: zero loss, no NaN from the 0/0 guard
+    assert float(model.apply(params, tokens,
+                             jnp.full((2, T), -100, jnp.int32))) == 0.0
