@@ -16,7 +16,7 @@ from .config_utils import dict_raise_error_on_duplicate_keys, get_scalar_param
 from .constants import *
 from .zero.config import DeepSpeedZeroConfig
 from .zero.constants import (MAX_STAGE_ZERO_OPTIMIZATION, ZERO_OPTIMIZATION_GRADIENTS,
-                             ZERO_OPTIMIZATION_OPTIMIZER_STATES)
+                             ZERO_OPTIMIZATION_WEIGHTS)
 from .activation_checkpointing.config import DeepSpeedActivationCheckpointingConfig
 
 TENSOR_CORE_ALIGN_SIZE = 8  # MXU lane alignment hint (reference used tensor-core 8)
@@ -253,8 +253,13 @@ class DeepSpeedConfig:
             assert self.zero_optimization_stage <= MAX_STAGE_ZERO_OPTIMIZATION, (
                 f"DeepSpeedConfig: Maximum supported ZeRO stage is {MAX_STAGE_ZERO_OPTIMIZATION}")
             if self.zero_config.cpu_offload is True:
-                assert self.zero_optimization_stage == ZERO_OPTIMIZATION_GRADIENTS, (
-                    f"DeepSpeedConfig: cpu-offload supported ZeRO stage is {ZERO_OPTIMIZATION_GRADIENTS}")
+                # stage 2 is reference parity; stage 3 + offload (sharded compute
+                # params AND host-tier master/moments) composes here because the
+                # offload tier is partitioned by the same master layout
+                assert self.zero_optimization_stage in (
+                    ZERO_OPTIMIZATION_GRADIENTS, ZERO_OPTIMIZATION_WEIGHTS), (
+                    "DeepSpeedConfig: cpu-offload requires ZeRO stage "
+                    f"{ZERO_OPTIMIZATION_GRADIENTS} or {ZERO_OPTIMIZATION_WEIGHTS}")
 
     def _do_warning_check(self):
         # Unlike the reference (zero implied fp16), bf16 ZeRO is first-class here: only an

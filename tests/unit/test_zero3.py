@@ -26,12 +26,13 @@ from simple_model import SimpleModel, random_dataset, simple_config
 H = 64  # dp=8-divisible so every weight matrix shards
 
 
-def _engine(stage, hidden=H, batch=8, **cfg):
+def _engine(stage, hidden=H, batch=8, cpu_offload=False, **cfg):
     model = SimpleModel(hidden)
     params = model.init(jax.random.PRNGKey(0))
+    zero = {"stage": stage, "cpu_offload": cpu_offload}
     return DeepSpeedEngine(
         model=model, model_parameters=params,
-        config_params=simple_config(batch=batch, zero_optimization={"stage": stage},
+        config_params=simple_config(batch=batch, zero_optimization=zero,
                                     bf16={"enabled": True}, **cfg))
 
 
@@ -101,6 +102,22 @@ def test_zero3_checkpoint_roundtrip(tmp_path):
             assert not v.sharding.is_fully_replicated
 
 
+def test_zero3_composes_with_offload():
+    """Stage 3 + cpu_offload: compute params sharded over data AND master/moments
+    in the host tier (beyond-reference composition — the offload regions are
+    partitioned by the same master layout stage 3 gives the params). Trajectory
+    must match stage 2 + offload exactly (layouts don't change the math)."""
+    l3 = _run_steps(_engine(3, cpu_offload=True), n=6)
+    l2 = _run_steps(_engine(2, cpu_offload=True), n=6)
+    assert l3[-1] < l3[0], l3
+    np.testing.assert_allclose(l3, l2, rtol=1e-6, atol=1e-6)
+    eng = _engine(3, cpu_offload=True)
+    assert eng._offload is not None
+    for name, leaf in eng.params.items():
+        if leaf.ndim == 2:
+            assert not leaf.sharding.is_fully_replicated, name
+
+
 def test_zero3_composes_with_spmd_pipeline():
     """Public-API PipelineModule + stage 3: ZeRO claims a free data-divisible axis
     ON TOP of the pipe-stacked stage layout for the compute params too (true
@@ -152,8 +169,13 @@ def test_zero3_config_validation():
     with pytest.raises(AssertionError):
         DeepSpeedConfig({"train_batch_size": 8, "bf16": {"enabled": True},
                          "zero_optimization": {"stage": 4}}, world_size=8)
+    # cpu_offload composes with stage 3 (host master + sharded compute params);
+    # stage 1 still rejects it
+    cfg3 = DeepSpeedConfig({"train_batch_size": 8, "bf16": {"enabled": True},
+                            "zero_optimization": {"stage": 3, "cpu_offload": True}},
+                           world_size=8)
+    assert cfg3.zero_config.cpu_offload
     with pytest.raises(AssertionError):
-        # cpu_offload remains a stage-2 feature (reference parity)
         DeepSpeedConfig({"train_batch_size": 8, "bf16": {"enabled": True},
-                         "zero_optimization": {"stage": 3, "cpu_offload": True}},
+                         "zero_optimization": {"stage": 1, "cpu_offload": True}},
                         world_size=8)
