@@ -165,9 +165,16 @@ class GPT2Model:
 
         def model_fn(params, tokens, labels, rng=None):
             def local(params, tokens, labels, *r):
-                # equal shards: global token mean = mean of per-rank means
-                return jax.lax.pmean(
-                    sp.apply(params, tokens, labels, rng=(r[0] if r else None)), axis)
+                # sum-of-losses / sum-of-counts across ranks: with ignore labels
+                # (-100) the per-rank VALID counts differ, so a pmean of per-rank
+                # means would over-weight ranks holding masked positions (and a
+                # fully-masked chunk would scale the loss by (sp-1)/sp)
+                local_mean = sp.apply(params, tokens, labels,
+                                      rng=(r[0] if r else None))
+                n_valid = jnp.sum((labels >= 0).astype(jnp.float32))
+                total = jax.lax.psum(local_mean * n_valid, axis)
+                count = jax.lax.psum(n_valid, axis)
+                return total / jnp.maximum(count, 1.0)
 
             args = (params, tokens, labels) + (() if rng is None else (rng,))
             in_specs = (P(), tok_spec, tok_spec) + (() if rng is None else (P(),))
@@ -457,6 +464,17 @@ class GPT2Model:
         return -jnp.sum(ll * valid) / jnp.maximum(jnp.sum(valid), 1.0) + aux
 
     # ------------------------------------------------------------- generation
+    def _cached_jit(self, key, fn):
+        """Per-model decode-program cache: generate and beam_search share it (the
+        shape-keyed ``("prefill", ...)`` entries are deliberately common so any
+        decode variant reuses the expensive prompt program)."""
+        cache = getattr(self, "_gen_jit_cache", None)
+        if cache is None:
+            cache = self._gen_jit_cache = {}
+        if key not in cache:
+            cache[key] = jax.jit(fn)
+        return cache[key]
+
     def _build_cached_forward(self, max_len: int):
         """Incremental forward over per-layer KV caches, shared by ``generate``
         and ``beam_search``: ``forward(p, toks [B, Tn], pos, kcs, vcs) ->
@@ -523,7 +541,7 @@ class GPT2Model:
         return forward
 
     def beam_search(self, params, tokens, max_new_tokens: int, num_beams: int = 4,
-                    eos_token_id=None, length_penalty: float = 1.0):
+                    *, eos_token_id=None, length_penalty: float = 1.0):
         """KV-cached beam search: prefill once, expand to ``num_beams`` beams per
         batch row, then a ``lax.scan`` of single-token steps that keeps the K
         highest-scoring hypotheses (summed token log-probs). With
@@ -535,6 +553,10 @@ class GPT2Model:
         assert self.tp_axis is None and self.seq_axis is None, \
             "beam_search() supports the plain (non-shard_map) model"
         assert max_new_tokens >= 1 and num_beams >= 1
+        assert num_beams <= self.config.vocab_size, \
+            f"num_beams {num_beams} exceeds vocab_size {self.config.vocab_size}"
+        assert eos_token_id is None or 0 <= eos_token_id < self.config.vocab_size, \
+            f"eos_token_id {eos_token_id} outside vocab [0, {self.config.vocab_size})"
         c = self.config
         B, T0 = tokens.shape
         K = int(num_beams)
@@ -608,16 +630,9 @@ class GPT2Model:
 
         # the prefill program depends only on shapes — key it separately so
         # varying num_beams/eos/length_penalty reuses the expensive prompt jit
-        pre_sig = ("prefill", B, T0, max_len)
-        sig = ("beam", B, T0, L, K, eos, float(length_penalty))
-        cache = getattr(self, "_gen_jit_cache", None)
-        if cache is None:
-            cache = self._gen_jit_cache = {}
-        if pre_sig not in cache:
-            cache[pre_sig] = jax.jit(forward)
-        if sig not in cache:
-            cache[sig] = jax.jit(decode)
-        jit_forward, jit_decode = cache[pre_sig], cache[sig]
+        jit_forward = self._cached_jit(("prefill", B, T0, max_len), forward)
+        jit_decode = self._cached_jit(
+            ("beam", B, T0, L, K, eos, float(length_penalty)), decode)
 
         cache_shape = (c.n_layer, B, c.n_head, max_len, c.head_dim)
         kcs = jnp.zeros(cache_shape, c.compute_dtype)
@@ -627,7 +642,7 @@ class GPT2Model:
         return jnp.concatenate([tokens, gen.astype(tokens.dtype)], axis=1), scores
 
     def generate(self, params, tokens, max_new_tokens: int,
-                 temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+                 temperature: float = 0.0, *, top_k: int = 0, top_p: float = 1.0,
                  rng=None):
         """Autoregressive decode with per-layer KV caches: one jitted prefill over
         the prompt, then a ``lax.scan`` of single-token steps that append to
@@ -695,17 +710,10 @@ class GPT2Model:
         # jit arguments, not closure captures. The prefill depends only on
         # shapes (same key beam_search uses), so sampling-parameter variants
         # share the expensive prompt program.
-        pre_sig = ("prefill", B, T0, max_len)
-        sig = (B, T0, int(max_new_tokens), float(temperature), int(top_k),
-               float(top_p), str(out_dtype))
-        cache = getattr(self, "_gen_jit_cache", None)
-        if cache is None:
-            cache = self._gen_jit_cache = {}
-        if pre_sig not in cache:
-            cache[pre_sig] = jax.jit(forward)
-        if sig not in cache:
-            cache[sig] = jax.jit(decode)
-        jit_forward, jit_decode = cache[pre_sig], cache[sig]
+        jit_forward = self._cached_jit(("prefill", B, T0, max_len), forward)
+        jit_decode = self._cached_jit(
+            (B, T0, int(max_new_tokens), float(temperature), int(top_k),
+             float(top_p), str(out_dtype)), decode)
 
         cache_shape = (c.n_layer, B, nh, max_len, hd)
         kcs = jnp.zeros(cache_shape, c.compute_dtype)

@@ -40,6 +40,19 @@ class SparsityConfig:
         different sequence lengths) are themselves reproducible."""
         return random.Random(self.layout_seed)
 
+    def set_random_layout(self, h, layout, rng=None):
+        """Per-row random blocks for patterns with ``num_random_blocks``
+        (Variable, BigBird); shared here so the sampling logic has one home."""
+        num_blocks = layout.shape[1]
+        if num_blocks < self.num_random_blocks:
+            raise ValueError(f"sparse layout: num_random_blocks={self.num_random_blocks} "
+                             f"exceeds the {num_blocks} blocks per row")
+        rng = rng or self.layout_rng()
+        for row in range(num_blocks):
+            rnd_cols = rng.sample(range(num_blocks), self.num_random_blocks)
+            layout[h, row, rnd_cols] = 1
+        return layout
+
     def setup_layout(self, seq_len) -> np.ndarray:
         if seq_len % self.block != 0:
             raise ValueError(f"sparse layout: seq_len={seq_len} is not a multiple of block={self.block}")
@@ -173,17 +186,6 @@ class VariableSparsityConfig(SparsityConfig):
                              "attention='bidirectional'")
         self.horizontal_global_attention = horizontal_global_attention
 
-    def set_random_layout(self, h, layout, rng=None):
-        num_blocks = layout.shape[1]
-        if num_blocks < self.num_random_blocks:
-            raise ValueError(f"sparse layout: num_random_blocks={self.num_random_blocks} "
-                             f"exceeds the {num_blocks} blocks per row")
-        rng = rng or self.layout_rng()
-        for row in range(num_blocks):
-            rnd_cols = rng.sample(range(num_blocks), self.num_random_blocks)
-            layout[h, row, rnd_cols] = 1
-        return layout
-
     def set_local_layout(self, h, layout):
         num_blocks = layout.shape[1]
         start = 0
@@ -247,17 +249,6 @@ class BigBirdSparsityConfig(SparsityConfig):
         self.num_random_blocks = num_random_blocks
         self.num_sliding_window_blocks = num_sliding_window_blocks
         self.num_global_blocks = num_global_blocks
-
-    def set_random_layout(self, h, layout, rng=None):
-        num_blocks = layout.shape[1]
-        if num_blocks < self.num_random_blocks:
-            raise ValueError(f"sparse layout: num_random_blocks={self.num_random_blocks} "
-                             f"exceeds the {num_blocks} blocks per row")
-        rng = rng or self.layout_rng()
-        for row in range(num_blocks):
-            rnd_cols = rng.sample(range(num_blocks), self.num_random_blocks)
-            layout[h, row, rnd_cols] = 1
-        return layout
 
     def set_sliding_window_layout(self, h, layout):
         num_blocks = layout.shape[1]

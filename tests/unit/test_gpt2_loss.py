@@ -52,3 +52,24 @@ def test_negative_labels_are_ignored(loss_chunk):
     # all ignored: zero loss, no NaN from the 0/0 guard
     assert float(model.apply(params, tokens,
                              jnp.full((2, T), -100, jnp.int32))) == 0.0
+
+
+def test_sequence_parallel_loss_weights_ignored_labels_globally():
+    """Ranks hold UNEQUAL valid counts when -100 labels cluster in one chunk:
+    the sp loss must be sum-loss/sum-count across ranks (a pmean of per-rank
+    means would over-weight the masked rank), i.e. exactly the single-program
+    masked loss."""
+    from deepspeed_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(data=8, model=1, pipe=1)
+    model, params = _model(0)
+    sp_fn = model.sequence_parallel_loss_fn(mesh, "data")
+    rng = np.random.default_rng(2)
+    tokens = jnp.asarray(rng.integers(0, V, (2, T)), jnp.int32)
+    labels = np.roll(np.asarray(tokens), -1, axis=1)
+    labels[:, T // 2:] = -100          # the back half (4 of 8 ranks) fully masked
+    labels[:, 3] = -100                # plus a sprinkle in rank 0
+    labels = jnp.asarray(labels)
+    got = float(jax.jit(sp_fn)(params, tokens, labels))
+    want = float(model.apply(params, tokens, labels))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
