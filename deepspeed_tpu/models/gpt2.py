@@ -147,7 +147,7 @@ class GPT2Model:
             "(the ring path would silently ignore the layout)"
         # MoE composes: the dense dispatch routes each rank's LOCAL sequence chunk
         # (per-chunk capacity; experts replicated inside the shard_map) and the aux
-        # term folds into the pmean'd loss
+        # term is pmean'd unweighted alongside the count-weighted CE
         m = GPT2Model(self.config)
         m.seq_axis = axis
         return m
@@ -168,13 +168,16 @@ class GPT2Model:
                 # sum-of-losses / sum-of-counts across ranks: with ignore labels
                 # (-100) the per-rank VALID counts differ, so a pmean of per-rank
                 # means would over-weight ranks holding masked positions (and a
-                # fully-masked chunk would scale the loss by (sp-1)/sp)
-                local_mean = sp.apply(params, tokens, labels,
-                                      rng=(r[0] if r else None))
+                # fully-masked chunk would scale the loss by (sp-1)/sp). The MoE
+                # aux term is a per-chunk load-balancing mean, NOT a per-token
+                # loss — it stays a plain pmean so label masking can't reweight
+                # (or, for a fully-masked rank, drop) its contribution.
+                ce_mean, aux = sp.apply_parts(params, tokens, labels,
+                                              rng=(r[0] if r else None))
                 n_valid = jnp.sum((labels >= 0).astype(jnp.float32))
-                total = jax.lax.psum(local_mean * n_valid, axis)
+                total = jax.lax.psum(ce_mean * n_valid, axis)
                 count = jax.lax.psum(n_valid, axis)
-                return total / jnp.maximum(count, 1.0)
+                return total / jnp.maximum(count, 1.0) + jax.lax.pmean(aux, axis)
 
             args = (params, tokens, labels) + (() if rng is None else (rng,))
             in_specs = (P(), tok_spec, tok_spec) + (() if rng is None else (P(),))
@@ -440,6 +443,29 @@ class GPT2Model:
             (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)), (xs, ls))
         return total / jnp.maximum(n_valid, 1.0)
 
+    def apply_parts(self, params, tokens, labels, rng=None):
+        """``(ce_mean, weighted_aux)`` — the two training-loss components kept
+        separate. ``apply`` returns their sum; the sequence-parallel wrapper
+        needs them apart (CE is psum-weighted across ranks by valid-label
+        count, while the MoE load-balancing aux — already a per-chunk mean —
+        is pmean'd unweighted so masked labels don't reweight it)."""
+        c = self.config
+        x, aux = self._backbone(params, tokens, rng=rng)
+        aux = (c.moe_aux_weight * aux if self._moe is not None
+               else jnp.zeros((), jnp.float32))
+        T = x.shape[1]
+        if c.loss_chunk:
+            # largest divisor of T not exceeding loss_chunk (static shapes for XLA)
+            chunk = next(cc for cc in range(min(c.loss_chunk, T), 0, -1) if T % cc == 0)
+            if chunk < T:
+                return self._chunked_ce(x, params["wte"], labels, chunk), aux
+        logits = jnp.dot(x, params["wte"].T.astype(x.dtype), preferred_element_type=jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        valid = (labels >= 0).astype(jnp.float32)  # < 0 = ignored (BERT's -100)
+        ll = jnp.take_along_axis(logp, jnp.maximum(labels, 0)[..., None],
+                                 axis=-1)[..., 0]
+        return -jnp.sum(ll * valid) / jnp.maximum(jnp.sum(valid), 1.0), aux
+
     def apply(self, params, tokens, labels=None, rng=None):
         """With labels: mean token cross-entropy loss (the training objective);
         negative labels (the -100 convention) are ignored — mask padding or the
@@ -447,21 +473,8 @@ class GPT2Model:
         ``rng`` enables stateless dropout when config.dropout > 0."""
         if labels is None:
             return self.logits(params, tokens, rng=rng)
-        c = self.config
-        x, aux = self._backbone(params, tokens, rng=rng)
-        aux = c.moe_aux_weight * aux if self._moe is not None else 0.0
-        T = x.shape[1]
-        if c.loss_chunk:
-            # largest divisor of T not exceeding loss_chunk (static shapes for XLA)
-            chunk = next(cc for cc in range(min(c.loss_chunk, T), 0, -1) if T % cc == 0)
-            if chunk < T:
-                return self._chunked_ce(x, params["wte"], labels, chunk) + aux
-        logits = jnp.dot(x, params["wte"].T.astype(x.dtype), preferred_element_type=jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        valid = (labels >= 0).astype(jnp.float32)  # < 0 = ignored (BERT's -100)
-        ll = jnp.take_along_axis(logp, jnp.maximum(labels, 0)[..., None],
-                                 axis=-1)[..., 0]
-        return -jnp.sum(ll * valid) / jnp.maximum(jnp.sum(valid), 1.0) + aux
+        ce, aux = self.apply_parts(params, tokens, labels, rng=rng)
+        return ce + aux
 
     # ------------------------------------------------------------- generation
     def _cached_jit(self, key, fn):
@@ -642,8 +655,8 @@ class GPT2Model:
         return jnp.concatenate([tokens, gen.astype(tokens.dtype)], axis=1), scores
 
     def generate(self, params, tokens, max_new_tokens: int,
-                 temperature: float = 0.0, *, top_k: int = 0, top_p: float = 1.0,
-                 rng=None):
+                 temperature: float = 0.0, rng=None, *, top_k: int = 0,
+                 top_p: float = 1.0):
         """Autoregressive decode with per-layer KV caches: one jitted prefill over
         the prompt, then a ``lax.scan`` of single-token steps that append to
         static-length caches (no recompilation per step, no O(T²) re-forward).
@@ -682,16 +695,19 @@ class GPT2Model:
                 kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
                 logits = jnp.where(logits < kth, jnp.float32(-jnp.inf), logits)
             if top_p < 1.0:
-                sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+                order = jnp.argsort(logits, axis=-1)[..., ::-1]
+                sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
                 probs = jax.nn.softmax(sorted_logits, axis=-1)
                 # exclusive cumulative mass BEFORE each token: a token stays while
                 # the mass ahead of it is under top_p, so the kept set is the
-                # smallest prefix reaching top_p (the argmax always stays)
+                # smallest prefix reaching top_p (the argmax always stays). The
+                # keep mask is scattered back by SORT POSITION, not logit value,
+                # so tokens tying the cutoff logit don't expand the nucleus.
                 mass_before = jnp.cumsum(probs, axis=-1) - probs
-                kept = mass_before < top_p
-                cutoff = jnp.sum(kept, axis=-1, keepdims=True) - 1
-                threshold = jnp.take_along_axis(sorted_logits, cutoff, axis=-1)
-                logits = jnp.where(logits < threshold, jnp.float32(-jnp.inf), logits)
+                kept_sorted = mass_before < top_p
+                inv = jnp.argsort(order, axis=-1)
+                kept = jnp.take_along_axis(kept_sorted, inv, axis=-1)
+                logits = jnp.where(kept, logits, jnp.float32(-jnp.inf))
             return jax.random.categorical(key, logits, axis=-1).astype(out_dtype)
 
         def decode(p, first, kcs, vcs, keys):

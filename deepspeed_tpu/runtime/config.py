@@ -107,6 +107,13 @@ class DeepSpeedConfig:
         except Exception:
             pass
 
+        # warn about unrecognized keys BEFORE batch inference/error checks: a typo'd
+        # batch key would otherwise abort on the missing-batch assertion without the
+        # user ever seeing which key went unrecognized
+        unknown = sorted(k for k in self._param_dict if k not in TOP_LEVEL_CONFIG_KEYS)
+        if unknown:
+            logger.warning(f"DeepSpeedConfig: unknown top-level config key(s) {unknown} "
+                           "— ignored. Known keys: see docs/config-json.md.")
         self._initialize_params(self._param_dict)
         self._configure_train_batch_size()
         self._do_sanity_check()
@@ -126,6 +133,27 @@ class DeepSpeedConfig:
         self.disable_allgather = get_scalar_param(param_dict, DISABLE_ALLGATHER, DISABLE_ALLGATHER_DEFAULT)
         self.allreduce_always_fp32 = get_scalar_param(param_dict, ALLREDUCE_ALWAYS_FP32,
                                                       ALLREDUCE_ALWAYS_FP32_DEFAULT)
+        if get_scalar_param(param_dict, FP32_ALLREDUCE, FP32_ALLREDUCE_DEFAULT):
+            # deprecated alias from the reference constants (constants.py:191-196):
+            # fold into allreduce_always_fp32 rather than silently dropping it
+            logger.warning(f"DeepSpeedConfig: '{FP32_ALLREDUCE}' is deprecated; it is "
+                           f"honored as '{ALLREDUCE_ALWAYS_FP32}'.")
+            self.allreduce_always_fp32 = True
+        self.communication_data_type = get_scalar_param(param_dict, COMMUNICATION_DATA_TYPE,
+                                                        COMMUNICATION_DATA_TYPE_DEFAULT)
+        if self.communication_data_type is not None:
+            allowed = ("fp32", "fp16", "bf16")
+            if self.communication_data_type not in allowed:
+                raise ValueError(f"DeepSpeedConfig: {COMMUNICATION_DATA_TYPE} must be one of "
+                                 f"{allowed} (got {self.communication_data_type!r})")
+            if self.communication_data_type == "fp16" and not param_dict.get(FP16, {}).get(
+                    FP16_ENABLED, FP16_ENABLED_DEFAULT):
+                # grads are PRODUCED in this dtype (the psum then rides it), so fp16
+                # without the loss-scaling block risks overflow even at dp=1
+                logger.warning(f"DeepSpeedConfig: {COMMUNICATION_DATA_TYPE}='fp16' without "
+                               "the fp16 loss-scaling block: gradients are cast to fp16 "
+                               "before reduction and may overflow (|g| > 65504). Prefer "
+                               "'bf16', or enable the fp16 block.")
         self.prescale_gradients = get_scalar_param(param_dict, PRESCALE_GRADIENTS, PRESCALE_GRADIENTS_DEFAULT)
         self.fused_step = get_scalar_param(param_dict, FUSED_STEP, FUSED_STEP_DEFAULT)
         self.compilation_cache_dir = get_scalar_param(param_dict, COMPILATION_CACHE_DIR,
@@ -159,6 +187,20 @@ class DeepSpeedConfig:
         amp_dict = param_dict.get(AMP, {})
         self.amp_enabled = get_scalar_param(amp_dict, AMP_ENABLED, AMP_ENABLED_DEFAULT)
         self.amp_params = {k: v for k, v in amp_dict.items() if k != AMP_ENABLED}
+        if self.amp_enabled:
+            # apex.amp is CUDA-only; its O1/O2 mixed precision maps to the TPU-native
+            # bf16 policy (low-precision compute, fp32 master/optimizer state). Act,
+            # don't no-op: enable the bf16 policy and say so. fp16+amp is rejected in
+            # _do_error_check (reference engine.py:530-531).
+            logger.warning("DeepSpeedConfig: 'amp' maps to the TPU-native bf16 mixed-"
+                           "precision policy (apex is CUDA-only); amp opt-level params "
+                           f"{self.amp_params or '{}'} are ignored. Prefer the 'bf16' "
+                           "block (docs/config-json.md).")
+            if not self.fp16_enabled:
+                self.bf16_enabled = True
+
+        self.zero_allow_untested_optimizer = get_scalar_param(
+            param_dict, ZERO_ALLOW_UNTESTED_OPTIMIZER, ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT)
 
         optimizer_dict = param_dict.get(OPTIMIZER, None)
         self.optimizer_name = None
@@ -239,12 +281,51 @@ class DeepSpeedConfig:
     def _do_sanity_check(self):
         self._do_error_check()
         self._do_warning_check()
+        self._do_compat_check()
+
+    def _do_compat_check(self):
+        """Every accepted key must act, warn, or error — never silently no-op
+        (reference: config.py:633-670 runs error/warning checks; this adds the
+        TPU-migration diagnostics for keys whose CUDA mechanism has no GSPMD
+        analog)."""
+        if (ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEPRECATED in self._param_dict
+                and not isinstance(self._param_dict.get(ZERO_OPTIMIZATION), bool)):
+            logger.warning(f"DeepSpeedConfig: '{ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEPRECATED}' "
+                           "is the deprecated companion of the boolean zero_optimization form and "
+                           "is only honored there — ignored (use the zero_optimization block).")
+        if self.disable_allgather:
+            logger.warning(f"DeepSpeedConfig: '{DISABLE_ALLGATHER}' selects the reference's "
+                           "allreduce-instead-of-allgather fallback for its hand-written ZeRO "
+                           "collectives; XLA GSPMD chooses collectives from the sharding "
+                           "layout here, so the key has no effect.")
+        if self.optimizer_legacy_fusion:
+            logger.warning(f"DeepSpeedConfig: optimizer '{LEGACY_FUSION}' switches the "
+                           "reference's CUDA fused-kernel variant; the TPU optimizer update "
+                           "is one XLA-fused jit either way, so the key has no effect.")
+        zc = self.zero_config
+        if getattr(zc, "explicit_tuning_keys", ()):
+            logger.warning("DeepSpeedConfig: zero_optimization buffer-tuning key(s) "
+                           f"{list(zc.explicit_tuning_keys)} tune the reference's bucketed "
+                           "collectives; GSPMD schedules collectives from shardings here, "
+                           "so they have no effect.")
+        if getattr(zc, "unknown_keys", ()):
+            logger.warning(f"DeepSpeedConfig: unknown zero_optimization key(s) "
+                           f"{list(zc.unknown_keys)} — ignored.")
+        if zc.elastic_checkpoint is False:
+            logger.warning("DeepSpeedConfig: zero_optimization.elastic_checkpoint=false has "
+                           "no effect — checkpoints are always elastic-loadable here (the "
+                           "loader merges/repartitions optimizer shards across DP sizes).")
 
     def _do_error_check(self):
         assert self.train_micro_batch_size_per_gpu, (
             f"DeepSpeedConfig: {TRAIN_MICRO_BATCH_SIZE_PER_GPU} is not defined")
         assert self.gradient_accumulation_steps, (
             f"DeepSpeedConfig: {GRADIENT_ACCUMULATION_STEPS} is not defined")
+        if self.amp_enabled:
+            # reference engine.py:530-531: amp and legacy fp16 are mutually exclusive
+            assert not self.fp16_enabled, (
+                "DeepSpeedConfig: cannot enable both amp and the fp16 block — pick one "
+                "mixed-precision policy (on TPU, prefer the default bf16)")
         if self.zero_enabled:
             # Reference requires fp16 for ZeRO; on TPU any low-precision policy (bf16 default)
             # satisfies the same "mixed precision master weights" contract.

@@ -215,3 +215,56 @@ PIPELINE_SEED_LAYERS = "seed_layers"
 PIPELINE_SEED_LAYERS_DEFAULT = False
 PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL = "activation_checkpoint_interval"
 PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL_DEFAULT = 0
+
+#############################################
+# ZeRO client-optimizer opt-in (reference constants: zero_allow_untested_optimizer)
+#############################################
+ZERO_ALLOW_UNTESTED_OPTIMIZER = "zero_allow_untested_optimizer"
+ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT = False
+
+#############################################
+# Key registry
+#############################################
+from .zero.constants import (ZERO_OPTIMIZATION,
+                             ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEPRECATED)
+from .activation_checkpointing.config import ACTIVATION_CHKPT
+
+# Every recognized TOP-LEVEL JSON config key. DeepSpeedConfig warns about any
+# top-level key not in this set (reference parity: config.py:633-670 error/
+# warning checks), and tests/unit/test_config_keys.py sweeps the registry
+# asserting each key either changes engine-visible config state or emits a
+# diagnostic — no key may silently no-op.
+TOP_LEVEL_CONFIG_KEYS = frozenset({
+    TRAIN_BATCH_SIZE,
+    TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+    TRAIN_MICRO_BATCH_SIZE_PER_DEVICE,
+    GRADIENT_ACCUMULATION_STEPS,
+    SPARSE_GRADIENTS,
+    OPTIMIZER,
+    SCHEDULER,
+    FP16,
+    BF16,
+    AMP,
+    GRADIENT_CLIPPING,
+    COMMUNICATION_DATA_TYPE,
+    PRESCALE_GRADIENTS,
+    FUSED_STEP,
+    COMPILATION_CACHE_DIR,
+    GRADIENT_PREDIVIDE_FACTOR,
+    DISABLE_ALLGATHER,
+    ALLREDUCE_ALWAYS_FP32,
+    FP32_ALLREDUCE,
+    STEPS_PER_PRINT,
+    DUMP_STATE,
+    VOCABULARY_SIZE,
+    WALL_CLOCK_BREAKDOWN,
+    MEMORY_BREAKDOWN,
+    TENSORBOARD,
+    SPARSE_ATTENTION,
+    PIPELINE,
+    ZERO_OPTIMIZATION,
+    ZERO_ALLOW_UNTESTED_OPTIMIZER,
+    ACTIVATION_CHKPT,
+    # deprecated boolean-zero companion (zero/config.py read_zero_config_deprecated)
+    ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEPRECATED,
+})

@@ -546,6 +546,16 @@ class DeepSpeedEngine:
             if isinstance(client_optimizer, tuple) and len(client_optimizer) == 2:
                 assert not specs, ("param_groups require a built-in optimizer; a client "
                                    "(init, apply) pair has no groups kwarg contract")
+                if self.config.zero_enabled:
+                    # reference engine.py:521-528: unknown optimizers under ZeRO need an
+                    # explicit opt-in (sharded state layouts are derived from the state
+                    # tree the client's init returns; untested shapes may shard poorly)
+                    assert self.config.zero_allow_untested_optimizer, (
+                        'You are using an untested ZeRO Optimizer. Please add '
+                        '<"zero_allow_untested_optimizer": true> in the configuration '
+                        'file to use it.')
+                    log_dist("**** You are using ZeRO with an untested optimizer, "
+                             "proceed with caution *****", ranks=[0])
                 self._opt_init, self._opt_apply = client_optimizer
                 self.optimizer = OptimizerHandle("client", self.config.optimizer_params or {})
             else:
@@ -658,9 +668,19 @@ class DeepSpeedEngine:
         # fp32 master update) — halving the grad HBM footprint that bounds max model
         # size per chip. Stage <= 1 keeps fp32 grads (the reference's fp32 allreduce
         # option); the optimizer update always upcasts per-leaf inside its fused loop.
+        # `allreduce_always_fp32` (reference engine.py:1016-1089 upcasts the allreduce
+        # tensor) and `communication_data_type` override the default: grads are
+        # produced in grad_dtype, so the psum XLA inserts over the data axis rides
+        # the wire in exactly this dtype.
         zero_stage_ = self.zero_optimization_stage()
         grad_dtype = (compute_dtype if (self._offload is not None or zero_stage_ >= 2)
                       else jnp.float32)
+        if self.config.allreduce_always_fp32:
+            grad_dtype = jnp.float32
+        if self.config.communication_data_type is not None:
+            grad_dtype = {"fp32": jnp.float32, "fp16": jnp.float16,
+                          "bf16": jnp.bfloat16}[self.config.communication_data_type]
+        self._grad_dtype = grad_dtype
 
         def local_loss_and_grad(params, scale, *batch):
             def scaled_loss_fn(p):

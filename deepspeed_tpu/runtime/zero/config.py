@@ -18,27 +18,44 @@ class DeepSpeedZeroConfig:
         self.cpu_offload = None
         self.elastic_checkpoint = None
 
-        if ZERO_OPTIMIZATION in param_dict:
+        user_configured = ZERO_OPTIMIZATION in param_dict
+        if user_configured:
             zero_config_dict = param_dict[ZERO_OPTIMIZATION]
             if isinstance(zero_config_dict, bool):
                 zero_config_dict = self.read_zero_config_deprecated(param_dict)
         else:
             zero_config_dict = ZERO_OPTIMIZATION_DEFAULT
 
-        self._initialize(zero_config_dict)
+        self._initialize(zero_config_dict, user_configured)
 
     def read_zero_config_deprecated(self, param_dict):
         zero_config_dict = {}
         zero_config_dict[ZERO_OPTIMIZATION_STAGE] = 1 if param_dict[ZERO_OPTIMIZATION] else 0
-        if zero_config_dict[ZERO_OPTIMIZATION_STAGE] > 0:
-            zero_config_dict[ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE] = get_scalar_param(
-                param_dict, ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEPRECATED,
-                ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEFAULT)
+        if (zero_config_dict[ZERO_OPTIMIZATION_STAGE] > 0
+                and ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEPRECATED in param_dict):
+            # only when the user actually set the companion key — inserting the
+            # default here would trip the explicit-tuning-key warning spuriously
+            zero_config_dict[ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE] = param_dict[
+                ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEPRECATED]
         logger.warning("DeepSpeedConfig: this format of ZeRO optimization setup is deprecated: '{}'".format(
             ZERO_FORMAT))
         return zero_config_dict
 
-    def _initialize(self, zero_config_dict):
+    def _initialize(self, zero_config_dict, user_configured=True):
+        # Buffer/bucket tuning keys steer the reference's hand-written collectives
+        # (stage2.py bucketed allreduce); XLA/GSPMD schedules collectives here, so
+        # they cannot act. Record which ones the user EXPLICITLY set (not the
+        # defaults dict) so DeepSpeedConfig can warn instead of silently ignoring.
+        _tuning_keys = (ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS, ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE,
+                        ZERO_OPTIMIZATION_REDUCE_SCATTER, ZERO_OPTIMIZATION_OVERLAP_COMM,
+                        ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS, ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE)
+        if user_configured:
+            _acting_keys = _tuning_keys + (ZERO_OPTIMIZATION_STAGE, ZERO_OPTIMIZATION_CPU_OFFLOAD,
+                                           ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT)
+            self.explicit_tuning_keys = tuple(k for k in _tuning_keys if k in zero_config_dict)
+            self.unknown_keys = tuple(k for k in zero_config_dict if k not in _acting_keys)
+        else:
+            self.explicit_tuning_keys = self.unknown_keys = ()
         self.stage = get_scalar_param(zero_config_dict, ZERO_OPTIMIZATION_STAGE, ZERO_OPTIMIZATION_STAGE_DEFAULT)
         self.contiguous_gradients = get_scalar_param(zero_config_dict, ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS,
                                                      ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS_DEFAULT)

@@ -1,0 +1,205 @@
+"""Every accepted config key acts, warns, or errors — never a silent no-op.
+
+Sweeps the TOP_LEVEL_CONFIG_KEYS registry (runtime/constants.py): for each key,
+setting a non-default value must either change engine-visible DeepSpeedConfig
+state, emit a diagnostic through the package logger, or raise. Mirrors the
+reference's error/warning discipline (deepspeed/runtime/config.py:633-670) and
+extends it with the TPU-migration diagnostics for keys whose CUDA mechanism
+(apex amp, hand-written bucketed collectives, fused-kernel variants) has no
+GSPMD analog.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.constants import TOP_LEVEL_CONFIG_KEYS
+from deepspeed_tpu.utils import logger
+
+
+class _Capture(logging.Handler):
+    def __init__(self):
+        super().__init__(level=logging.DEBUG)
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+    @property
+    def text(self):
+        return "\n".join(r.getMessage() for r in self.records)
+
+
+@pytest.fixture
+def capture():
+    h = _Capture()
+    logger.addHandler(h)
+    try:
+        yield h
+    finally:
+        logger.removeHandler(h)
+
+
+BASE = {"train_batch_size": 8}
+
+
+def _cfg(**over):
+    d = dict(BASE)
+    d.update(over)
+    return DeepSpeedConfig(d, world_size=1)
+
+
+# key -> (test value, expectation). Expectations:
+#   ("attr", name, value)  config attribute takes the value
+#   ("warn", substring)    diagnostic emitted containing substring
+#   ("raise", exc)         parse rejects the value
+# A key may map to a tuple of several (value, expectation) probes.
+SWEEP = {
+    "train_batch_size": (16, ("attr", "train_batch_size", 16)),
+    "train_micro_batch_size_per_gpu": (4, ("attr", "train_micro_batch_size_per_gpu", 4)),
+    "train_micro_batch_size_per_device": (4, ("attr", "train_micro_batch_size_per_gpu", 4)),
+    "gradient_accumulation_steps": (2, ("attr", "gradient_accumulation_steps", 2)),
+    "sparse_gradients": (True, ("attr", "sparse_gradients_enabled", True)),
+    "optimizer": ({"type": "Lamb", "params": {"lr": 1e-3}},
+                  ("attr", "optimizer_name", "lamb")),
+    "scheduler": ({"type": "WarmupLR", "params": {}},
+                  ("attr", "scheduler_name", "WarmupLR")),
+    "fp16": ({"enabled": True, "loss_scale": 128}, ("attr", "loss_scale", 128)),
+    "bf16": ({"enabled": False}, ("attr", "bf16_enabled", False)),
+    "amp": ({"enabled": True, "opt_level": "O1"}, ("warn", "bf16")),
+    "gradient_clipping": (1.0, ("attr", "gradient_clipping", 1.0)),
+    "communication_data_type": (
+        ("fp16", ("attr", "communication_data_type", "fp16")),
+        ("int8", ("raise", ValueError)),
+    ),
+    "prescale_gradients": (True, ("attr", "prescale_gradients", True)),
+    "fused_step": (True, ("attr", "fused_step", True)),
+    "compilation_cache_dir": ("/tmp/xla-cache", ("attr", "compilation_cache_dir", "/tmp/xla-cache")),
+    "gradient_predivide_factor": (2.0, ("attr", "gradient_predivide_factor", 2.0)),
+    "disable_allgather": (True, ("warn", "no effect")),
+    "allreduce_always_fp32": (True, ("attr", "allreduce_always_fp32", True)),
+    "fp32_allreduce": (True, ("warn", "deprecated")),
+    "steps_per_print": (5, ("attr", "steps_per_print", 5)),
+    "dump_state": (True, ("attr", "dump_state", True)),
+    "vocabulary_size": (1001, ("warn", "aligned")),
+    "wall_clock_breakdown": (True, ("attr", "wall_clock_breakdown", True)),
+    "memory_breakdown": (True, ("attr", "memory_breakdown", True)),
+    "tensorboard": ({"enabled": True, "job_name": "j"},
+                    ("attr", "tensorboard_job_name", "j")),
+    "sparse_attention": ({"mode": "fixed", "block": 16},
+                         ("attr_pred", lambda c: c.sparse_attention.mode == "fixed")),
+    "pipeline": ({"stages": 2}, ("attr_pred", lambda c: c.pipeline["stages"] == 2)),
+    "zero_optimization": (
+        ({"stage": 2}, ("attr", "zero_optimization_stage", 2)),
+        ({"stage": 1, "overlap_comm": True}, ("warn", "no effect")),
+        ({"stage": 1, "nonsense_key": 1}, ("warn", "unknown zero_optimization")),
+        ({"stage": 1, "elastic_checkpoint": False}, ("warn", "elastic")),
+    ),
+    "zero_allow_untested_optimizer": (True, ("attr", "zero_allow_untested_optimizer", True)),
+    "activation_checkpointing": (
+        {"partition_activations": True},
+        ("attr_pred", lambda c: c.activation_checkpointing_config.partition_activations)),
+    # deprecated boolean-zero companion key: honored with {"zero_optimization": true}
+    # (test_deprecated_boolean_zero_reads_allgather_size), warns otherwise
+    "allgather_size": (500000000, ("warn", "only honored")),
+}
+
+
+def _run_probe(key, value, expect, capture):
+    capture.records.clear()
+    if expect[0] == "raise":
+        with pytest.raises(expect[1]):
+            _cfg(**{key: value})
+        return
+    cfg = _cfg(**{key: value})
+    if expect[0] == "attr":
+        assert getattr(cfg, expect[1]) == expect[2], key
+    elif expect[0] == "attr_pred":
+        assert expect[1](cfg), key
+    elif expect[0] == "warn":
+        assert expect[1] in capture.text, (key, capture.text)
+
+
+@pytest.mark.parametrize("key", sorted(TOP_LEVEL_CONFIG_KEYS))
+def test_every_registered_key_acts_or_diagnoses(key, capture):
+    assert key in SWEEP, f"registry key {key!r} has no sweep probe — add one"
+    probes = SWEEP[key]
+    if not isinstance(probes[0], tuple):  # single (value, expect) pair
+        probes = (probes,)
+    for value, expect in probes:
+        _run_probe(key, value, expect, capture)
+
+
+def test_sweep_covers_exactly_the_registry():
+    assert set(SWEEP) == set(TOP_LEVEL_CONFIG_KEYS)
+
+
+def test_unknown_top_level_key_warns(capture):
+    _cfg(definitely_not_a_key=1)
+    assert "unknown top-level config key" in capture.text
+    assert "definitely_not_a_key" in capture.text
+
+
+def test_deprecated_boolean_zero_reads_allgather_size(capture):
+    cfg = _cfg(zero_optimization=True, allgather_size=123456)
+    assert cfg.zero_optimization_stage == 1
+    assert cfg.zero_config.allgather_bucket_size == 123456
+    assert "deprecated" in capture.text
+
+
+def test_amp_plus_fp16_is_an_error():
+    with pytest.raises(AssertionError, match="amp"):
+        _cfg(amp={"enabled": True}, fp16={"enabled": True})
+
+
+def test_amp_maps_to_bf16_policy(capture):
+    cfg = _cfg(amp={"enabled": True}, bf16={"enabled": False})
+    assert cfg.bf16_enabled  # amp overrides the explicit bf16 opt-out
+    assert "bf16" in capture.text
+
+
+def test_legacy_fusion_warns(capture):
+    _cfg(optimizer={"type": "Adam", "params": {"lr": 1e-3}, "legacy_fusion": True})
+    assert "legacy_fusion" in capture.text
+
+
+def test_grad_comm_dtype_reaches_the_engine():
+    """allreduce_always_fp32 / communication_data_type steer the dtype gradients
+    are produced (and psum'd) in — reference engine.py:1016-1089."""
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from simple_model import SimpleModel, simple_config
+
+    def build(**over):
+        model = SimpleModel(4)
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=model.init(__import__("jax").random.PRNGKey(0)),
+            config_params=simple_config(**over))
+        return eng
+
+    assert build(zero_optimization={"stage": 2})._grad_dtype == jnp.bfloat16
+    assert build(zero_optimization={"stage": 2},
+                 allreduce_always_fp32=True)._grad_dtype == jnp.float32
+    assert build(communication_data_type="bf16")._grad_dtype == jnp.bfloat16
+    assert build()._grad_dtype == jnp.float32
+
+
+def test_untested_client_optimizer_under_zero_requires_opt_in():
+    import jax
+    import deepspeed_tpu
+    from simple_model import SimpleModel, simple_config
+
+    def init(params):
+        return {}
+
+    def apply(grads, opt_state, params, **kw):
+        return params, opt_state
+
+    model = SimpleModel(4)
+    with pytest.raises(AssertionError, match="untested"):
+        deepspeed_tpu.initialize(
+            model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+            optimizer=(init, apply),
+            config_params=simple_config(zero_optimization={"stage": 2}))

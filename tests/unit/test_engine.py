@@ -243,7 +243,8 @@ def test_external_master_optimizer(tmp_path):
     params = model.init(jax.random.PRNGKey(0))
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model, model_parameters=params, optimizer=(init, apply),
-        config_params=simple_config(zero_optimization={"stage": 2}))
+        config_params=simple_config(zero_optimization={"stage": 2},
+                                    zero_allow_untested_optimizer=True))
     assert engine._external_master
     # no separate master storage exists: master_params is a derived fp32 view of
     # the compute params (zero extra HBM — the whole point at dp=1/1.5B)
