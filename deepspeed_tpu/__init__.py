@@ -5,8 +5,16 @@ Public API mirrors the reference's ``deepspeed/__init__.py``: ``initialize()`` r
 The implementation is idiomatic JAX/XLA/Pallas/pjit — see SURVEY.md for the mapping.
 """
 
-__version__ = "0.1.0"
-__git_branch__ = "main"
+from . import git_version_info as _gvi
+
+
+def __getattr__(name):
+    # lazy provenance (PEP 562): no git subprocess at import time
+    _map = {"__version__": "version", "__git_hash__": "git_hash",
+            "__git_branch__": "git_branch", "installed_ops": "installed_ops"}
+    if name in _map:
+        return getattr(_gvi, _map[name])
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 from .runtime.config import DeepSpeedConfig  # noqa: F401
 from .runtime.activation_checkpointing import checkpointing  # noqa: F401

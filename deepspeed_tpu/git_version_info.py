@@ -1,0 +1,74 @@
+"""Version / provenance info.
+
+Analog of the reference's ``deepspeed/git_version_info.py`` (setup.py:320-324 writes
+``git_version_info_installed.py`` at install time with version+git hash+installed ops).
+A checkout with a live ``.git`` computes the fields from git (so editable installs
+never report a stale hash); a regular install reads the generated module. Everything
+is lazy (PEP 562): importing the package does not shell out to git — the subprocess
+cost is only paid when ``version``/``git_hash`` is actually read.
+
+``installed_ops`` reports which native/kernel ops this host can serve:
+- ``cpu_adam``: the C++ host-tier Adam (built lazily at first use; requires g++ —
+  False means the numpy fallback will serve)
+- ``flash_attention`` / ``block_sparse_attention`` / ``transformer``: Pallas/XLA
+  kernels, always shipped (they compile with jax, no separate toolchain)
+"""
+
+import os
+import subprocess
+
+_FIELDS = ("version", "git_hash", "git_branch", "installed_ops")
+_cache = None
+
+
+def _live():
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    def git(cmd):
+        try:
+            out = subprocess.check_output(["git", *cmd], stderr=subprocess.DEVNULL, cwd=here)
+            return out.decode().strip()
+        except (OSError, subprocess.CalledProcessError):
+            return "unknown"
+
+    try:
+        with open(os.path.join(here, "..", "version.txt")) as fd:
+            base = fd.read().strip()
+    except OSError:
+        base = "0.0.0"
+    import shutil
+    git_hash = git(["rev-parse", "--short", "HEAD"])
+    return {
+        "version": f"{base}+{git_hash}",
+        "git_hash": git_hash,
+        "git_branch": git(["rev-parse", "--abbrev-ref", "HEAD"]),
+        "installed_ops": {
+            "cpu_adam": shutil.which("g++") is not None,
+            "flash_attention": True,
+            "block_sparse_attention": True,
+            "transformer": True,
+        },
+    }
+
+
+def _info():
+    global _cache
+    if _cache is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if os.path.isdir(os.path.join(repo_root, ".git")):
+            # live checkout (incl. editable installs): git is the truth — the
+            # install-time snapshot would go stale at the very next commit
+            _cache = _live()
+        else:
+            try:
+                from . import git_version_info_installed as gi
+                _cache = {f: getattr(gi, f) for f in _FIELDS}
+            except ImportError:
+                _cache = _live()
+    return _cache
+
+
+def __getattr__(name):
+    if name in _FIELDS:
+        return _info()[name]
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -146,14 +146,6 @@ class DeepSpeedConfig:
             if self.communication_data_type not in allowed:
                 raise ValueError(f"DeepSpeedConfig: {COMMUNICATION_DATA_TYPE} must be one of "
                                  f"{allowed} (got {self.communication_data_type!r})")
-            if self.communication_data_type == "fp16" and not param_dict.get(FP16, {}).get(
-                    FP16_ENABLED, FP16_ENABLED_DEFAULT):
-                # grads are PRODUCED in this dtype (the psum then rides it), so fp16
-                # without the loss-scaling block risks overflow even at dp=1
-                logger.warning(f"DeepSpeedConfig: {COMMUNICATION_DATA_TYPE}='fp16' without "
-                               "the fp16 loss-scaling block: gradients are cast to fp16 "
-                               "before reduction and may overflow (|g| > 65504). Prefer "
-                               "'bf16', or enable the fp16 block.")
         self.prescale_gradients = get_scalar_param(param_dict, PRESCALE_GRADIENTS, PRESCALE_GRADIENTS_DEFAULT)
         self.fused_step = get_scalar_param(param_dict, FUSED_STEP, FUSED_STEP_DEFAULT)
         self.compilation_cache_dir = get_scalar_param(param_dict, COMPILATION_CACHE_DIR,
@@ -346,6 +338,13 @@ class DeepSpeedConfig:
         # Unlike the reference (zero implied fp16), bf16 ZeRO is first-class here: only an
         # actual fp16 wrapper takes over max_grad_norm; bf16/fp32 use engine clipping.
         fp16_enabled = self.fp16_enabled
+        if self.communication_data_type == "fp16" and not fp16_enabled:
+            # grads are PRODUCED in this dtype (the psum then rides it), so fp16
+            # without the loss-scaling block risks overflow even at dp=1
+            logger.warning(f"DeepSpeedConfig: {COMMUNICATION_DATA_TYPE}='fp16' without "
+                           "the fp16 loss-scaling block: gradients are cast to fp16 "
+                           "before reduction and may overflow (|g| > 65504). Prefer "
+                           "'bf16', or enable the fp16 block.")
         vocabulary_size = self._param_dict.get(VOCABULARY_SIZE, VOCABULARY_SIZE_DEFAULT)
         if vocabulary_size and vocabulary_size % TENSOR_CORE_ALIGN_SIZE != 0:
             logger.warning("DeepSpeedConfig: vocabulary size {} is not aligned to {}, "

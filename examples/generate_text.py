@@ -8,11 +8,6 @@ machinery; load a checkpoint via engine.load_checkpoint for real text).
 """
 
 import argparse
-import os
-import sys
-
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
-
 import numpy as np
 
 

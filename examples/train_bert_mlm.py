@@ -8,11 +8,6 @@ Synthetic structured tokens + 15% masking; fused transformer layers inside.
 """
 
 import argparse
-import os
-import sys
-
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
-
 import numpy as np
 
 

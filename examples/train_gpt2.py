@@ -11,11 +11,6 @@ real token stream. One chip or a mesh — the engine shards the batch over the
 """
 
 import argparse
-import os
-import sys
-
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
-
 import numpy as np
 
 

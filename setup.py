@@ -1,0 +1,68 @@
+"""Install deepspeed_tpu.
+
+Mirrors the reference's install-time provenance discipline (setup.py:19 version,
+setup.py:300-324 git hash + ``git_version_info_installed.py`` with ``installed_ops``)
+without its torch/CUDA extension builds: TPU kernels are Pallas/XLA (compiled by jax
+at runtime) and the one C++ host op (cpu_adam) builds lazily on first use, so install
+only records WHAT this host can serve, it does not compile anything.
+
+    pip install -e .          # editable dev install
+    pip install .             # regular install
+"""
+
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def read_version():
+    with open(os.path.join(HERE, "version.txt")) as fd:
+        return fd.read().strip()
+
+
+def fetch_requirements(path):
+    with open(os.path.join(HERE, path)) as fd:
+        return [r.strip() for r in fd if r.strip() and not r.startswith(("#", "-r"))]
+
+
+def git_info():
+    def run(args):
+        try:
+            return subprocess.check_output(["git", *args], cwd=HERE,
+                                           stderr=subprocess.DEVNULL).decode().strip()
+        except (OSError, subprocess.CalledProcessError):
+            return "unknown"
+
+    return run(["rev-parse", "--short", "HEAD"]), run(["rev-parse", "--abbrev-ref", "HEAD"])
+
+
+VERSION = read_version()
+git_hash, git_branch = git_info()
+version = f"{VERSION}+{git_hash}" if git_hash != "unknown" else VERSION
+
+# What this host can serve (reference setup.py records which CUDA ops compiled;
+# here the Pallas kernels always ship and cpu_adam needs a C++ toolchain at runtime)
+installed_ops = {
+    "cpu_adam": shutil.which("g++") is not None,
+    "flash_attention": True,
+    "block_sparse_attention": True,
+    "transformer": True,
+}
+
+print(f"version={version}, git_hash={git_hash}, git_branch={git_branch}")
+print(f"installed_ops={installed_ops}")
+with open(os.path.join(HERE, "deepspeed_tpu", "git_version_info_installed.py"), "w") as fd:
+    fd.write(f"version='{version}'\n")
+    fd.write(f"git_hash='{git_hash}'\n")
+    fd.write(f"git_branch='{git_branch}'\n")
+    fd.write(f"installed_ops={installed_ops}\n")
+
+setup(
+    version=version,
+    install_requires=fetch_requirements("requirements.txt"),
+    extras_require={"dev": fetch_requirements("requirements-dev.txt")},
+)

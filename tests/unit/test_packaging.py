@@ -1,0 +1,49 @@
+"""Install/provenance surface (reference setup.py:19,320-324 discipline).
+
+The package must expose version + git provenance + the native-op availability
+map whether it was pip-installed (git_version_info_installed.py) or imported
+from a source checkout (live fallback).
+"""
+
+import re
+import subprocess
+import sys
+
+import deepspeed_tpu
+from deepspeed_tpu import git_version_info
+
+
+def test_version_shape():
+    # "<semver>+<shorthash>" (or bare semver when git is unavailable at install)
+    assert re.match(r"^\d+\.\d+\.\d+(\+[0-9a-f]{4,}|\+unknown)?$", deepspeed_tpu.__version__), \
+        deepspeed_tpu.__version__
+    assert deepspeed_tpu.__git_hash__ == git_version_info.git_hash
+
+
+def test_installed_ops_map():
+    ops = deepspeed_tpu.installed_ops
+    assert set(ops) >= {"cpu_adam", "flash_attention", "block_sparse_attention",
+                        "transformer"}
+    assert all(isinstance(v, bool) for v in ops.values())
+    # the kernels that compile with jax itself are always servable
+    assert ops["flash_attention"] and ops["transformer"]
+
+
+def test_pyproject_console_scripts_resolve():
+    """Every console_script target must import and be callable (a broken entry
+    point only surfaces at `pip install` otherwise)."""
+    import importlib
+    try:
+        import tomllib
+    except ImportError:  # py<3.11
+        return
+    with open(f"{_repo_root()}/pyproject.toml", "rb") as fd:
+        meta = tomllib.load(fd)
+    for target in meta["project"]["scripts"].values():
+        mod, fn = target.split(":")
+        assert callable(getattr(importlib.import_module(mod), fn)), target
+
+
+def _repo_root():
+    import os
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
