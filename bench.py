@@ -127,7 +127,7 @@ def _shard_optimizer(dp):
     return init, apply
 
 
-def bench_1p5b_engine(remat_policy="dots", batch=8):
+def bench_1p5b_engine(remat_policy="dots", batch=8, loss_chunk=128):
     """The 1.5B metric measured THROUGH DeepSpeedEngine: the real jitted
     value_and_grad, grad adoption, apply_update with donated buffers,
     monitor/report path — with the per-rank optimizer work supplied as an
@@ -143,9 +143,9 @@ def bench_1p5b_engine(remat_policy="dots", batch=8):
     from deepspeed_tpu.parallel.mesh import build_mesh
 
     cfg = GPT2Config(vocab_size=50304, n_positions=1024, n_embd=1600, n_layer=48,
-                     n_head=25, remat=True,
-                     remat_policy=None if remat_policy == "full" else remat_policy,
-                     use_flash_attention=True)
+                     n_head=25, remat=remat_policy != "none",
+                     remat_policy=None if remat_policy in ("full", "none") else remat_policy,
+                     use_flash_attention=True, loss_chunk=loss_chunk)
     model = GPT2Model(cfg)
     params = model.init(jax.random.PRNGKey(0))
     n_params = model.param_count(params)
@@ -154,7 +154,9 @@ def bench_1p5b_engine(remat_policy="dots", batch=8):
         optimizer=_shard_optimizer(32),
         config_params={"train_batch_size": batch, "steps_per_print": 1000,
                        "bf16": {"enabled": True},
-                       "zero_optimization": {"stage": 2}})
+                       "zero_optimization": {"stage": 2},
+                       # the external-master shard pair is a client optimizer
+                       "zero_allow_untested_optimizer": True})
     del params
     gc.collect()
     rng = np.random.default_rng(0)
@@ -517,7 +519,9 @@ def main():
             print(f"PROBE_OK {n}")
         return
     if len(sys.argv) >= 4 and sys.argv[1] == "--engine-1p5b":
-        tps, mfu = bench_1p5b_engine(remat_policy=sys.argv[2], batch=int(sys.argv[3]))
+        lc = int(sys.argv[4]) if len(sys.argv) >= 5 else 128
+        tps, mfu = bench_1p5b_engine(remat_policy=sys.argv[2], batch=int(sys.argv[3]),
+                                     loss_chunk=lc)
         print(f"ENGINE_OK {tps:.1f} {mfu:.4f}")
         return
     import jax

@@ -114,7 +114,8 @@ class BertForMaskedLM:
                rng=None, deterministic=True):
         x = self.bert.apply(params, input_ids, token_type_ids, attention_mask, rng, deterministic)
         wte = params["embeddings"]["word"]
-        return jnp.dot(x, wte.T.astype(x.dtype), preferred_element_type=jnp.float32)
+        return jnp.einsum("bth,vh->btv", x, wte.astype(x.dtype),
+                          preferred_element_type=jnp.float32)
 
     def apply(self, params, input_ids, labels, token_type_ids=None, attention_mask=None,
               rng=None, deterministic=True):

@@ -182,8 +182,8 @@ class GPT2Pipe:
         s = jax.lax.axis_index(PIPE_AXIS)
         labels = labels_mb[mb]
         y = dense._layer_norm(y, io_params["ln_f"], c.layer_norm_epsilon)
-        logits = jnp.dot(y, wte.T.astype(y.dtype),
-                         preferred_element_type=jnp.float32)        # [B, T, V/S] fp32
+        logits = jnp.einsum("bth,vh->btv", y, wte.astype(y.dtype),
+                            preferred_element_type=jnp.float32)      # [B, T, V/S] fp32
         if self.vocab_pad != c.vocab_size:
             col = s * v_local + jnp.arange(v_local)
             logits = jnp.where(col < c.vocab_size, logits, -1e30)
@@ -201,8 +201,11 @@ class GPT2Pipe:
         return jnp.mean(m + jnp.log(sumexp) - ll)
 
     # ---- training loss over micro-batches ----
-    def loss(self, params, tokens_mb, labels_mb, *, mesh):
-        """Mean LM loss over [M, B, T] micro-batches through the pipe-axis pipeline."""
+    def loss(self, params, tokens_mb, labels_mb, *, mesh,
+             max_microbatches_per_flush=None, stream_segments=True):
+        """Mean LM loss over [M, B, T] micro-batches through the pipe-axis pipeline.
+        The segmentation knobs pass through to ``pipeline_apply`` (streamed
+        single-fill segments by default)."""
         from jax.sharding import PartitionSpec as P
         if self.tp > 1:
             tp_in_mesh = mesh.shape.get(MODEL_AXIS, 1)
@@ -224,4 +227,6 @@ class GPT2Pipe:
             last_stage_args_specs=(
                 io_specs, P(None, "data") if labels_mb.ndim >= 2 else P()),
             stacked_param_specs=self._stacked_specs(params["stages"]),
+            max_microbatches_per_flush=max_microbatches_per_flush,
+            stream_segments=stream_segments,
         )

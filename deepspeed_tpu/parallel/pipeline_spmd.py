@@ -26,10 +26,16 @@ micro-batches x full per-layer activations (~12x stage-input per stage for 2-lay
 stages) regardless of M. For the training configs this engine targets (M <= ~4S
 micro-batches per accumulation window), GPipe+remat live memory is at or below
 1F1B-without-remat. At M >> S, ``pipeline_apply`` automatically splits the window
-into rematerialized flushes of <= 4S micro-batches (``_flushed_apply``), restoring
-the bound: measured at M = 16S (GPT-2 2L/128E/S=2, T=512, mb-batch 16, grad of the
-full loss, peak RSS on the 8-virtual-device CPU) single flush 4529 MB vs scanned
-flushes 2287 MB.
+into rematerialized SEGMENTS of <= 4S micro-batches, restoring the bound: measured
+at M = 16S (GPT-2 2L/128E/S=2, T=512, mb-batch 16, grad of the full loss, peak RSS
+on the 8-virtual-device CPU) single flush 4529 MB vs segmented 2287 MB. By default
+the segments are STREAMED (``_streamed_apply``): the pipe buffer is a scan carry
+across the checkpoint segments, so the whole window pays the (S-1)-step fill ONCE —
+the reference 1F1B's single-fill discipline (schedule.py:182-289) — instead of per
+flush: at M=16S, S=8, cap=4S the lockstep step count drops 156 -> 135 (bubble 17.9%
+-> 5.2%; ``flush_schedule`` is the accounting). The legacy drain-per-flush schedule
+(``_flushed_apply``) stays available via ``stream_segments=False`` as a comparison
+oracle.
 
 Requires homogeneous stages (equal per-stage blocks) — the layout GPT/BERT stacks
 naturally have. Heterogeneous first/last work (embedding, LM head, loss) runs inside the
@@ -62,6 +68,154 @@ def stacked_param_sharding(mesh: Mesh, stacked_tree):
         spec = [PIPE_AXIS] + [None] * (x.ndim - 1)
         return NamedSharding(mesh, P(*spec))
     return jax.tree_util.tree_map(leaf, stacked_tree)
+
+
+def flush_schedule(M: int, S: int, cap: int, streamed: bool = True):
+    """Compiled-step accounting for an M-micro-batch window on an S-stage pipe with
+    checkpoint segments of ``cap`` micro-batches (the memory bound).
+
+    ``ideal_steps`` is the single-fill optimum ``M + S - 1`` (the reference 1F1B's
+    per-optimizer-step discipline, reference schedule.py:182-289). The STREAMED
+    schedule achieves it exactly — the pipe buffer is carried across checkpoint
+    segments so segment i+1's fill IS segment i's drain. The legacy per-flush
+    schedule drains every flush: ``(M / cap) * (cap + S - 1)`` steps.
+
+    Returns ``{steps, ideal_steps, n_segments, bubble_fraction}`` where
+    bubble_fraction = 1 - M / steps (fraction of lockstep steps in which at least
+    one stage computes no real micro-batch)."""
+    assert M % cap == 0, f"window M={M} must divide into segments of {cap}"
+    n = M // cap
+    steps = (M + S - 1) if streamed else n * (cap + S - 1)
+    return {"steps": steps, "ideal_steps": M + S - 1, "n_segments": n,
+            "bubble_fraction": 1.0 - M / steps}
+
+
+def _infer_specs(stacked_params, x_microbatches, last_stage_args, first_stage_args,
+                 last_stage_args_specs, first_stage_args_specs, stacked_param_specs, M):
+    """Default shard_map specs shared by the unsplit and streamed paths: stacked
+    params over pipe, micro-batches data-sharded on dim 1, micro-batched
+    last_stage_args ([M, batch, ...] leaves, e.g. labels) keep their data
+    sharding, everything else replicated. NOTE the last-args rule is a shape
+    heuristic — a WEIGHT whose leading dim happens to equal M gets data-sharded;
+    pass explicit last_stage_args_specs to override (the legacy drain-per-flush
+    schedule, which additionally CHUNKS micro-batched args, refuses to guess and
+    errors instead)."""
+    x_spec = P(*([None, DATA_AXIS] + [None] * (x_microbatches.ndim - 2)))
+    stacked_spec = (stacked_param_specs if stacked_param_specs is not None
+                    else jax.tree_util.tree_map(
+                        lambda a: P(*([PIPE_AXIS] + [None] * (a.ndim - 1))),
+                        stacked_params))
+
+    def _last_arg_spec(a):
+        if hasattr(a, "ndim") and a.ndim >= 2 and a.shape[0] == M:
+            return P(*([None, DATA_AXIS] + [None] * (a.ndim - 2)))
+        return P()
+
+    last_spec = (last_stage_args_specs if last_stage_args_specs is not None
+                 else jax.tree_util.tree_map(_last_arg_spec, last_stage_args))
+    first_spec = (first_stage_args_specs if first_stage_args_specs is not None
+                  else jax.tree_util.tree_map(lambda _: P(), first_stage_args))
+    return x_spec, stacked_spec, last_spec, first_spec
+
+
+def _streamed_apply(stage_fn, stacked_params, x_microbatches, cap, *, mesh,
+                    last_stage_fn, last_stage_args, first_stage_fn, first_stage_args,
+                    last_stage_args_specs, first_stage_args_specs, stacked_param_specs,
+                    last_stage_collective):
+    """Checkpoint-segmented pipeline WITHOUT per-segment drain: the pipe buffer is a
+    scan carry across segments, so micro-batches stream continuously and the whole
+    window pays the (S-1)-step fill exactly once — the single-fill discipline of the
+    reference's 1F1B (schedule.py:182-289) with GPipe-order remat memory (backward
+    replays one ``cap``-micro-batch segment at a time; live memory is one segment's
+    stage inputs + the running grads, same bound as ``_flushed_apply``).
+
+    vs. the per-flush schedule this removes (M/cap - 1) * (S-1) lockstep steps:
+    at M=16S, cap=4S, the step count drops 156 -> 135 (S=8) — see flush_schedule."""
+    M = x_microbatches.shape[0]
+    S = mesh.shape[PIPE_AXIS]
+    n = M // cap
+
+    x_spec, stacked_spec, last_spec, first_spec = _infer_specs(
+        stacked_params, x_microbatches, last_stage_args, first_stage_args,
+        last_stage_args_specs, first_stage_args_specs, stacked_param_specs, M)
+
+    def inner(stacked_local, x_mb, last_args, first_args):
+        # ONE shard_map for the whole window: the pipe buffer lives entirely
+        # inside it (segments are an inner checkpointed scan), so its cotangent
+        # never crosses a shard_map boundary — routing it through per-segment
+        # shard_map calls dropped/corrupted exactly the boundary micro-batches'
+        # first-stage grads (measured: mbs {cap-S+1 mod cap} wrong, loss exact).
+        s = jax.lax.axis_index(PIPE_AXIS)
+        is_first = s == 0
+        is_last = s == S - 1
+        my_params = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+
+        def ingest(g):
+            x0 = x_mb[jnp.clip(g, 0, M - 1)]
+            if first_stage_fn is not None:
+                x0 = first_stage_fn(x0, *first_args)
+            return x0
+
+        def step(ingest_real):
+            def body(carry, g):
+                buf, loss_acc = carry
+                if ingest_real:  # static: the drain never ingests
+                    # ingest runs UNCONDITIONALLY on every rank (it may contain
+                    # pipe collectives — vocab-parallel embedding — which must
+                    # stay uniform); only the SELECT is rank-dependent
+                    x_ing = ingest(g)
+                    x_in = jnp.where(is_first, x_ing, buf) if x_ing.ndim == 0 else \
+                        jax.lax.select(jnp.broadcast_to(is_first, ()), x_ing, buf)
+                else:
+                    x_in = buf
+                y = stage_fn(my_params, x_in)
+                mb = g - (S - 1)
+                valid = jnp.logical_and(mb >= 0, mb < M)
+                if last_stage_collective:
+                    def do_head(_):
+                        y_b = jax.lax.psum(
+                            jnp.where(is_last, 1.0, 0.0).astype(y.dtype) * y, PIPE_AXIS)
+                        return last_stage_fn(y_b, *last_args, jnp.clip(mb, 0, M - 1))
+
+                    loss_acc = loss_acc + jax.lax.cond(
+                        valid, do_head, lambda _: jnp.zeros((), jnp.float32),
+                        operand=None)
+                else:
+                    take = jnp.logical_and(is_last, valid)
+                    loss_acc = loss_acc + jax.lax.cond(
+                        take,
+                        lambda _: last_stage_fn(y, *last_args, jnp.clip(mb, 0, M - 1)),
+                        lambda _: jnp.zeros((), jnp.float32), operand=None)
+                perm = [(i, (i + 1) % S) for i in range(S)]
+                return (jax.lax.ppermute(y, PIPE_AXIS, perm), loss_acc), None
+
+            return body
+
+        @jax.checkpoint
+        def segment(carry, f):
+            # cap lockstep steps; backward replays ONE segment's forward at a
+            # time — the same live-memory bound as the per-flush schedule, but
+            # the (buf, loss) carry streams on so the pipe never drains
+            carry, _ = jax.lax.scan(step(True), carry, f * cap + jnp.arange(cap))
+            return carry, None
+
+        x0_example = jax.eval_shape(ingest, jax.ShapeDtypeStruct((), jnp.int32))
+        carry0 = (jnp.zeros(x0_example.shape, x0_example.dtype),
+                  jnp.zeros((), jnp.float32))
+        carry, _ = jax.lax.scan(segment, carry0, jnp.arange(n))
+        if S > 1:
+            carry, _ = jax.lax.scan(step(False), carry, M + jnp.arange(S - 1))
+        _, loss_acc = carry
+        if last_stage_collective:
+            # the collective head already accumulates uniformly over pipe
+            return jax.lax.pmean(loss_acc / M, DATA_AXIS)
+        loss = jax.lax.psum(jnp.where(is_last, loss_acc, 0.0), PIPE_AXIS) / M
+        return jax.lax.pmean(loss, DATA_AXIS)
+
+    fn = jax.shard_map(inner, mesh=mesh,
+                       in_specs=(stacked_spec, x_spec, last_spec, first_spec),
+                       out_specs=P(), check_vma=False)
+    return fn(stacked_params, x_microbatches, last_stage_args, first_stage_args)
 
 
 def _flushed_apply(stage_fn, stacked_params, x_microbatches, cap, *, mesh,
@@ -151,17 +305,22 @@ def pipeline_apply(stage_fn: Callable,
                    first_stage_args_specs=None,
                    stacked_param_specs=None,
                    last_stage_collective: bool = False,
-                   max_microbatches_per_flush: int = None):
+                   max_microbatches_per_flush: int = None,
+                   stream_segments: bool = True):
     """Run micro-batches through the pipe-axis pipeline inside shard_map.
 
     When the window exceeds ``max_microbatches_per_flush`` (default ``4 * n_stages``,
     the M <= ~4S regime where GPipe+remat live memory matches 1F1B — see module
-    docstring), the loss path automatically splits into ``ceil(M / cap)`` independent
-    pipeline FLUSHES, each wrapped in ``jax.checkpoint``: the backward of flush i
-    replays only flush i's forward, so live memory is bounded by one flush's stage
-    inputs regardless of M — the engine-level analog of the reference running multiple
-    1F1B flushes per optimizer step (gradient accumulation over train_batch calls).
-    Pass ``max_microbatches_per_flush=0`` to disable splitting.
+    docstring), the loss path automatically splits into ``ceil(M / cap)``
+    ``jax.checkpoint`` segments: the backward of segment i replays only segment i's
+    forward, so live memory is bounded by one segment's stage inputs regardless of M.
+    With ``stream_segments=True`` (default) the pipe buffer is CARRIED across
+    segments — micro-batches stream continuously and the whole window pays the
+    (S-1)-step fill exactly once (the reference 1F1B's single-fill discipline,
+    schedule.py:182-289; see ``flush_schedule`` for the step accounting). With
+    ``stream_segments=False`` each segment drains fully before the next fills (the
+    legacy per-flush schedule: (M/cap)(cap+S-1) steps — kept as a comparison
+    oracle). Pass ``max_microbatches_per_flush=0`` to disable splitting.
 
     Args:
       stage_fn: homogeneous per-stage function ``(stage_params, x) -> y``; applied by
@@ -209,7 +368,8 @@ def pipeline_apply(stage_fn: Callable,
                 "running a SINGLE unsplit flush (memory grows with M)"
                 if cap_eff < 2 else f"running {M // cap_eff} flushes of {cap_eff}")
         if cap_eff >= 2:
-            return _flushed_apply(
+            impl = _streamed_apply if stream_segments else _flushed_apply
+            return impl(
                 stage_fn, stacked_params, x_microbatches, cap_eff, mesh=mesh,
                 last_stage_fn=last_stage_fn, last_stage_args=last_stage_args,
                 first_stage_fn=first_stage_fn, first_stage_args=first_stage_args,
@@ -302,28 +462,12 @@ def pipeline_apply(stage_fn: Callable,
         loss = jax.lax.pmean(loss, DATA_AXIS)
         return loss
 
-    # shardings: stacked params split over pipe; everything else replicated over pipe
+    # shardings: stacked params split over pipe (caller-provided layouts, e.g.
+    # model-axis TP dims, pass through); everything else replicated over pipe
     # (data-dim sharding of the micro-batches is preserved by P(None, 'data', ...)).
-    x_spec = P(*([None, DATA_AXIS] + [None] * (x_microbatches.ndim - 2)))
-    if stacked_param_specs is not None:
-        # caller-provided layout (e.g. model-axis TP dims on the weight shards); the
-        # stage_fn is then responsible for the matching manual collectives
-        stacked_spec = stacked_param_specs
-    else:
-        stacked_spec = jax.tree_util.tree_map(
-            lambda a: P(*([PIPE_AXIS] + [None] * (a.ndim - 1))), stacked_params)
-
-    def _last_arg_spec(a):
-        # micro-batched leaves ([M, batch, ...], e.g. labels) keep their data sharding;
-        # everything else (head weights, scalars) is replicated
-        if hasattr(a, "ndim") and a.ndim >= 2 and a.shape[0] == M:
-            return P(*([None, DATA_AXIS] + [None] * (a.ndim - 2)))
-        return P()
-
-    last_spec = (last_stage_args_specs if last_stage_args_specs is not None
-                 else jax.tree_util.tree_map(_last_arg_spec, last_stage_args))
-    first_spec = (first_stage_args_specs if first_stage_args_specs is not None
-                  else jax.tree_util.tree_map(lambda _: P(), first_stage_args))
+    x_spec, stacked_spec, last_spec, first_spec = _infer_specs(
+        stacked_params, x_microbatches, last_stage_args, first_stage_args,
+        last_stage_args_specs, first_stage_args_specs, stacked_param_specs, M)
     out_spec = P() if last_stage_fn is not None else x_spec
 
     fn = jax.shard_map(inner, mesh=mesh,

@@ -134,6 +134,42 @@ def _partition_constraint(x: jnp.ndarray):
     return x
 
 
+def _flash_policy(exclude="qkv", keep_qkv=False):
+    """Replay-free attention remat policies: save the flash kernel's named
+    residuals (out, lse) plus no-batch-dims dots, minus a width-signature-chosen
+    exclusion that funds the attention saves in HBM.
+
+    Measured at GPT-2 1.5B, batch 8, one v5e (PERF.md round-5 remat table):
+    'dots' replays the flash fwd kernel in backward (the custom_vjp residuals
+    are not dots) and plain 'dots+attn' overshoots HBM by ~60 MB. Exclusions by
+    2-D-rhs width signature (unique among the transformer's dots):
+    - "qkv" (policy 'flash'): rhs [E, 3E] — frees 3E per layer (3.7 GB) but the
+      replay re-runs the widest projection;
+    - "square" (policy 'dots+attn-lean'): rhs [E, E], the attention output
+      projection — frees E per layer (1.25 GB) and the replay is one cheap dot
+      whose input (attn_out) is itself saved."""
+    names = jax.checkpoint_policies.save_only_these_names("attn_out", "attn_lse")
+
+    def eff_policy(prim, *avals, **params):
+        if names(prim, *avals, **params):
+            return True
+        if getattr(prim, "name", "") != "dot_general":
+            return False
+        (lc, rc), (lb, rb) = params["dimension_numbers"]
+        if lb or rb:
+            return False
+        if len(avals) >= 2 and getattr(avals[1], "ndim", 0) == 2 and len(rc) == 1:
+            rhs = avals[1]
+            contracted, out_w = rhs.shape[rc[0]], rhs.shape[1 - rc[0]]
+            if not keep_qkv and out_w == 3 * contracted:
+                return False  # fused-qkv projection: recompute, don't save
+            if exclude == "square" and out_w == contracted:
+                return False  # attention output projection: recompute from attn_out
+        return True
+
+    return eff_policy
+
+
 def checkpoint_wrapper(fn, policy=None):
     """Wrap ``fn(*args)`` so its forward is rematerialized in backward, honoring the
     configured saveable placement. The TPU analog of CheckpointFunction
@@ -165,15 +201,35 @@ def checkpoint_wrapper(fn, policy=None):
         elif policy == "dots":
             eff_policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         elif policy == "attn":
-            # save only attention OUTPUTS (tagged "attn_out" by the models): backward
-            # skips replaying the flash kernel — the priciest recompute — while the
-            # per-layer residual stays one [B, T, E] tensor
-            eff_policy = jax.checkpoint_policies.save_only_these_names("attn_out")
+            # save only attention OUTPUTS (tagged "attn_out"/"attn_lse" by the
+            # models): backward skips replaying the flash kernel — the priciest
+            # recompute — for one [B, T, E] + one [B, H, T] residual per layer
+            eff_policy = jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "attn_lse")
+        elif policy == "dots+attn":
+            # dots AND the flash kernel's (out, lse): backward replays ONLY cheap
+            # elementwise ops (layernorm/gelu/adds) — the kernel's own residuals
+            # (q,k,v) are saved dots, out/lse are the named saves, so the flash
+            # bwd kernels run with zero fwd-kernel replay. The extra HBM over
+            # 'dots' is one [B,T,E] + one [B,H,T] per layer (~3% of the dots set).
+            eff_policy = jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names("attn_out", "attn_lse"))
+        elif policy == "flash":
+            eff_policy = _flash_policy()
+        elif policy == "dots+attn-lean":
+            # dots+attn minus the SQUARE-rhs dots (the attention output
+            # projection, rhs [E, E]): its replay is ONE cheap dot from the
+            # saved attn_out, and dropping the save frees a [B, T, E] per layer
+            # (1.25 GB at 1.5B/batch 8) — the margin that lets the replay-free
+            # attention saves fit in HBM (see PERF.md round-5 remat table)
+            eff_policy = _flash_policy(exclude="square", keep_qkv=True)
         elif policy is None or callable(policy):
             eff_policy = policy
         else:
             raise ValueError(f"unknown remat policy {policy!r}: expected None, 'dots', "
-                             f"'attn', or a jax.checkpoint_policies callable")
+                             f"'attn', 'dots+attn', 'flash', or a "
+                             f"jax.checkpoint_policies callable")
         ckpt = jax.checkpoint(placed, policy=eff_policy)
         if _config["profile"]:
             with jax.named_scope("ds_activation_checkpoint"):

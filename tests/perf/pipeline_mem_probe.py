@@ -1,0 +1,67 @@
+"""Peak-RSS probe for the pipeline flush/segment schedules (8-virtual-device CPU).
+
+Reproduces the PERF.md "Pipeline memory at M >> S" row and extends it to the
+streamed single-fill schedule: each mode runs `jax.grad` of the GPT2Pipe loss at
+M = 16S in a fresh subprocess and reports `ru_maxrss`.
+
+Usage: python tests/perf/pipeline_mem_probe.py            # all modes
+       python tests/perf/pipeline_mem_probe.py --one MODE # child (internal)
+"""
+
+import subprocess
+import sys
+
+MODES = ("single", "legacy", "streamed")
+
+
+def child(mode):
+    import os
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import resource
+
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+    from deepspeed_tpu.models.gpt2_pipe import GPT2Pipe
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    import deepspeed_tpu.parallel.pipeline_spmd as ps
+
+    S, M = 2, 32
+    cfg = GPT2Config(vocab_size=512, n_positions=512, n_embd=128, n_layer=2,
+                     n_head=4, compute_dtype=jnp.bfloat16)
+    mesh = build_mesh(pipe=S, model=1)
+    pipe = GPT2Pipe(cfg, num_stages=S)
+    params = pipe.init(jax.random.PRNGKey(0))
+    placed = jax.device_put(params, pipe.param_shardings(mesh, params))
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(M, 16, 512)).astype(np.int32))
+    labels = jnp.asarray(np.roll(np.asarray(toks), -1, axis=2))
+
+    cap = 0 if mode == "single" else None
+    stream = mode == "streamed"
+
+    def loss(p):
+        return pipe.loss(p, toks, labels, mesh=mesh,
+                         max_microbatches_per_flush=cap, stream_segments=stream)
+
+    g = jax.jit(jax.grad(loss))(placed)
+    jax.block_until_ready(g)
+    peak_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+    print(f"RESULT {mode} peak_rss_mb={peak_mb:.0f}")
+
+
+if __name__ == "__main__":
+    if "--one" in sys.argv:
+        child(sys.argv[sys.argv.index("--one") + 1])
+    else:
+        for mode in MODES:
+            r = subprocess.run([sys.executable, __file__, "--one", mode],
+                               capture_output=True, text=True, timeout=1200)
+            for line in r.stdout.splitlines():
+                if line.startswith("RESULT"):
+                    print(line)
+                    break
+            else:
+                print(f"{mode} FAILED:", r.stderr.splitlines()[-3:])

@@ -217,9 +217,12 @@ def test_gpt2_pipe_to_dense_roundtrip(tp):
     assert restacked["io"]["wte"].shape[0] == 132
 
 
-def test_auto_flush_split_matches_single_flush(mesh):
-    """M = 8S must auto-split into rematerialized flushes (VERDICT r2 next #5) with
-    bit-comparable loss AND grads vs the unsplit pipeline."""
+@pytest.mark.parametrize("streamed", [True, False])
+def test_auto_flush_split_matches_single_flush(mesh, streamed):
+    """M = 8S must auto-split into rematerialized segments (VERDICT r2 next #5) with
+    bit-comparable loss AND grads vs the unsplit pipeline — in BOTH the streamed
+    (single-fill, default) and the legacy drain-per-flush schedule. The grad check
+    covers every segment-boundary micro-batch (the streamed carry's hard case)."""
     from jax.sharding import PartitionSpec as P
     S2, M8 = 2, 16
     key = jax.random.PRNGKey(2)
@@ -240,7 +243,8 @@ def test_auto_flush_split_matches_single_flush(mesh):
             return pipeline_apply(stage_fn, s, x, mesh=mesh, last_stage_fn=last_fn,
                                   last_stage_args=(labels_mb,),
                                   last_stage_args_specs=(P(None, "data"),),
-                                  max_microbatches_per_flush=cap)
+                                  max_microbatches_per_flush=cap,
+                                  stream_segments=streamed)
         return f
 
     l_split = jax.jit(loss(None))(stacked, x_mb)       # default cap 4*S=8 < M: splits
@@ -252,6 +256,93 @@ def test_auto_flush_split_matches_single_flush(mesh):
     for k in ("w", "b"):
         np.testing.assert_allclose(np.asarray(g_split[k]), np.asarray(g_whole[k]),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_flush_schedule_accounting():
+    """Step accounting: the streamed schedule pays the single (S-1)-step fill once
+    (the reference 1F1B discipline, schedule.py:182-289); the legacy schedule pays
+    it per flush."""
+    from deepspeed_tpu.parallel.pipeline_spmd import flush_schedule
+
+    acc = flush_schedule(M=128, S=8, cap=32, streamed=True)
+    assert acc == {"steps": 135, "ideal_steps": 135, "n_segments": 4,
+                   "bubble_fraction": acc["bubble_fraction"]}
+    assert abs(acc["bubble_fraction"] - (1 - 128 / 135)) < 1e-12
+    legacy = flush_schedule(M=128, S=8, cap=32, streamed=False)
+    assert legacy["steps"] == 4 * (32 + 7) == 156
+    assert legacy["bubble_fraction"] > 0.17 > acc["bubble_fraction"]
+
+    with pytest.raises(AssertionError):
+        flush_schedule(M=10, S=2, cap=4)
+
+
+def _scan_lengths(jaxpr):
+    """All (length, has_stage_marker) for scan eqns anywhere in a jaxpr, where the
+    marker is whether the scan body applies the stage function (detected via a
+    sentinel primitive-free probe: we instead return raw lengths and let the
+    caller reason about them)."""
+    def as_jaxpr(v):
+        # ClosedJaxpr wraps .jaxpr; raw Jaxpr (shard_map/remat bodies) has .eqns
+        if hasattr(v, "eqns"):
+            return v
+        inner = getattr(v, "jaxpr", None)
+        return inner if hasattr(inner, "eqns") else None
+
+    out = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            out.append(eqn.params["length"])
+        for v in eqn.params.values():
+            for w in (v if isinstance(v, (list, tuple)) else [v]):
+                j = as_jaxpr(w)
+                if j is not None:
+                    out.extend(_scan_lengths(j))
+    return out
+
+
+def test_streamed_executes_single_fill_step_count(mesh):
+    """The TRACED streamed program's scan trip counts prove the single-fill
+    schedule: an n-segment outer scan whose body runs `cap` pipeline steps, plus
+    one (S-1)-step drain — total executed steps == flush_schedule(streamed)
+    == M + S - 1, NOT the legacy n*(cap+S-1). A regression that drains per
+    segment would show an inner length of cap+S-1 (or an extra S-1 scan per
+    segment) and fail the exact-multiset assertion."""
+    from deepspeed_tpu.parallel.pipeline_spmd import flush_schedule
+    S2, M8, cap = 2, 16, 8
+
+    key = jax.random.PRNGKey(2)
+    per_stage = []
+    for _ in range(S2):
+        k1, key = jax.random.split(key)
+        per_stage.append({"w": jax.random.normal(k1, (H, H)) * 0.3, "b": jnp.zeros((H,))})
+    stacked = stack_stage_params(per_stage)
+    stacked = jax.device_put(stacked, stacked_param_sharding(mesh, stacked))
+    x_mb = jax.random.normal(key, (M8, B, H))
+
+    def last_fn(y, mb):
+        return jnp.mean(y)
+
+    def f(s, x):
+        return pipeline_apply(stage_fn, s, x, mesh=mesh, last_stage_fn=last_fn,
+                              max_microbatches_per_flush=cap)
+
+    lengths = sorted(_scan_lengths(jax.make_jaxpr(f)(stacked, x_mb).jaxpr))
+    n = M8 // cap
+    # exactly three scans: drain (S-1), segment body (cap), outer segments (n)
+    assert lengths == sorted([S2 - 1, cap, n]), lengths
+    # executed pipeline steps = n * cap + (S - 1) = the single-fill optimum
+    acc = flush_schedule(M=M8, S=S2, cap=cap, streamed=True)
+    assert n * cap + (S2 - 1) == acc["steps"] == M8 + S2 - 1
+
+    # the legacy schedule shows its drain in the trip counts: inner flush scans
+    # run cap + S - 1 steps each
+    def f_legacy(s, x):
+        return pipeline_apply(stage_fn, s, x, mesh=mesh, last_stage_fn=last_fn,
+                              max_microbatches_per_flush=cap, stream_segments=False)
+
+    legacy_lengths = sorted(_scan_lengths(jax.make_jaxpr(f_legacy)(stacked, x_mb).jaxpr))
+    assert cap + S2 - 1 in legacy_lengths, legacy_lengths
+    assert n * (cap + S2 - 1) == flush_schedule(M8, S2, cap, streamed=False)["steps"]
 
 
 def test_auto_flush_split_through_gpt2_pipe(mesh):
