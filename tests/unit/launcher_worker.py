@@ -25,6 +25,63 @@ jax.config.update("jax_platforms", "cpu")  # before any backend/distributed init
 import numpy as np  # noqa: E402
 
 
+def run_elastic_rehearsal(tmp, repo_root, timeout=420):
+    """Three-phase sharded-state lifecycle rehearsal, shared by
+    tests/unit/test_launcher.py and __graft_entry__'s multichip dry run:
+    (A) 2 launcher-spawned jax.distributed processes train ZeRO-2+offload and
+    save per-process region files; (B) a fresh 1-process engine (2 virtual
+    devices — same global math) ELASTICALLY reloads the 2-process checkpoint
+    and continues; (C) an uninterrupted single-process oracle. Returns the
+    three result dicts after asserting B continues C step-for-step."""
+    import base64
+    import socket
+    import subprocess
+
+    import numpy as np
+
+    def clean_env(**extra):
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("DS_", "TPU_", "CLOUD_TPU"))
+               and k not in ("XLA_FLAGS", "MASTER_ADDR", "MASTER_PORT", "RANK",
+                             "WORLD_SIZE", "LOCAL_RANK", "JAX_PLATFORMS")}
+        env.update(extra, PYTHONPATH=repo_root)
+        return env
+
+    worker = os.path.abspath(__file__)
+    ckpt = os.path.join(tmp, "ckpt")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    world_info = base64.urlsafe_b64encode(
+        json.dumps({"localhost": [0, 1]}).encode()).decode()
+    out_a, out_b, out_c = (os.path.join(tmp, f"{x}.json") for x in "abc")
+
+    r = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.launch", "--node_rank=0",
+         "--master_addr=127.0.0.1", f"--master_port={port}",
+         f"--world_info={world_info}", worker,
+         f"--out={out_a}", "--steps=3", "--offload", f"--ckpt_dir={ckpt}"],
+        env=clean_env(), capture_output=True, text=True, timeout=timeout)
+    assert r.returncode == 0, f"phase A failed:\n{r.stdout[-800:]}\n{r.stderr[-1500:]}"
+    env1 = clean_env(XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    r = subprocess.run(
+        [sys.executable, worker, f"--out={out_b}", "--steps=2", "--offload",
+         f"--ckpt_dir={ckpt}", "--load", "--data_offset=3"],
+        env=env1, capture_output=True, text=True, timeout=timeout)
+    assert r.returncode == 0, f"phase B failed:\n{r.stdout[-800:]}\n{r.stderr[-1500:]}"
+    r = subprocess.run(
+        [sys.executable, worker, f"--out={out_c}", "--steps=5", "--offload"],
+        env=env1, capture_output=True, text=True, timeout=timeout)
+    assert r.returncode == 0, f"phase C failed:\n{r.stderr[-1500:]}"
+
+    a, b, c = (json.load(open(p)) for p in (out_a, out_b, out_c))
+    assert a["world"] == 2 and a["roundtrip_ok"], a
+    assert b["world"] == 1 and b["devices"] == 2, b
+    np.testing.assert_allclose(a["losses"], c["losses"][:3], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(b["losses"], c["losses"][3:], rtol=1e-5, atol=1e-6)
+    return a, b, c
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--local_rank", type=int, default=0)
@@ -34,6 +91,12 @@ def main():
                         help="ZeRO-2 + cpu_offload: each process steps and "
                              "checkpoints only its own host-tier regions")
     parser.add_argument("--ckpt_dir", type=str, default=None)
+    parser.add_argument("--load", action="store_true",
+                        help="load --ckpt_dir BEFORE training (elastic: the saved "
+                             "world size may differ from this run's)")
+    parser.add_argument("--data_offset", type=int, default=0,
+                        help="skip this many steps of the deterministic stream "
+                             "(resume continuity)")
     args = parser.parse_args()
 
     import deepspeed_tpu
@@ -51,9 +114,13 @@ def main():
         cfg["zero_optimization"] = {"stage": 2, "cpu_offload": True}
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
                                                config_params=cfg)
-    data = random_dataset(8 * args.steps, hidden, seed=42)
+    if args.load:
+        # elastic path: region files on disk may come from a DIFFERENT world size
+        # (the loader merges every saved process's regions and re-scatters locals)
+        engine.load_checkpoint(args.ckpt_dir)
+    data = random_dataset(8 * (args.data_offset + args.steps), hidden, seed=42)
     losses = []
-    for i in range(args.steps):
+    for i in range(args.data_offset, args.data_offset + args.steps):
         xs = np.stack([data[i * 8 + j][0] for j in range(8)])
         ys = np.stack([data[i * 8 + j][1] for j in range(8)])
         loss = engine(xs, ys)
@@ -63,7 +130,7 @@ def main():
 
     result = {"losses": losses, "world": jax.process_count(),
               "devices": jax.device_count()}
-    if args.ckpt_dir:
+    if args.ckpt_dir and not args.load:
         # every process writes its offload regions; process 0 writes the rest
         engine.save_checkpoint(args.ckpt_dir, tag="t0")
         if args.offload:
