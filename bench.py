@@ -483,8 +483,10 @@ def collect_workload_evidence():
         out["tpu_parity"] = {"passed": False, "error": "timeout"}
 
     try:
+        # 3600 s: the real-corpus convergence gate adds ~5 min of byte-level
+        # training idle, ~3x that under concurrent compiles
         r = subprocess.run([sys.executable, "-m", "pytest", "tests/model", "-q"],
-                           capture_output=True, text=True, timeout=1500, cwd=here)
+                           capture_output=True, text=True, timeout=3600, cwd=here)
         m = re.search(r"(\d+) passed", r.stdout)
         f = re.search(r"(\d+) failed", r.stdout)
         out["model_suite"] = {"passed": int(m.group(1)) if m else 0,

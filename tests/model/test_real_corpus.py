@@ -1,0 +1,97 @@
+"""Real-corpus convergence gate (VERDICT r4 #9).
+
+Every other model-suite workload trains on synthetic streams; this module pins
+that the framework trains models on NATURAL text to a quality threshold — the
+analog of the reference's real-data Megatron-GPT2 / BingBertSquad model tests
+(reference tests/model/Megatron_GPT2/run_func_test.py, BingBertSquad/run_tests.sh).
+
+Corpus: tests/model/data/corpus.txt — 154 KB of genuine natural-English prose
+(freely-redistributable license texts), committed so the gate is self-contained.
+Byte-level modeling (vocab 256/257): no external tokenizer needed.
+
+Thresholds were calibrated on the 8-virtual-device CPU mesh with margin over the
+observed curves (GPT-2: 5.53 -> ~2.74 nats/byte by step 120; BERT-MLM:
+5.59 -> ~3.1-3.5 band by step 100) — loose enough for numeric jitter, tight
+enough that a model failing to learn real-text statistics (loss stuck near the
+uniform baseline ln(256) = 5.55) fails loudly.
+"""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+from .test_common import THIS_DIR, parse_steps, run_gpt2, run_workload
+
+CORPUS = os.path.join(THIS_DIR, "data", "corpus.txt")
+BERT_SCRIPT = os.path.join(THIS_DIR, "bert_mlm_corpus.py")
+
+GPT2_ARGS = ("--seq", "128", "--n-layer", "2", "--n-embd", "128", "--n-head", "4",
+             "--corpus", CORPUS)
+
+
+def corpus_config(**over):
+    cfg = {"train_batch_size": 16, "steps_per_print": 1000,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}
+    cfg.update(over)
+    return cfg
+
+
+def test_corpus_is_natural_text():
+    """The gate is only meaningful on real language: assert the committed corpus
+    looks like English prose, not binary or synthetic noise."""
+    with open(CORPUS, "rb") as f:
+        data = f.read()
+    assert len(data) > 100_000
+    text = data.decode("utf-8")
+    words = text.split()
+    # natural English: common function words appear frequently
+    lower = text.lower()
+    for w in (" the ", " of ", " and ", " to "):
+        assert lower.count(w) > 100, w
+    # bytes-per-word in a natural-language band
+    assert 4 < len(data) / len(words) < 9
+
+
+@pytest.mark.slow
+def test_gpt2_trains_on_real_text_to_threshold(tmp_path):
+    """Next-byte GPT-2 on natural English reaches < 3.05 nats/byte (~4.4 bits)
+    within 120 steps — far below the uniform 5.55 and the unigram ~4.2."""
+    recs, _ = run_gpt2(corpus_config(zero_optimization={"stage": 2}), tmp_path,
+                       steps=120, extra_args=GPT2_ARGS, name="corpus_z2",
+                       timeout=900)
+    assert len(recs) == 120
+    first, tail = recs[0]["loss"], np.mean([r["loss"] for r in recs[-10:]])
+    assert first > 4.5, f"did not start from scratch (first loss {first})"
+    assert tail < 3.05, f"failed to learn natural-text statistics (tail {tail:.3f})"
+
+
+@pytest.mark.slow
+def test_cross_stage_parity_on_real_text(tmp_path):
+    """ZeRO stages are an implementation detail: stage 0 and stage 2 on identical
+    real-text batches/seed must produce the same loss trajectory (the reference's
+    check_parity discipline, run_func_test.py:6-7 — here on natural data)."""
+    k = 20
+    recs0, _ = run_gpt2(corpus_config(), tmp_path, steps=k,
+                        extra_args=GPT2_ARGS, name="corpus_z0", timeout=900)
+    recs2, _ = run_gpt2(corpus_config(zero_optimization={"stage": 2}), tmp_path,
+                        steps=k, extra_args=GPT2_ARGS, name="corpus_z2p", timeout=900)
+    l0 = [r["loss"] for r in recs0]
+    l2 = [r["loss"] for r in recs2]
+    np.testing.assert_allclose(l0, l2, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.slow
+def test_bert_mlm_trains_on_real_text_to_threshold(tmp_path):
+    """Byte-level BERT masked-LM on natural English: mean of the last 20 steps
+    < 3.7 nats on masked positions (uniform baseline ln(257) = 5.55) and at
+    least 1.5 nats below the from-scratch first step."""
+    recs, _ = run_workload(BERT_SCRIPT, corpus_config(zero_optimization={"stage": 2}),
+                           tmp_path, steps=100, extra_args=("--corpus", CORPUS),
+                           name="bert_corpus", timeout=900)
+    assert len(recs) == 100
+    first, tail = recs[0]["loss"], np.mean([r["loss"] for r in recs[-20:]])
+    assert first > 4.5
+    assert tail < 3.7, f"failed to learn masked-byte statistics (tail {tail:.3f})"
+    assert tail < first - 1.5

@@ -25,6 +25,26 @@ jax.config.update("jax_platforms", "cpu")  # before any backend/distributed init
 import numpy as np  # noqa: E402
 
 
+def clean_spawn_env(**extra):
+    """Environment for spawned multi-process workers with every distributed-
+    identity / platform-pinning variable scrubbed (a stale RANK/TPU_* var from
+    the host process would corrupt the spawned world). Single source of truth —
+    test_launcher.py and __graft_entry__'s rehearsal both use it."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("DS_", "TPU_", "CLOUD_TPU"))
+           and k not in ("XLA_FLAGS", "MASTER_ADDR", "MASTER_PORT", "RANK",
+                         "WORLD_SIZE", "LOCAL_RANK", "JAX_PLATFORMS")}
+    env.update(extra)
+    return env
+
+
+def free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def run_elastic_rehearsal(tmp, repo_root, timeout=420):
     """Three-phase sharded-state lifecycle rehearsal, shared by
     tests/unit/test_launcher.py and __graft_entry__'s multichip dry run:
@@ -34,24 +54,16 @@ def run_elastic_rehearsal(tmp, repo_root, timeout=420):
     and continues; (C) an uninterrupted single-process oracle. Returns the
     three result dicts after asserting B continues C step-for-step."""
     import base64
-    import socket
     import subprocess
 
     import numpy as np
 
     def clean_env(**extra):
-        env = {k: v for k, v in os.environ.items()
-               if not k.startswith(("DS_", "TPU_", "CLOUD_TPU"))
-               and k not in ("XLA_FLAGS", "MASTER_ADDR", "MASTER_PORT", "RANK",
-                             "WORLD_SIZE", "LOCAL_RANK", "JAX_PLATFORMS")}
-        env.update(extra, PYTHONPATH=repo_root)
-        return env
+        return clean_spawn_env(PYTHONPATH=repo_root, **extra)
 
     worker = os.path.abspath(__file__)
     ckpt = os.path.join(tmp, "ckpt")
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    port = free_port()
     world_info = base64.urlsafe_b64encode(
         json.dumps({"localhost": [0, 1]}).encode()).decode()
     out_a, out_b, out_c = (os.path.join(tmp, f"{x}.json") for x in "abc")

@@ -251,19 +251,9 @@ import sys
 import numpy as np
 
 
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def _clean_env(**extra):
-    env = {k: v for k, v in os.environ.items()
-           if not k.startswith(("DS_", "TPU_", "CLOUD_TPU"))
-           and k not in ("XLA_FLAGS", "MASTER_ADDR", "MASTER_PORT", "RANK",
-                         "WORLD_SIZE", "LOCAL_RANK", "JAX_PLATFORMS")}
-    env.update(extra)
-    return env
+# single source of truth for spawn-env scrubbing + port pick (shared with the
+# dry-run rehearsal)
+from launcher_worker import clean_spawn_env as _clean_env, free_port as _free_port  # noqa: E402
 
 
 @pytest.mark.slow
