@@ -15,12 +15,17 @@ import sys
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(os.path.dirname(_HERE))
-sys.path.insert(0, _REPO)
-sys.path.insert(0, _HERE)
+if __name__ == "__main__":
+    # spawned-worker bootstrap ONLY: an importing host (pytest, the dry run)
+    # already has its platform pinned and must not get tests/unit at
+    # sys.path[0], where generically named modules (simple_model) would shadow
+    sys.path.insert(0, _REPO)
+    sys.path.insert(0, _HERE)
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")  # before any backend/distributed init
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")  # before any backend/distributed init
 
 import numpy as np  # noqa: E402
 

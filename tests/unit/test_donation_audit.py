@@ -1,0 +1,105 @@
+"""Donation audit (VERDICT r4 #10): the 1.5B-scale engine programs must donate
+cleanly — donation is the HBM margin that decides the remat policy.
+
+Background: the suite's "Some donated buffers were not usable" warnings come
+from paths that donate the GRAD tree into the update program. Grad leaves can
+rarely alias an output (opt state is a flat fp32 shard; grads are per-leaf
+model shapes), so XLA reports them unusable for output aliasing — but donation
+still allows the buffers to be overwritten mid-execution, which is the point
+(at 1.5B an undonated fp32 grad tree holds a full param-tree of HBM through
+the update). Those warnings are expected and pinned here as grad-only.
+
+What must be CLEAN is the fused single-jit step (the pinned 1.5B bench path):
+it donates only opt_state, whose flat shard aliases the updated shard exactly.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from simple_model import SimpleModel, simple_config
+
+
+def _shard_pair(n):
+    """External-master (init, apply) client pair — the 1.5B bench's optimizer
+    structure (bench.py _shard_optimizer) at test scale."""
+    def init(params):
+        flat = jnp.concatenate([p.reshape(-1).astype(jnp.float32)
+                                for p in jax.tree_util.tree_leaves(params)])
+        shard = flat[: flat.shape[0] // n]
+        return {"master": shard, "m1": jnp.zeros_like(shard),
+                "m2": jnp.zeros_like(shard)}
+
+    def apply(grads, opt_state, master, step, hyper):
+        g = jnp.concatenate([x.reshape(-1).astype(jnp.float32)
+                             for x in jax.tree_util.tree_leaves(grads)])
+        gs = g[: opt_state["master"].shape[0]]
+        m1 = 0.9 * opt_state["m1"] + 0.1 * gs
+        m2 = 0.999 * opt_state["m2"] + 0.001 * gs * gs
+        new_master = opt_state["master"] - hyper["lr"] * m1 / (jnp.sqrt(m2) + 1e-8)
+        return None, {"master": new_master, "m1": m1, "m2": m2}
+
+    apply.external_master = True
+    return init, apply
+
+
+def _build(gas):
+    model = SimpleModel(16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+        optimizer=_shard_pair(4),
+        config_params=simple_config(batch=8 * gas, gradient_accumulation_steps=gas,
+                                    zero_optimization={"stage": 2},
+                                    zero_allow_untested_optimizer=True))
+    return engine
+
+
+def _run_steps(engine, n=2):
+    x = np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32)
+    for _ in range(n):
+        loss = engine(x, np.tanh(x))
+        engine.backward(loss)
+        engine.step()
+
+
+def test_fused_step_donates_cleanly():
+    """The external-master FUSED path (the pinned 1.5B bench structure: gas=1,
+    client shard pair, ZeRO-2) must produce ZERO donation warnings: its only
+    donated argument (opt_state) aliases the updated shard leaf-for-leaf."""
+    engine = _build(gas=1)
+    assert engine._run_fused_step is not None, "fused path did not engage"
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        _run_steps(engine)
+    bad = [str(w.message) for w in caught if "donated" in str(w.message).lower()]
+    assert not bad, f"fused step mis-donates: {bad}"
+
+
+def test_unfused_accumulation_warning_is_grad_only():
+    """The unfused external-master path donates the accumulated GRAD tree on
+    purpose (mid-execution reuse). Pin that any 'not usable' warning lists only
+    fp32 grad-shaped buffers — if an opt-state or scaler buffer ever shows up
+    here, the update stopped aliasing and the 1.5B HBM margin silently shrank."""
+    engine = _build(gas=2)
+    assert engine._run_fused_step is None
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        x = np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32)
+        for _ in range(2):  # gas=2: two micro-steps per optimizer step
+            loss = engine(x, np.tanh(x))
+            engine.backward(loss)
+        engine.step()
+    msgs = [str(w.message) for w in caught if "donated" in str(w.message).lower()]
+    for m in msgs:
+        # grads are fp32 here (stage 2 keeps compute-dtype grads, fp32 under
+        # fp32 compute); the flat opt shard is fp32[12] (196 params / 4 -> 49?)
+        # — assert NO buffer matching the opt shard length appears
+        assert "float32" in m, m
+    shard_len = int(engine.opt_state["master"].shape[0])
+    for m in msgs:
+        assert f"float32[{shard_len}]" not in m, \
+            f"opt-state shard appears in donation warning: {m}"

@@ -244,7 +244,6 @@ def test_num_gpus_without_hostfile_honored(monkeypatch):
 # ---------------------------------------------------------------------------
 
 import os
-import socket
 import subprocess
 import sys
 
