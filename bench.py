@@ -348,6 +348,49 @@ def bench_offload_step_timing():
                      "overlapped region pipeline, not production wall-clock")}
 
 
+def bench_decode_420m():
+    """KV-cache greedy decode tokens/s, GPT-2 420M batch 8 (VERDICT r4 #3 — the
+    generation stack is beyond the v0.3.0 reference, so it carries its own
+    number). Decode rate isolated from prefill by differencing a 128-token and a
+    1-token generation; full table (1.5B, batch 1, beam-4) in PERF.md via
+    tests/perf/decode_perf.py."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    T0, NEW, B = 1024, 128, 8
+    cfg = GPT2Config(vocab_size=50304, n_positions=T0 + NEW + 8, n_embd=1024,
+                     n_layer=24, n_head=16, use_flash_attention=True)
+    model = GPT2Model(cfg)
+    params = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.bfloat16) if p.ndim >= 2 else p,
+        model.init(jax.random.PRNGKey(0)))
+    prompt = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(B, T0)), jnp.int32)
+
+    def fence_tokens(x):
+        # the generated [B, T] token array can't go through the scalar _fence
+        return jax.tree_util.tree_leaves(jax.device_get(x))[0]
+
+    def timed(fn):
+        fence_tokens(fn())
+        fence_tokens(fn())
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.time()
+            fence_tokens(fn())
+            best = min(best, time.time() - t0)
+        return best
+
+    t1 = timed(lambda: model.generate(params, prompt, 1))
+    t_long = timed(lambda: model.generate(params, prompt, NEW))
+    out = {"greedy_tok_s": round((NEW - 1) * B / max(t_long - t1, 1e-9), 1),
+           "prefill_s": round(t1, 3), "batch": B, "prompt": T0}
+    del params
+    gc.collect()
+    return out
+
+
 def _zero2_step_fn(model, dp_shard):
     """jitted fwd+bwd + the 1/dp fp32 Adam-shard update of one ZeRO-2 rank."""
     import jax
@@ -632,6 +675,10 @@ def main():
         extra["offload_step_timing"] = bench_offload_step_timing()
     except Exception as e:
         extra["offload_step_timing"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        extra["decode_420m"] = bench_decode_420m()
+    except Exception as e:
+        extra["decode_420m"] = {"error": f"{type(e).__name__}: {e}"}
     mp = max_params_offload()
     extra["max_trainable_params_per_chip_zero_offload"] = int(mp)
     if os.environ.get("DS_BENCH_SKIP_WORKLOADS", "0") != "1":

@@ -512,7 +512,7 @@ class GPT2Model:
                 "cache — the sparse_attention layout applies to training "
                 "forwards only, so generated text reflects full attention")
 
-        def attn_cached(x, bp, kc, vc, pos):
+        def attn_cached(x, bp, kcs, vcs, li, pos):
             B_, Tn, _ = x.shape
             qkv = jnp.dot(x, bp["c_attn_w"].astype(x.dtype),
                           preferred_element_type=jnp.float32).astype(x.dtype) \
@@ -521,42 +521,46 @@ class GPT2Model:
             q = q.reshape(B_, Tn, nh, hd).transpose(0, 2, 1, 3)
             k = k.reshape(B_, Tn, nh, hd).transpose(0, 2, 1, 3)
             v = v.reshape(B_, Tn, nh, hd).transpose(0, 2, 1, 3)
-            kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, 0, pos, 0))
-            vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, 0, pos, 0))
-            s = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
+            # write THROUGH the stacked [L, B, nh, max_len, hd] carry arrays:
+            # per-layer slice-out + end-of-step jnp.stack kept L transient copies
+            # of the whole cache live (measured: 1.5B batch-8 decode demanded
+            # 37.1 G HBM and OOM'd); in-place dynamic_update_slice on the carry
+            # lets XLA alias one buffer through the layer loop
+            kcs = jax.lax.dynamic_update_slice(
+                kcs, k.astype(kcs.dtype)[None], (li, 0, 0, pos, 0))
+            vcs = jax.lax.dynamic_update_slice(
+                vcs, v.astype(vcs.dtype)[None], (li, 0, 0, pos, 0))
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, kcs[li],
                            preferred_element_type=jnp.float32) / math.sqrt(hd)
             j = jnp.arange(max_len)[None, :]
             i = pos + jnp.arange(Tn)[:, None]
             s = jnp.where(j <= i, s, jnp.float32(-1e9))  # causal + not-yet-written mask
             p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
-            y = jnp.einsum("bhqk,bhkd->bhqd", p, vc,
+            y = jnp.einsum("bhqk,bhkd->bhqd", p, vcs[li],
                            preferred_element_type=jnp.float32).astype(x.dtype)
             y = y.transpose(0, 2, 1, 3).reshape(B_, Tn, nh * hd)
             return (jnp.dot(y, bp["c_proj_w"].astype(x.dtype),
                             preferred_element_type=jnp.float32).astype(x.dtype)
-                    + bp["c_proj_b"].astype(x.dtype)), kc, vc
+                    + bp["c_proj_b"].astype(x.dtype)), kcs, vcs
 
         def forward(p, toks, pos, kcs, vcs):
             Tn = toks.shape[1]
             positions = pos + jnp.arange(Tn)
             x = p["wte"][toks].astype(c.compute_dtype) \
                 + p["wpe"][positions].astype(c.compute_dtype)
-            new_k, new_v = [], []
             for li, bp in enumerate(p["blocks"]):
-                a, kc, vc = attn_cached(
+                a, kcs, vcs = attn_cached(
                     self._layer_norm(x, bp["ln_1"], c.layer_norm_epsilon),
-                    bp["attn"], kcs[li], vcs[li], pos)
+                    bp["attn"], kcs, vcs, li, pos)
                 x = x + a
                 h = self._layer_norm(x, bp["ln_2"], c.layer_norm_epsilon)
                 m = (self._moe.apply(bp["moe"], h)[0] if "moe" in bp
                      else self._mlp(h, bp["mlp"]))
                 x = x + m
-                new_k.append(kc)
-                new_v.append(vc)
             x = self._layer_norm(x, p["ln_f"], c.layer_norm_epsilon)
             logits = jnp.einsum("bh,vh->bv", x[:, -1], p["wte"].astype(x.dtype),
                                 preferred_element_type=jnp.float32)
-            return logits, jnp.stack(new_k), jnp.stack(new_v)
+            return logits, kcs, vcs
 
         return forward
 

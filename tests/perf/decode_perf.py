@@ -85,8 +85,21 @@ def bench_model(name, cfg_kwargs, batches=(1, 8), do_beam=True):
 def main():
     print("devices:", jax.devices())
     names = ["420M"] if "--small-only" in sys.argv else ["420M", "1.5B"]
+    everything = "--all" in sys.argv
     for name in names:
-        bench_model(name, MODELS[name])
+        for B in (1, 8):
+            # two configs reproducibly crash THIS rig's relay TPU worker (compile
+            # succeeds; the worker dies mid-run — see PERF.md decode table):
+            # beam-4 at batch 8, and the 1.5B batch-8 long decode. Skip them by
+            # default so the documented repro command completes; --all runs them.
+            beam = True
+            if not everything:
+                if name == "1.5B" and B == 8:
+                    print(f"SKIP {name} batch={B} (crashes the relay worker; "
+                          "run with --all to attempt)", flush=True)
+                    continue
+                beam = B != 8
+            bench_model(name, MODELS[name], batches=(B,), do_beam=beam)
 
 
 if __name__ == "__main__":
