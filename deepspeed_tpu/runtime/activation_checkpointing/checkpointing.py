@@ -228,7 +228,7 @@ def checkpoint_wrapper(fn, policy=None):
             eff_policy = policy
         else:
             raise ValueError(f"unknown remat policy {policy!r}: expected None, 'dots', "
-                             f"'attn', 'dots+attn', 'flash', or a "
+                             f"'attn', 'dots+attn', 'dots+attn-lean', 'flash', or a "
                              f"jax.checkpoint_policies callable")
         ckpt = jax.checkpoint(placed, policy=eff_policy)
         if _config["profile"]:

@@ -1,0 +1,80 @@
+"""Remat-policy classification (CPU guard for the TPU-side replay probe).
+
+`tests/perf/remat_flash_probe.py` proves on the real chip that the attention
+policies compile replay-free; this suite pins the POLICY CALLABLES' decisions
+per-equation in CI (the width-signature logic that distinguishes the fused-qkv
+and square projections must not drift)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.ad_checkpoint import checkpoint_name
+
+from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+    checkpoint_wrapper, _flash_policy)
+
+E = 8
+
+
+def _eqns(fn, *args):
+    return jax.make_jaxpr(fn)(*args).jaxpr.eqns
+
+
+def _decide(policy, eqn):
+    return bool(policy(eqn.primitive, *[v.aval for v in eqn.invars], **eqn.params))
+
+
+def _dot_eqn(n_in, n_out):
+    x = jnp.ones((4, n_in))
+    w = jnp.ones((n_in, n_out))
+    (eqn,) = [e for e in _eqns(lambda x, w: x @ w, x, w)
+              if e.primitive.name == "dot_general"]
+    return eqn
+
+
+def test_flash_policy_saves_named_attention_residuals():
+    pol = _flash_policy()
+    (eqn,) = [e for e in _eqns(lambda x: checkpoint_name(x, "attn_out"), jnp.ones((2,)))
+              if e.primitive.name == "name"]
+    assert _decide(pol, eqn)
+    (eqn,) = [e for e in _eqns(lambda x: checkpoint_name(x, "attn_lse"), jnp.ones((2,)))
+              if e.primitive.name == "name"]
+    assert _decide(pol, eqn)
+    (eqn,) = [e for e in _eqns(lambda x: checkpoint_name(x, "other"), jnp.ones((2,)))
+              if e.primitive.name == "name"]
+    assert not _decide(pol, eqn)
+
+
+@pytest.mark.parametrize("exclude,keep_qkv,qkv,square,fc,head", [
+    # 'flash': drop the fused-qkv save, keep everything else
+    ("qkv", False, False, True, True, True),
+    # 'dots+attn-lean': keep qkv, drop the square attention projection
+    ("square", True, True, False, True, True),
+])
+def test_flash_policy_width_signatures(exclude, keep_qkv, qkv, square, fc, head):
+    pol = _flash_policy(exclude=exclude, keep_qkv=keep_qkv)
+    assert _decide(pol, _dot_eqn(E, 3 * E)) == qkv        # fused qkv [E, 3E]
+    assert _decide(pol, _dot_eqn(E, E)) == square          # attn proj [E, E]
+    assert _decide(pol, _dot_eqn(E, 4 * E)) == fc          # mlp fc [E, 4E]
+    assert _decide(pol, _dot_eqn(4 * E, E)) == head        # mlp proj [4E, E]
+
+
+def test_wrapper_rejects_unknown_policy():
+    with pytest.raises(ValueError, match="unknown remat policy"):
+        checkpoint_wrapper(lambda x: x, policy="not-a-policy")(jnp.ones((2,)))
+
+
+@pytest.mark.parametrize("name", ["dots", "attn", "dots+attn", "flash",
+                                  "dots+attn-lean", None])
+def test_all_named_policies_differentiate(name):
+    """Every named policy must produce a working checkpointed grad (numerics
+    equal to the un-checkpointed oracle)."""
+    w = jnp.ones((4, 4)) * 0.3
+
+    def block(x):
+        return jnp.tanh(x @ w).sum()
+
+    x = jnp.arange(4.0).reshape(1, 4)
+    g_ref = jax.grad(lambda x: block(x))(x)
+    g = jax.grad(lambda x: checkpoint_wrapper(block, policy=name)(x))(x)
+    assert jnp.allclose(g, g_ref)
