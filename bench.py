@@ -253,18 +253,29 @@ def _engine_1p5b_subprocess():
     policy, batch, chunk = PINNED_ENGINE_CONFIG
     got = run_one(policy, batch, chunk, retries=2)
     if got is not None:
-        # best-of-2 on the shared relay chip: run-to-run variance on the SAME
-        # pinned config measured ±4% (0.491 in a post-offload-phase window vs
-        # 0.510 clean); both attempts ride the attempts record for transparency.
-        # The confirmation sample is optional — shorter timeout, no retry — and
-        # the selection label reports how many samples were actually taken.
-        got2 = run_one(policy, batch, chunk, retries=0, timeout=900)
-        n_samples = 1 if got2 is None else 2
-        if got2 is not None and got2[1] > got[1]:
-            got = got2
-        return {"tps": got[0], "mfu": got[1],
+        # Run-to-run variance on the SAME pinned config measured ±4% on the
+        # shared relay chip (0.491 in a post-offload-phase window vs 0.510
+        # clean), so a single draw — and especially a best-of draw — biases the
+        # round-over-round headline high. The headline is the MEDIAN of up to
+        # three samples (VERDICT "What's weak" #1); best-of stays as a secondary
+        # field and every sample rides the attempts record. Confirmation
+        # samples are optional — shorter timeout, no retry — so a relay hiccup
+        # degrades to fewer samples, never to a dead headline.
+        samples = [got]
+        for _ in range(2):
+            extra = run_one(policy, batch, chunk, retries=0, timeout=900)
+            if extra is not None:
+                samples.append(extra)
+        # median by mfu, keeping (tps, mfu) paired: lower-middle on even counts
+        # so the headline is always a genuinely observed sample
+        ranked = sorted(samples, key=lambda s: s[1])
+        med = ranked[(len(ranked) - 1) // 2]
+        best = ranked[-1]
+        return {"tps": med[0], "mfu": med[1],
+                "best_tps": best[0], "best_mfu": best[1],
                 "config": f"remat={policy},batch={batch},chunk={chunk}",
-                "selection": f"best-of-{n_samples} (shared-chip variance; see attempts)",
+                "selection": f"median-of-{len(samples)} (best-of kept as "
+                             f"best_tps/best_mfu; see attempts)",
                 "attempts": attempts}
     sys.stderr.write("[bench] PINNED engine 1.5B config failed — headline engine "
                      "metric will read 0.0 (fallbacks reported separately)\n")
@@ -306,20 +317,41 @@ def _offload_step_once(n_embd, n_layer, vocab=8192):
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, cfg.vocab_size, size=(4, 512)).astype(np.int32)
     labels = np.roll(tokens, -1, axis=1)
-    loss = engine(tokens, labels)
-    engine.backward(loss)
-    engine.step()
-    _fence(loss)
-    t = dict(engine._offload.last_step_timing)
+    # TWO steps: the first pipelined step autotunes the region-element cap (it
+    # takes effect at the next grad fetch), the second is the measured one
+    for _ in range(2):
+        loss = engine(tokens, labels)
+        engine.backward(loss)
+        engine.step()
+        _fence(loss)
+    t = dict(engine.offload_step_timing)
     numel = int(engine._offload.numel)
+    # lane-busy seconds are the honest overlap denominator: fetch_wait is only
+    # the stall the Adam loop actually SAW, so a well-overlapped step has tiny
+    # fetch_wait while fetch_busy stays ~= the serial fetch time
+    lanes = {"fetch": t.get("fetch_busy", t["fetch_wait"]),
+             "adam": t["host_adam"], "push": t.get("push_busy", t["push"])}
+    regions = t.get("regions", [])
+    top = sorted(regions, key=lambda r: -(r["fetch"] + r["adam"] + r["push"]))[:5]
     out = {"params": int(n_params), "numel_local": numel,
            "fetch_wait_s": round(t["fetch_wait"], 3),
+           "fetch_busy_s": round(lanes["fetch"], 3),
            "host_adam_s": round(t["host_adam"], 3),
-           "push_s": round(t["push"], 3), "total_s": round(t["total"], 3),
+           "push_s": round(t["push"], 3),
+           "push_busy_s": round(lanes["push"], 3),
+           "total_s": round(t["total"], 3),
+           "pipeline_depth": t.get("pipeline_depth"),
+           "region_cap_elements": t.get("region_cap"),
+           "n_regions": len(regions), "n_work_items": t.get("n_work_items"),
            "elements_per_s": round(numel / max(t["total"], 1e-9)),
-           # ideal overlapped pipeline -> total ~= max(component) -> efficiency -> 1
+           # ideal overlapped pipeline -> total ~= max(lane busy) -> efficiency -> 1
            "overlap_efficiency": round(
-               max(t["fetch_wait"], t["host_adam"], t["push"]) / max(t["total"], 1e-9), 3)}
+               max(lanes.values()) / max(t["total"], 1e-9), 3),
+           "regions_top": [
+               {"leaf": r["leaf"], "size": r["size"], "chunks": r["chunks"],
+                "fetch_wait_s": round(r["fetch_wait"], 3),
+                "fetch_s": round(r["fetch"], 3), "adam_s": round(r["adam"], 3),
+                "push_s": round(r["push"], 3)} for r in top]}
     del engine, params
     gc.collect()
     return out
@@ -680,6 +712,11 @@ def main():
                   "gpt2_1p5b_engine_mfu": round(e["mfu"], 4),
                   "gpt2_1p5b_engine_config": e["config"],
                   "gpt2_1p5b_engine_attempts": e["attempts"]})
+    if "selection" in e:
+        extra["gpt2_1p5b_engine_selection"] = e["selection"]
+    if "best_mfu" in e:
+        extra["gpt2_1p5b_engine_best_tokens_per_sec"] = round(e["best_tps"], 1)
+        extra["gpt2_1p5b_engine_best_mfu"] = round(e["best_mfu"], 4)
     if e.get("pinned_config_failed"):
         extra["gpt2_1p5b_engine_pinned_config_failed"] = True
         if "fallback" in e:

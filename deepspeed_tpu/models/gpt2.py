@@ -181,8 +181,9 @@ class GPT2Model:
 
             args = (params, tokens, labels) + (() if rng is None else (rng,))
             in_specs = (P(), tok_spec, tok_spec) + (() if rng is None else (P(),))
-            return jax.shard_map(local, mesh=mesh, in_specs=in_specs,
-                                 out_specs=P(), check_vma=False)(*args)
+            from ..parallel.mesh import shard_map
+            return shard_map(local, mesh=mesh, in_specs=in_specs,
+                             out_specs=P(), check_vma=False)(*args)
 
         return model_fn
 

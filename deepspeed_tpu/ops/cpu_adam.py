@@ -13,21 +13,26 @@ addressable from THIS process — the analog of the reference stepping each DP r
 index of a leaf is stored exactly once (replicated leaves are stepped once per host, not
 once per device), so the per-host work and DRAM scale as 1/dp of the model under ZeRO-2.
 
-Overlapped stepping (the reference's async D2H grad copies + ``ds_adam_step_plus_copy``
+Pipelined stepping (the reference's async D2H grad copies + ``ds_adam_step_plus_copy``
 H2D param push, stage2.py:750-907, csrc/adam/custom_cuda_kernel.cu): ``begin_grad_fetch``
-initiates ``copy_to_host_async`` on every local grad shard up front, then
-``step_regions`` walks the regions in order — waiting only for that region's transfer,
-stepping it with the native kernel (loss-scale/clip factor fused in via ``grad_scale``),
-and immediately dispatching the async H2D ``device_put`` of the updated compute-dtype
-slice. Transfers of later regions and device pushes of earlier ones proceed concurrently
-with the host Adam of the current one, so wall-clock ≈ max(transfer, host-Adam) instead
-of their sum.
+initiates ``copy_to_host_async`` on every local grad region up front — splitting regions
+larger than the current element cap into fixed-width device-sliced chunks — and
+``step_regions`` runs a K-deep software pipeline over the resulting work items:
+a dedicated fetch worker lands chunk i+K into the flat grad buffer while the caller
+thread runs host Adam on chunk i (loss-scale/clip factor fused in via ``grad_scale``)
+and a dedicated push worker dispatches the H2D ``device_put`` of regions completed
+earlier. numpy memcpy and the ctypes kernel release the GIL, so the three lanes
+genuinely overlap and wall-clock ≈ max(Σfetch, Σadam, Σpush) instead of their sum.
+The chunk cap is autotuned from the first step's measured fetch/Adam rates (about
+50 ms of the slower lane per chunk) unless pinned via ``max_region_elements``, so a
+single 400M-element region can no longer serialize the whole step.
 
 If the native toolchain is unavailable the same math runs as vectorized numpy
 (~3-10x slower but bit-compatible modulo fma ordering).
 """
 
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
 import numpy as np
@@ -40,8 +45,13 @@ except ImportError:  # pragma: no cover
 
 import jax
 
+from ..runtime.zero.sharding import chunk_spans
 from ..utils import logger
 from .native import load_cpu_adam
+
+#: pre-autotune pipeline chunk cap (elements): small enough that even the first
+#: step of a 400M-element region pipelines, large enough to amortize dispatch
+_DEFAULT_REGION_CAP = 8 << 20
 
 
 def _ptr(arr, ctype=None):
@@ -76,6 +86,69 @@ def _normalize_index(idx, shape):
     return tuple(out)
 
 
+class _LazyFuture:
+    """Future-alike that runs its work on the caller thread at first ``result()``."""
+
+    __slots__ = ("_fn", "_args", "_done", "_result", "_exc")
+
+    def __init__(self, fn, args):
+        self._fn, self._args = fn, args
+        self._done = False
+        self._result = self._exc = None
+
+    def result(self, timeout=None):
+        if not self._done:
+            try:
+                self._result = self._fn(*self._args)
+            except BaseException as e:  # re-raised on every result() like a real Future
+                self._exc = e
+            self._done = True
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class SerialTransferExecutor:
+    """Non-overlapped transfer execution: every fetch/push runs inline on the caller
+    thread when its future is first waited on, reproducing the legacy serial step —
+    wall-clock ≈ Σfetch + Σadam + Σpush. Used when the pipeline is disabled and as
+    the reference path for bit-equality tests."""
+
+    pipelined = False
+
+    def submit_fetch(self, fn, *args):
+        return _LazyFuture(fn, args)
+
+    def submit_push(self, fn, *args):
+        return _LazyFuture(fn, args)
+
+    def shutdown(self):
+        pass
+
+
+class PipelinedTransferExecutor:
+    """Dedicated single-worker fetch and push lanes — the TPU analog of the reference's
+    separate D2H/H2D CUDA streams (stage2.py:750-907). numpy memcpy, ``jax.device_put``
+    staging, and the ctypes Adam kernel all release the GIL, so fetch(i+K) / adam(i) /
+    push(i-1) genuinely overlap across the three threads."""
+
+    pipelined = True
+
+    def __init__(self):
+        self._fetch = ThreadPoolExecutor(1, thread_name_prefix="offload-fetch")
+        self._push = ThreadPoolExecutor(1, thread_name_prefix="offload-push")
+
+    def submit_fetch(self, fn, *args):
+        return self._fetch.submit(fn, *args)
+
+    def submit_push(self, fn, *args):
+        return self._push.submit(fn, *args)
+
+    def shutdown(self):
+        self._fetch.shutdown(wait=False)
+        self._push.shutdown(wait=False)
+
+
 class DeepSpeedCPUAdam:
     """Adam over flat host-resident fp32 buffers with pytree views.
 
@@ -86,11 +159,21 @@ class DeepSpeedCPUAdam:
         tree = opt.params_tree()                     # fp32 numpy leaves
 
     Engine mode passes ``shardings`` (the ZeRO master layout) and uses
-    ``begin_grad_fetch`` + ``step_regions`` for the partitioned, overlapped step.
+    ``begin_grad_fetch`` + ``step_regions`` for the partitioned, pipelined step.
+
+    Pipeline knobs (config block ``zero_optimization.offload_optimizer``):
+    ``pipeline`` toggles the threaded fetch/push lanes (off -> legacy serial walk),
+    ``pipeline_depth`` is K, the number of work items kept in flight ahead of the
+    host Adam, and ``max_region_elements`` caps the per-chunk element count
+    ("auto" -> autotuned after the first step from the measured fetch/Adam rates).
+    Tests may inject a custom executor via the ``transfer_executor`` attribute
+    (anything with ``submit_fetch``/``submit_push`` returning futures and a
+    ``pipelined`` flag).
     """
 
     def __init__(self, params_tree, adamw: bool = True, bias_correction: bool = True,
-                 shardings=None):
+                 shardings=None, pipeline: bool = True, pipeline_depth: int = 2,
+                 max_region_elements="auto"):
         leaves, self._treedef = jax.tree_util.tree_flatten(params_tree)
         shard_leaves = (jax.tree_util.tree_leaves(shardings) if shardings is not None
                         else [None] * len(leaves))
@@ -159,9 +242,65 @@ class DeepSpeedCPUAdam:
         self.adamw = adamw
         self.bias_correction = bias_correction
         self._lib = load_cpu_adam()
-        self.last_step_timing = None  # {"fetch_wait": s, "host_adam": s, "push": s, "total": s}
+        # aggregate + per-region breakdown; see step_regions for the full schema
+        self.last_step_timing = None
         self.last_push_elements = 0   # elements crossing the host->device link last step
         self._warned_fallback = False
+
+        # ---- pipeline configuration
+        self.pipeline = bool(pipeline)
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        if max_region_elements in (None, 0, "auto"):
+            self._cap_fixed = None
+        else:
+            cap = int(max_region_elements)
+            if cap <= 0:
+                raise ValueError(
+                    f"offload_optimizer.max_region_elements must be 'auto' or a positive "
+                    f"integer, got {max_region_elements!r}")
+            self._cap_fixed = cap
+        self._auto_cap = _DEFAULT_REGION_CAP
+        self._autotuned = False
+        self.transfer_executor = None  # injectable; None -> built from `pipeline`
+        self._default_ex = None
+        self._slicers = {}  # cap -> jitted fixed-width device slicer
+
+    # ------------------------------------------------------------- pipeline plumbing
+    def _get_executor(self):
+        if self.transfer_executor is not None:
+            return self.transfer_executor
+        if self._default_ex is None:
+            self._default_ex = (PipelinedTransferExecutor() if self.pipeline
+                                else SerialTransferExecutor())
+        return self._default_ex
+
+    def region_cap(self) -> Optional[int]:
+        """Current per-chunk element cap, or None when stepping serially (unsplit)."""
+        if not getattr(self._get_executor(), "pipelined", False):
+            return None
+        return self._cap_fixed if self._cap_fixed is not None else self._auto_cap
+
+    def _chunk_slicer(self, cap):
+        """Jitted fixed-width flat slice: one compiled program per (leaf shape, cap) —
+        the dynamic start index keeps every chunk of a region on the same executable."""
+        fn = self._slicers.get(cap)
+        if fn is None:
+            from jax import lax
+            fn = jax.jit(lambda x, start: lax.dynamic_slice_in_dim(
+                x.reshape(-1), start, cap))
+            self._slicers[cap] = fn
+        return fn
+
+    def close(self):
+        if self._default_ex is not None:
+            self._default_ex.shutdown()
+            self._default_ex = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     # ------------------------------------------------------------- tree views
     def _assemble(self, flat):
@@ -279,11 +418,19 @@ class DeepSpeedCPUAdam:
         else:
             p -= lr * update
 
-    # ------------------------------------------------------------- overlapped engine path
+    # ------------------------------------------------------------- pipelined engine path
     def begin_grad_fetch(self, grads_tree):
-        """Initiate async D2H of every local grad region; returns opaque handles for
+        """Initiate async D2H of every local grad region; returns opaque work items for
         ``step_regions``. Transfers overlap whatever runs next (device compute, the
-        norm/overflow stats jit, earlier regions' host Adam)."""
+        norm/overflow stats jit, earlier items' host Adam).
+
+        Regions larger than the current chunk cap are split into fixed-width
+        device-sliced chunks, each with its own async copy, so the host Adam of a big
+        region starts as soon as its first chunk lands instead of after the whole
+        region. Work items are ``(kind, data, region, rel_lo, rel_hi, win)`` with
+        [rel_lo, rel_hi) the covered flat sub-range of the region and ``win`` the
+        start of the fetch window that carries it (see ``chunk_spans``)."""
+        cap = self.region_cap()
         gleaves = jax.tree_util.tree_leaves(grads_tree)
         handles = []
         for li, regions in enumerate(self._leaf_regions):
@@ -300,8 +447,15 @@ class DeepSpeedCPUAdam:
                     if s is not None and _normalize_index(
                             s.index if s.index is not None else (), leaf_shape) == \
                             tuple((sl.start, sl.stop) for sl in r.slices):
-                        s.data.copy_to_host_async()
-                        handles.append(("shard", s.data, r))
+                        if cap is not None and r.size > cap:
+                            slicer = self._chunk_slicer(cap)
+                            for lo, hi, win in chunk_spans(r.size, cap):
+                                c = slicer(s.data, win)
+                                c.copy_to_host_async()
+                                handles.append(("shard_chunk", c, r, lo, hi, win))
+                        else:
+                            s.data.copy_to_host_async()
+                            handles.append(("shard", s.data, r, 0, r.size, 0))
                         continue
                 # Layout mismatch (e.g. XLA-chosen grad layouts under cpu-checkpointing):
                 # reassemble the region from the ADDRESSABLE shards only. Never
@@ -317,9 +471,10 @@ class DeepSpeedCPUAdam:
                         self._warned_fallback = True
                     for s in g.addressable_shards:
                         s.data.copy_to_host_async()
-                    handles.append(("region_shards", g, r))
+                    handles.append(("region_shards", g, r, 0, r.size, 0))
                 else:
-                    handles.append(("leaf", g, r))
+                    for lo, hi, _ in chunk_spans(r.size, cap):
+                        handles.append(("leaf", g, r, lo, hi, lo))
         return handles
 
     def _region_from_addressable(self, g, r) -> np.ndarray:
@@ -358,37 +513,109 @@ class DeepSpeedCPUAdam:
                 "multi-host run; give the grads the engine's master/grad shardings")
         return out
 
+    def _fetch_item(self, item, host_leaves):
+        """Land one work item's grads into the flat buffer (fetch-lane work).
+        Returns the busy seconds spent — the blocking D2H wait plus the memcpy."""
+        kind, data, r, rel_lo, rel_hi, win = item
+        t0 = time.perf_counter()
+        dst = self._grad_buf[r.offset + rel_lo:r.offset + rel_hi]
+        if kind in ("shard", "shard_chunk"):
+            h = np.asarray(data)  # blocks until this item's async copy lands
+            np.copyto(dst, h.reshape(-1)[rel_lo - win:rel_hi - win], casting="unsafe")
+        elif kind == "region_shards":
+            np.copyto(dst, self._region_from_addressable(data, r).reshape(-1),
+                      casting="unsafe")
+        else:  # "leaf": host (or device_get-able) array, sliced region-relative
+            if host_leaves[r.leaf] is None:
+                host_leaves[r.leaf] = np.asarray(jax.device_get(data), np.float32)
+            np.copyto(dst, host_leaves[r.leaf][r.slices].reshape(-1)[rel_lo:rel_hi],
+                      casting="unsafe")
+        return time.perf_counter() - t0
+
+    def _push_region(self, r, out_host):
+        """Dispatch one completed region's H2D push (push-lane work). Returns
+        ``(result, pushed_elems, busy_seconds)``; the result is merged into the
+        global assembly on the caller thread."""
+        t0 = time.perf_counter()
+        pushed = 0
+        if r.devices is None:
+            res = ("host", out_host)
+        elif (len(r.devices) > 1 and len(self._leaf_regions[r.leaf]) == 1
+              and len(self._shardings[r.leaf].device_set) == len(r.devices)):
+            # A leaf ZeRO couldn't shard (replicated whole-leaf region), all of its
+            # devices addressable here: push ONE copy over the host link and let a
+            # jitted reshard broadcast it device-to-device (ICI) in step_regions —
+            # host->device bytes stay proportional to the partition, not
+            # x n_devices. (Multi-host replicated leaves keep per-device pushes:
+            # a process-local single-device array cannot enter a cross-process jit.)
+            res = ("repl", jax.device_put(out_host, r.devices[0]))
+            pushed = r.size
+        else:
+            res = ("devs", {dev: jax.device_put(out_host, dev) for dev in r.devices})
+            pushed = r.size * len(r.devices)
+        return res, pushed, time.perf_counter() - t0
+
     def step_regions(self, handles, step: int, lr: float, beta1: float = 0.9,
                      beta2: float = 0.999, eps: float = 1e-8, weight_decay: float = 0.0,
                      grad_scale: float = 1.0, out_dtype=np.float32, leaf_hypers=None):
-        """Partitioned, overlapped step: wait-per-region D2H -> native Adam -> async H2D
-        push of the updated compute-dtype slice. Returns the tree of GLOBAL jax arrays
+        """Partitioned, pipelined step: K work items in flight — the fetch lane lands
+        chunk i+K while the caller thread runs Adam on chunk i and the push lane
+        dispatches regions completed earlier. Returns the tree of GLOBAL jax arrays
         (one per leaf, carrying the construction sharding) in ``out_dtype``.
 
         ``leaf_hypers``: optional per-leaf {lr, beta1, beta2, eps, weight_decay} dicts
         (tree_flatten order) overriding the scalar args — the engine's per-group
-        hyperparameters applied on the host tier."""
+        hyperparameters applied on the host tier.
+
+        ``last_step_timing`` afterwards holds the aggregate lanes (``fetch_wait``
+        caller-thread stall, ``host_adam``, ``push`` drain + global assembly,
+        ``total``), the lane busy sums (``fetch_busy``, ``push_busy``) the overlap
+        efficiency is computed from, the pipeline shape (``pipeline_depth``,
+        ``region_cap``, ``n_work_items``), and ``regions`` — one
+        {leaf, size, chunks, fetch_wait, fetch, adam, push} record per region."""
         out_np = np.dtype(out_dtype)
         use_fused_bf16 = (_BF16 is not None and out_np == np.dtype(_BF16))
-        t_fetch = t_adam = t_push = 0.0
         t0 = time.perf_counter()
-        pushed_elems = 0
+        ex = self._get_executor()
+        # Serial executors run fetches inline at result() time, so depth beyond 1 only
+        # reorders identical work; pipelined lanes keep K items in flight.
+        K = self.pipeline_depth if getattr(ex, "pipelined", False) else 1
+        items = handles
+        n = len(items)
+        host_leaves = [None] * len(self._leaf_regions)
+        remaining = {}  # region -> elements not yet stepped (push fires at zero)
+        for it in items:
+            remaining[it[2]] = remaining.get(it[2], 0) + (it[4] - it[3])
+        staging = {}       # region -> flat compute-dtype output buffer
+        region_order = []  # first-touch order, for the per-region timing records
+        rec = {}
+        t_fetch_wait = t_adam = 0.0
+        fetch_busy = 0.0
+        fetch_futs = [None] * n
+        for j in range(min(K, n)):
+            fetch_futs[j] = ex.submit_fetch(self._fetch_item, items[j], host_leaves)
+        push_futs = []
         pieces = [dict() for _ in self._leaf_regions]  # leaf -> {device: jax.Array}
         repl_single = [None] * len(self._leaf_regions)  # whole-leaf replicated: 1 push/host
-        host_leaves = [None] * len(self._leaf_regions)
-        for kind, data, r in handles:
+        for i, it in enumerate(items):
+            kind, data, r, rel_lo, rel_hi, win = it
             t = time.perf_counter()
-            if kind == "shard":
-                h = np.asarray(data)  # blocks until this region's copy lands
-            elif kind == "region_shards":
-                h = self._region_from_addressable(data, r)
-            else:
-                if host_leaves[r.leaf] is None:
-                    host_leaves[r.leaf] = np.asarray(jax.device_get(data), np.float32)
-                h = host_leaves[r.leaf][r.slices]
-            lo, hi = r.offset, r.offset + r.size
-            self._grad_buf[lo:hi] = np.asarray(h, np.float32).reshape(-1)
-            t_fetch += time.perf_counter() - t
+            busy = fetch_futs[i].result()
+            fetch_futs[i] = None  # drop the chunk array as soon as it's consumed
+            stall = time.perf_counter() - t
+            if i + K < n:
+                fetch_futs[i + K] = ex.submit_fetch(self._fetch_item, items[i + K],
+                                                    host_leaves)
+            rr = rec.get(r)
+            if rr is None:
+                region_order.append(r)
+                rr = rec[r] = {"leaf": r.leaf, "size": r.size, "chunks": 0,
+                               "fetch_wait": 0.0, "fetch": 0.0, "adam": 0.0, "push": 0.0}
+            rr["chunks"] += 1
+            rr["fetch_wait"] += stall
+            rr["fetch"] += busy
+            t_fetch_wait += stall
+            fetch_busy += busy
 
             t = time.perf_counter()
             if leaf_hypers is not None:
@@ -397,37 +624,42 @@ class DeepSpeedCPUAdam:
                 r_eps, r_wd = hy["eps"], hy["weight_decay"]
             else:
                 r_lr, r_b1, r_b2, r_eps, r_wd = lr, beta1, beta2, eps, weight_decay
+            lo, hi = r.offset + rel_lo, r.offset + rel_hi
+            sbuf = staging.get(r)
+            if sbuf is None:
+                sbuf = staging[r] = np.empty(r.size,
+                                             np.uint16 if use_fused_bf16 else out_np)
             if use_fused_bf16:
-                out_seg = np.empty(r.size, np.uint16)
                 self._kernel_step(lo, hi, self._grad_buf, step, r_lr, r_b1, r_b2, r_eps,
-                                  r_wd, grad_scale, out_bf16=out_seg)
-                out_host = out_seg.view(_BF16).reshape(r.shape)
+                                  r_wd, grad_scale, out_bf16=sbuf[rel_lo:rel_hi])
             else:
                 self._kernel_step(lo, hi, self._grad_buf, step, r_lr, r_b1, r_b2, r_eps,
                                   r_wd, grad_scale)
-                out_host = self.fp32[lo:hi].astype(out_np).reshape(r.shape)
-            t_adam += time.perf_counter() - t
+                np.copyto(sbuf[rel_lo:rel_hi], self.fp32[lo:hi], casting="unsafe")
+            dt = time.perf_counter() - t
+            rr["adam"] += dt
+            t_adam += dt
 
-            t = time.perf_counter()
-            if r.devices is None:
-                pieces[r.leaf][None] = out_host
-            elif (len(r.devices) > 1 and len(self._leaf_regions[r.leaf]) == 1
-                  and len(self._shardings[r.leaf].device_set) == len(r.devices)):
-                # A leaf ZeRO couldn't shard (replicated whole-leaf region), all of its
-                # devices addressable here: push ONE copy over the host link and let a
-                # jitted reshard broadcast it device-to-device (ICI) below —
-                # host->device bytes stay proportional to the partition, not
-                # x n_devices. (Multi-host replicated leaves keep per-device pushes:
-                # a process-local single-device array cannot enter a cross-process jit.)
-                repl_single[r.leaf] = jax.device_put(out_host, r.devices[0])
-                pushed_elems += r.size
-            else:
-                for dev in r.devices:
-                    pieces[r.leaf][dev] = jax.device_put(out_host, dev)  # async H2D
-                    pushed_elems += r.size
-            t_push += time.perf_counter() - t
+            remaining[r] -= rel_hi - rel_lo
+            if remaining[r] == 0:  # region complete: hand the whole shard to the push lane
+                out_host = (sbuf.view(_BF16) if use_fused_bf16 else sbuf).reshape(r.shape)
+                push_futs.append((r, ex.submit_push(self._push_region, r, out_host)))
 
         t = time.perf_counter()
+        pushed_elems = 0
+        push_busy = 0.0
+        for r, fut in push_futs:
+            res, pushed, busy = fut.result()
+            rec[r]["push"] = busy
+            push_busy += busy
+            pushed_elems += pushed
+            tag, val = res
+            if tag == "host":
+                pieces[r.leaf][None] = val
+            elif tag == "repl":
+                repl_single[r.leaf] = val
+            else:
+                pieces[r.leaf].update(val)
         out = []
         reshard_idx = []
         for li, (shape, sh) in enumerate(zip(self._shapes, self._shardings)):
@@ -447,11 +679,33 @@ class DeepSpeedCPUAdam:
                                        [self._shardings[li] for li in reshard_idx])
             for li, arr in zip(reshard_idx, resharded):
                 out[li] = arr
-        t_push += time.perf_counter() - t
-        self.last_step_timing = {"fetch_wait": t_fetch, "host_adam": t_adam,
-                                 "push": t_push, "total": time.perf_counter() - t0}
+        t_push = time.perf_counter() - t  # drain stall + global assembly
+        self.last_step_timing = {
+            "fetch_wait": t_fetch_wait, "host_adam": t_adam, "push": t_push,
+            "total": time.perf_counter() - t0,
+            "fetch_busy": fetch_busy, "push_busy": push_busy,
+            "pipeline_depth": K, "region_cap": self.region_cap() or 0,
+            "n_work_items": n,
+            "regions": [rec[r] for r in region_order],
+        }
         self.last_push_elements = pushed_elems
+        self._maybe_autotune_cap(ex, fetch_busy, t_adam)
         return jax.tree_util.tree_unflatten(self._treedef, out)
+
+    def _maybe_autotune_cap(self, ex, fetch_busy: float, adam_busy: float):
+        """Set the chunk cap from the first pipelined step's measured rates: about
+        50 ms of the slower of the fetch/Adam lanes per chunk — deep enough that a
+        hundreds-of-MB region pipelines, coarse enough to amortize per-chunk
+        dispatch. A user-pinned ``max_region_elements`` disables this; the new cap
+        takes effect at the next ``begin_grad_fetch``."""
+        if (self._cap_fixed is not None or self._autotuned
+                or not getattr(ex, "pipelined", False)
+                or fetch_busy <= 0.0 or adam_busy <= 0.0 or self.numel <= 0):
+            return
+        slower_rate = self.numel / max(fetch_busy, adam_busy)
+        cap = int(0.05 * slower_rate)
+        self._auto_cap = max(1 << 20, min(cap, 64 << 20))
+        self._autotuned = True
 
     # ------------------------------------------------------------- checkpoint plumbing
     def load_flat(self, fp32: Optional[np.ndarray] = None, exp_avg: Optional[np.ndarray] = None,

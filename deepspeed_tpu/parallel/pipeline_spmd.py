@@ -54,7 +54,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import DATA_AXIS, PIPE_AXIS
+from .mesh import DATA_AXIS, PIPE_AXIS, axis_size, shard_map
 
 
 def stack_stage_params(per_stage_params):
@@ -93,26 +93,29 @@ def flush_schedule(M: int, S: int, cap: int, streamed: bool = True):
 def _infer_specs(stacked_params, x_microbatches, last_stage_args, first_stage_args,
                  last_stage_args_specs, first_stage_args_specs, stacked_param_specs, M):
     """Default shard_map specs shared by the unsplit and streamed paths: stacked
-    params over pipe, micro-batches data-sharded on dim 1, micro-batched
-    last_stage_args ([M, batch, ...] leaves, e.g. labels) keep their data
-    sharding, everything else replicated. NOTE the last-args rule is a shape
-    heuristic — a WEIGHT whose leading dim happens to equal M gets data-sharded;
-    pass explicit last_stage_args_specs to override (the legacy drain-per-flush
-    schedule, which additionally CHUNKS micro-batched args, refuses to guess and
-    errors instead)."""
+    params over pipe, micro-batches data-sharded on dim 1, everything else
+    replicated. A last_stage_args leaf that LOOKS micro-batched ([M, batch, ...]
+    — e.g. labels, but equally a weight whose leading dim happens to equal M)
+    is ambiguous, and guessing data-sharded would silently mis-shard the weight
+    case; like the drain-per-flush schedule (which additionally CHUNKS
+    micro-batched args), refuse and demand explicit last_stage_args_specs."""
     x_spec = P(*([None, DATA_AXIS] + [None] * (x_microbatches.ndim - 2)))
     stacked_spec = (stacked_param_specs if stacked_param_specs is not None
                     else jax.tree_util.tree_map(
                         lambda a: P(*([PIPE_AXIS] + [None] * (a.ndim - 1))),
                         stacked_params))
 
-    def _last_arg_spec(a):
-        if hasattr(a, "ndim") and a.ndim >= 2 and a.shape[0] == M:
-            return P(*([None, DATA_AXIS] + [None] * (a.ndim - 2)))
-        return P()
-
+    if last_stage_args_specs is None:
+        for path, a in jax.tree_util.tree_flatten_with_path(last_stage_args)[0]:
+            if hasattr(a, "ndim") and a.ndim >= 2 and a.shape[0] == M:
+                raise ValueError(
+                    f"pipeline_apply: last_stage_args leaf "
+                    f"'{jax.tree_util.keystr(path) or '<root>'}' (shape {a.shape}) has "
+                    f"leading dim == M={M} and could be either a micro-batched input "
+                    "(P(None, 'data')) or a replicated weight (P()) — pass explicit "
+                    "last_stage_args_specs instead of relying on shape inference.")
     last_spec = (last_stage_args_specs if last_stage_args_specs is not None
-                 else jax.tree_util.tree_map(_last_arg_spec, last_stage_args))
+                 else jax.tree_util.tree_map(lambda _: P(), last_stage_args))
     first_spec = (first_stage_args_specs if first_stage_args_specs is not None
                   else jax.tree_util.tree_map(lambda _: P(), first_stage_args))
     return x_spec, stacked_spec, last_spec, first_spec
@@ -212,9 +215,9 @@ def _streamed_apply(stage_fn, stacked_params, x_microbatches, cap, *, mesh,
         loss = jax.lax.psum(jnp.where(is_last, loss_acc, 0.0), PIPE_AXIS) / M
         return jax.lax.pmean(loss, DATA_AXIS)
 
-    fn = jax.shard_map(inner, mesh=mesh,
-                       in_specs=(stacked_spec, x_spec, last_spec, first_spec),
-                       out_specs=P(), check_vma=False)
+    fn = shard_map(inner, mesh=mesh,
+                   in_specs=(stacked_spec, x_spec, last_spec, first_spec),
+                   out_specs=P(), check_vma=False)
     return fn(stacked_params, x_microbatches, last_stage_args, first_stage_args)
 
 
@@ -379,7 +382,7 @@ def pipeline_apply(stage_fn: Callable,
                 last_stage_collective=last_stage_collective)
 
     def inner(stacked_local, x_mb, last_args, first_args):
-        S = jax.lax.axis_size(PIPE_AXIS)
+        S = axis_size(PIPE_AXIS)
         s = jax.lax.axis_index(PIPE_AXIS)
         is_first = s == 0
         is_last = s == S - 1
@@ -470,8 +473,8 @@ def pipeline_apply(stage_fn: Callable,
         last_stage_args_specs, first_stage_args_specs, stacked_param_specs, M)
     out_spec = P() if last_stage_fn is not None else x_spec
 
-    fn = jax.shard_map(inner, mesh=mesh,
-                       in_specs=(stacked_spec, x_spec, last_spec, first_spec),
-                       out_specs=out_spec,
-                       check_vma=False)
+    fn = shard_map(inner, mesh=mesh,
+                   in_specs=(stacked_spec, x_spec, last_spec, first_spec),
+                   out_specs=out_spec,
+                   check_vma=False)
     return fn(stacked_params, x_microbatches, last_stage_args, first_stage_args)

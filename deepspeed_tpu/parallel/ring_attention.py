@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.pallas.flash_attention import _merge_partial, flash_attention_with_lse
-from .mesh import DATA_AXIS
+from .mesh import DATA_AXIS, axis_size, shard_map
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
@@ -58,7 +58,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         at global T stays the oracle, and the mask is invariant to ring size.
     Returns the LOCAL [B, H, T_local, D] attention output. Differentiable in q/k/v.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     T_local = q.shape[2]
     # chunks step to the NEXT rank each rotation: after r steps rank i holds the
@@ -103,7 +103,7 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, seq_axis: str = DATA_AXIS,
     sharding = NamedSharding(mesh, spec)
     q, k, v = (x if getattr(x, "sharding", None) == sharding else
                jax.device_put(x, sharding) for x in (q, k, v))
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
                           sm_scale=sm_scale, interpret=interpret,
                           dropout_rate=dropout_rate, dropout_seed=dropout_seed),

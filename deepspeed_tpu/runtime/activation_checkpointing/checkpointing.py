@@ -147,8 +147,44 @@ def _flash_policy(exclude="qkv", keep_qkv=False):
       replay re-runs the widest projection;
     - "square" (policy 'dots+attn-lean'): rhs [E, E], the attention output
       projection — frees E per layer (1.25 GB) and the replay is one cheap dot
-      whose input (attn_out) is itself saved."""
+      whose input (attn_out) is itself saved.
+
+    The classification is purely shape-based, so it is only sound when each
+    width signature is UNIQUE among the model's dots: a square MoE expert dot
+    [F, F] or a head whose vocab happens to equal 3E would silently fall into
+    an exclusion class and lose its save. Each returned policy instance tracks
+    the distinct (contracted, out) rhs shapes it excludes across its trace and
+    raises instead of misclassifying: a second distinct shape in the same
+    exclusion class, or a square width that disagrees with the qkv-implied
+    embed width, is an error directing the caller to an explicit policy."""
     names = jax.checkpoint_policies.save_only_these_names("attn_out", "attn_lse")
+    # per-instance (== per checkpoint_wrapper call, i.e. per trace) signature log:
+    # class name -> set of distinct (contracted, out_w) rhs shapes observed. qkv
+    # signatures are recorded even when kept so the square check can cross-validate
+    # against the qkv-implied embed width.
+    seen = {"qkv": set(), "square": set()}
+
+    def _record(cls, shape, excluding):
+        seen[cls].add(shape)
+        if excluding and len(seen[cls]) > 1:
+            raise ValueError(
+                f"remat policy width-signature collision: {sorted(seen[cls])} both "
+                f"classify as the '{cls}' exclusion — the shape heuristic cannot "
+                f"tell them apart, so one would silently lose its save. Pass an "
+                f"explicit jax.checkpoint_policies callable (or use 'dots+attn') "
+                f"for this model.")
+        if exclude == "square" and seen["qkv"] and seen["square"]:
+            e_widths = {c for c, _ in seen["qkv"]}
+            for e_sq, _ in seen["square"]:
+                if e_sq not in e_widths:
+                    raise ValueError(
+                        f"remat policy width-signature collision: square dot "
+                        f"[{e_sq}, {e_sq}] does not match the fused-qkv embed "
+                        f"width(s) {sorted(e_widths)}, so it is not the attention "
+                        f"output projection (an MoE/router square?) and would "
+                        f"silently lose its save. Pass an explicit "
+                        f"jax.checkpoint_policies callable (or use 'dots+attn') "
+                        f"for this model.")
 
     def eff_policy(prim, *avals, **params):
         if names(prim, *avals, **params):
@@ -161,9 +197,12 @@ def _flash_policy(exclude="qkv", keep_qkv=False):
         if len(avals) >= 2 and getattr(avals[1], "ndim", 0) == 2 and len(rc) == 1:
             rhs = avals[1]
             contracted, out_w = rhs.shape[rc[0]], rhs.shape[1 - rc[0]]
-            if not keep_qkv and out_w == 3 * contracted:
-                return False  # fused-qkv projection: recompute, don't save
+            if out_w == 3 * contracted:
+                _record("qkv", (contracted, out_w), excluding=not keep_qkv)
+                if not keep_qkv:
+                    return False  # fused-qkv projection: recompute, don't save
             if exclude == "square" and out_w == contracted:
+                _record("square", (contracted, out_w), excluding=True)
                 return False  # attention output projection: recompute from attn_out
         return True
 

@@ -345,6 +345,16 @@ class DeepSpeedConfig:
                            "the fp16 loss-scaling block: gradients are cast to fp16 "
                            "before reduction and may overflow (|g| > 65504). Prefer "
                            "'bf16', or enable the fp16 block.")
+        if (self.allreduce_always_fp32 and self.communication_data_type is not None
+                and self.communication_data_type != "fp32"):
+            # engine.py resolves the comm dtype with communication_data_type LAST
+            # (explicit dtype overrides the blanket fp32 switch) — say so instead of
+            # letting the two keys silently disagree
+            logger.warning(
+                f"DeepSpeedConfig: both '{ALLREDUCE_ALWAYS_FP32}' and "
+                f"'{COMMUNICATION_DATA_TYPE}'='{self.communication_data_type}' are set "
+                f"with conflicting dtypes; the explicit {COMMUNICATION_DATA_TYPE} wins "
+                f"and gradients reduce in {self.communication_data_type}.")
         vocabulary_size = self._param_dict.get(VOCABULARY_SIZE, VOCABULARY_SIZE_DEFAULT)
         if vocabulary_size and vocabulary_size % TENSOR_CORE_ALIGN_SIZE != 0:
             logger.warning("DeepSpeedConfig: vocabulary size {} is not aligned to {}, "

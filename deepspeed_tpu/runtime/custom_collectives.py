@@ -32,10 +32,9 @@ from functools import partial
 import jax
 import numpy as np
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..parallel.mesh import DATA_AXIS
+from ..parallel.mesh import DATA_AXIS, shard_map
 
 def _pack_signs(signs):
     """(..., m) int8 in {-1, +1} -> (..., m/8) uint8, 8 signs per byte (set bit

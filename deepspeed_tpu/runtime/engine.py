@@ -334,9 +334,14 @@ class DeepSpeedEngine:
             # Adam/AdamW assert; absent optimizer block defaults to "adam" (L2),
             # matching the _OPTIMIZER_APPLY default for the non-offload path
             _offload_name = self.config.optimizer_name or ADAM_OPTIMIZER
-            self._offload = DeepSpeedCPUAdam(master_fp32,
-                                             adamw=(_offload_name == ADAMW_OPTIMIZER),
-                                             shardings=self._master_shardings)
+            zc = self.config.zero_config
+            self._offload = DeepSpeedCPUAdam(
+                master_fp32,
+                adamw=(_offload_name == ADAMW_OPTIMIZER),
+                shardings=self._master_shardings,
+                pipeline=zc.offload_pipeline,
+                pipeline_depth=zc.offload_pipeline_depth,
+                max_region_elements=zc.offload_max_region_elements)
         elif self._external_master:
             # no engine-held master at all: the optimizer owns parameter state, and
             # the master_params property derives an fp32 VIEW of the compute params
@@ -463,6 +468,14 @@ class DeepSpeedEngine:
 
     def zero_cpu_offload(self):
         return self.config.zero_config.cpu_offload
+
+    @property
+    def offload_step_timing(self):
+        """Last offload step's timing: aggregate lanes (fetch_wait/host_adam/push/total),
+        lane busy sums (fetch_busy/push_busy), pipeline shape (pipeline_depth/
+        region_cap/n_work_items) and per-region records — None before the first step
+        or when offload is disabled. See DeepSpeedCPUAdam.step_regions."""
+        return self._offload.last_step_timing if self._offload is not None else None
 
     def fp16_enabled(self):
         return self.config.fp16_enabled
@@ -698,7 +711,7 @@ class DeepSpeedEngine:
             """shard_map scaffold shared by the stacked (1-bit Adam) and sparse
             reduction modes: replicated params, data-sharded batch, pmean'd loss;
             only the per-leaf grad handling differs."""
-            from jax import shard_map
+            from ..parallel.mesh import shard_map
             param_specs = jax.tree_util.tree_map(lambda _: P(), self.params)
 
             def loss_and_grad(params, scale, *batch):

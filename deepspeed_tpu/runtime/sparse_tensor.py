@@ -97,7 +97,8 @@ def row_sparse_allreduce(dense_local: jnp.ndarray, axis_name: str, capacity: int
                             st.dense_shape)
     dense = gathered.to_dense()
     if mean:
-        dense = dense / jax.lax.axis_size(axis_name)
+        from ..parallel.mesh import axis_size
+        dense = dense / axis_size(axis_name)
     return dense.astype(dense_local.dtype)
 
 

@@ -75,6 +75,26 @@ def sharding_coverage(shardings_tree, tree):
     return sharded, total
 
 
+def chunk_spans(total: int, cap: Optional[int]):
+    """Partition the flat range [0, total) into pipeline work spans of at most ``cap``
+    elements: ``(lo, hi, win)`` triples where [lo, hi) is the span and ``win`` is the
+    start of the fixed-width fetch window that covers it.
+
+    Every window is exactly ``cap`` wide (the last one is right-aligned at
+    ``total - cap``, overlapping its predecessor) so a single compiled fixed-width
+    device slice serves every chunk of a region — the overlap re-fetches identical
+    elements, which the consumer simply doesn't write twice. With ``cap`` None/0 or
+    ``total <= cap`` the region stays whole: one span, window 0.
+    """
+    if not cap or cap <= 0 or total <= cap:
+        return [(0, total, 0)]
+    spans = []
+    for lo in range(0, total, cap):
+        hi = min(lo + cap, total)
+        spans.append((lo, hi, lo if hi - lo == cap else total - cap))
+    return spans
+
+
 def replicated_sharding(mesh: Mesh, tree):
     import jax
     return jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), tree)

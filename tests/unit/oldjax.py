@@ -1,0 +1,19 @@
+"""Version gates for tests that exercise jax APIs fixed after 0.4.x.
+
+``grad_through_shard_map_xfail`` marks tests that differentiate THROUGH a
+shard_map'd pipeline/train step: ``jax.experimental.shard_map``'s transpose
+rule materializes symbolic-zero cotangents as scalars and then fails its own
+``_check_names`` against the dim-named in_specs (``_SpecError``). The
+top-level ``jax.shard_map`` (jax >= 0.5) transposes these correctly, so the
+gate is conditional on its presence — on a current jax these tests must pass.
+"""
+
+import jax
+import pytest
+
+grad_through_shard_map_xfail = pytest.mark.xfail(
+    not hasattr(jax, "shard_map"),
+    reason="jax.experimental.shard_map transpose _SpecError under grad through "
+           "the shard_map'd step (fixed by the top-level jax.shard_map in "
+           "jax >= 0.5)",
+    strict=False)

@@ -153,3 +153,81 @@ def test_compilation_cache_dir_config(tmp_path):
         # cache pointed at this test's (soon-deleted) tmp dir
         jax.config.update("jax_compilation_cache_dir", prev_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)
+
+
+def _capture_warnings(monkeypatch):
+    from deepspeed_tpu.utils import logger
+    msgs = []
+    monkeypatch.setattr(logger, "warning", lambda m, *a: msgs.append(m % a if a else m))
+    return msgs
+
+
+def test_offload_optimizer_block_parses_and_implies_offload():
+    cfg = DeepSpeedConfig(base_dict(zero_optimization={
+        "stage": 2, "offload_optimizer": {"device": "cpu", "pipeline": True,
+                                          "pipeline_depth": 3,
+                                          "max_region_elements": 1 << 22}}), world_size=1)
+    zc = cfg.zero_config
+    assert zc.cpu_offload  # the block implies the legacy enable switch
+    assert zc.offload_device == "cpu"
+    assert zc.offload_pipeline is True
+    assert zc.offload_pipeline_depth == 3
+    assert zc.offload_max_region_elements == 1 << 22
+
+
+def test_offload_optimizer_defaults():
+    cfg = DeepSpeedConfig(base_dict(zero_optimization={"stage": 2, "cpu_offload": True}),
+                          world_size=1)
+    zc = cfg.zero_config
+    assert zc.offload_device == "cpu"
+    assert zc.offload_pipeline is True
+    assert zc.offload_pipeline_depth == 2
+    assert zc.offload_max_region_elements == "auto"
+
+
+def test_offload_optimizer_explicit_disable_wins(monkeypatch):
+    msgs = _capture_warnings(monkeypatch)
+    cfg = DeepSpeedConfig(base_dict(zero_optimization={
+        "stage": 2, "cpu_offload": False, "offload_optimizer": {"pipeline_depth": 4}}),
+        world_size=1)
+    assert cfg.zero_config.cpu_offload is False  # the explicit boolean wins
+    assert cfg.zero_config.offload_pipeline_depth == 4
+    assert any("explicitly" in m and "DISABLED" in m for m in msgs), msgs
+
+
+def test_offload_optimizer_validation():
+    with pytest.raises(ValueError, match="must be a dict"):
+        DeepSpeedConfig(base_dict(zero_optimization={"stage": 2,
+                                                     "offload_optimizer": "cpu"}),
+                        world_size=1)
+    with pytest.raises(ValueError, match="not supported"):
+        DeepSpeedConfig(base_dict(zero_optimization={
+            "stage": 2, "offload_optimizer": {"device": "nvme"}}), world_size=1)
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        DeepSpeedConfig(base_dict(zero_optimization={
+            "stage": 2, "offload_optimizer": {"pipeline_depth": 0}}), world_size=1)
+    with pytest.raises(ValueError, match="max_region_elements"):
+        DeepSpeedConfig(base_dict(zero_optimization={
+            "stage": 2, "offload_optimizer": {"max_region_elements": -1}}), world_size=1)
+
+
+def test_offload_optimizer_unknown_key_warns(monkeypatch):
+    msgs = _capture_warnings(monkeypatch)
+    DeepSpeedConfig(base_dict(zero_optimization={
+        "stage": 2, "offload_optimizer": {"buffer_count": 4}}), world_size=1)
+    assert any("unknown" in m and "buffer_count" in m for m in msgs), msgs
+
+
+def test_comm_dtype_conflict_warns(monkeypatch):
+    """allreduce_always_fp32 + a conflicting communication_data_type must warn and
+    name the winner (the explicit dtype — engine.py applies it last)."""
+    msgs = _capture_warnings(monkeypatch)
+    DeepSpeedConfig(base_dict(bf16={"enabled": True}, allreduce_always_fp32=True,
+                              communication_data_type="bf16"), world_size=1)
+    assert any("communication_data_type wins" in m and "bf16" in m for m in msgs), msgs
+
+    msgs.clear()
+    # agreeing settings (fp32 + fp32) stay silent
+    DeepSpeedConfig(base_dict(bf16={"enabled": True}, allreduce_always_fp32=True,
+                              communication_data_type="fp32"), world_size=1)
+    assert not any("communication_data_type wins" in m for m in msgs), msgs

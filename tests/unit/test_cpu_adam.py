@@ -402,7 +402,7 @@ def test_offload_grad_fetch_fallback_uses_addressable_shards():
     # grads sharded on the WRONG axis: per-device shard shape != region shape
     g_dev = {"w": jax.device_put(g_np["w"], NamedSharding(mesh, P(None, "data")))}
     handles = opt.begin_grad_fetch(g_dev)
-    assert any(kind == "region_shards" for kind, _, _ in handles)
+    assert any(kind == "region_shards" for kind, *_ in handles)
     assert opt._warned_fallback
     opt.step_regions(handles, step=1, lr=1e-2, weight_decay=0.01)
 

@@ -11,6 +11,10 @@ from deepspeed_tpu.parallel.pipeline_spmd import (pipeline_apply, stack_stage_pa
 
 S, M, B, H = 2, 4, 8, 16
 
+# Forward-only pipeline paths work on every jax; grad-through-pipeline needs
+# the top-level jax.shard_map (see tests/unit/oldjax.py).
+from oldjax import grad_through_shard_map_xfail as grad_through_pipeline_xfail
+
 
 @pytest.fixture(scope="module")
 def mesh():
@@ -56,6 +60,7 @@ def test_pipeline_forward_matches_sequential(mesh, toy):
     np.testing.assert_allclose(np.asarray(outs), np.asarray(ref), rtol=1e-5, atol=1e-6)
 
 
+@grad_through_pipeline_xfail
 def test_pipeline_loss_and_grads_match_sequential(mesh, toy):
     stacked, x_mb, labels_mb = toy
 
@@ -63,8 +68,10 @@ def test_pipeline_loss_and_grads_match_sequential(mesh, toy):
         return jnp.mean((y - labels_all[mb])**2)
 
     def pipe_loss(stacked, x_mb):
+        from jax.sharding import PartitionSpec as P
         return pipeline_apply(stage_fn, stacked, x_mb, mesh=mesh,
-                              last_stage_fn=last_fn, last_stage_args=(labels_mb,))
+                              last_stage_fn=last_fn, last_stage_args=(labels_mb,),
+                              last_stage_args_specs=(P(None, "data"),))
 
     l_seq = jax.jit(lambda s, x: seq_loss(s, x, labels_mb))(stacked, x_mb)
     l_pipe = jax.jit(pipe_loss)(stacked, x_mb)
@@ -77,12 +84,40 @@ def test_pipeline_loss_and_grads_match_sequential(mesh, toy):
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_ambiguous_last_stage_args_refused_without_specs(mesh, toy):
+    """A last_stage_args leaf whose leading dim == M is ambiguous (micro-batched
+    labels vs a weight that coincidentally matches); the default streamed path must
+    refuse and name the leaf — same contract as the drain-per-flush schedule —
+    instead of silently guessing data-sharded (ADVICE r5 medium)."""
+    stacked, x_mb, labels_mb = toy
+
+    def last_fn(y, labels_all, mb):
+        return jnp.mean((y - labels_all[mb])**2)
+
+    with pytest.raises(ValueError, match=r"last_stage_args leaf .* leading dim == M"):
+        jax.jit(lambda s, x: pipeline_apply(
+            stage_fn, s, x, mesh=mesh, last_stage_fn=last_fn,
+            last_stage_args=(labels_mb,)))(stacked, x_mb)
+
+    # an unambiguous extra arg (no M-leading dim) still infers P() without specs
+    scale = jnp.float32(2.0)
+
+    def last_fn2(y, s, mb):
+        return s * jnp.mean(y**2)
+
+    l_ok = jax.jit(lambda s, x: pipeline_apply(
+        stage_fn, s, x, mesh=mesh, last_stage_fn=last_fn2,
+        last_stage_args=(scale,)))(stacked, x_mb)
+    assert np.isfinite(float(l_ok))
+
+
 def test_stacked_params_actually_pipe_sharded(mesh, toy):
     stacked, _, _ = toy
     sh = stacked["w"].sharding
     assert not sh.is_fully_replicated
 
 
+@grad_through_pipeline_xfail
 def test_gpt2_pipe_trains(mesh):
     """Full 3D slice: GPT2Pipe (pipe=2 stages x data=4 DP x ZeRO-2) through the engine."""
     from deepspeed_tpu.models.gpt2 import GPT2Config
@@ -217,6 +252,7 @@ def test_gpt2_pipe_to_dense_roundtrip(tp):
     assert restacked["io"]["wte"].shape[0] == 132
 
 
+@grad_through_pipeline_xfail
 @pytest.mark.parametrize("streamed", [True, False])
 def test_auto_flush_split_matches_single_flush(mesh, streamed):
     """M = 8S must auto-split into rematerialized segments (VERDICT r2 next #5) with

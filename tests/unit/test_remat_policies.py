@@ -59,6 +59,42 @@ def test_flash_policy_width_signatures(exclude, keep_qkv, qkv, square, fc, head)
     assert _decide(pol, _dot_eqn(4 * E, E)) == head        # mlp proj [4E, E]
 
 
+def test_flash_policy_refuses_colliding_qkv_widths():
+    """Two DISTINCT shapes in the same exclusion class mean the width heuristic
+    is ambiguous for this model — the policy must fail loudly, not silently
+    drop one dot's save."""
+    pol = _flash_policy(exclude="qkv", keep_qkv=False)
+    assert not _decide(pol, _dot_eqn(E, 3 * E))
+    with pytest.raises(ValueError, match="width-signature collision"):
+        _decide(pol, _dot_eqn(2 * E, 6 * E))  # second, different fused-qkv width
+
+
+def test_flash_policy_refuses_foreign_square_projection():
+    """A square dot whose width disagrees with the qkv-implied embed width is
+    NOT the attention output projection (e.g. an MoE/router square) and must
+    not be silently excluded (ADVICE low finding)."""
+    pol = _flash_policy(exclude="square", keep_qkv=True)
+    assert _decide(pol, _dot_eqn(E, 3 * E))  # establishes embed width E
+    with pytest.raises(ValueError, match="MoE/router square"):
+        _decide(pol, _dot_eqn(2 * E, 2 * E))  # square, but at width 2E != E
+
+
+def test_flash_policy_collision_raises_through_wrapper():
+    """End-to-end: tracing a checkpointed block that contains a foreign square
+    dot under 'dots+attn-lean' raises at trace time instead of mis-saving."""
+    w_qkv = jnp.ones((E, 3 * E))
+    w_moe = jnp.ones((2 * E, 2 * E))
+
+    def block(x):
+        h = x @ w_qkv                      # fused-qkv signature: embed width E
+        r = jnp.ones((4, 2 * E)) @ w_moe   # square at 2E: not the attn out proj
+        return h.sum() + r.sum()
+
+    fn = checkpoint_wrapper(block, policy="dots+attn-lean")
+    with pytest.raises(ValueError, match="width-signature collision"):
+        jax.grad(lambda x: fn(x))(jnp.ones((4, E)))
+
+
 def test_wrapper_rejects_unknown_policy():
     with pytest.raises(ValueError, match="unknown remat policy"):
         checkpoint_wrapper(lambda x: x, policy="not-a-policy")(jnp.ones((2,)))
