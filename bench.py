@@ -75,19 +75,26 @@ def bench_420m():
     # on the axon relay block_until_ready does NOT fence — fence via device_get.
     step()
     _fence(step())
-    dt = float("inf")
-    for _ in range(2):  # best of two: the shared tunnel chip shows ~10% variance
+    # median-of-3 windows with the spread recorded: the shared tunnel chip shows
+    # ~10% variance, and a best-of draw biases the round-over-round flagship
+    # high (same rationale as the 1.5B engine headline's median-of-3)
+    dts = []
+    for _ in range(3):
         t0 = time.time()
         for _ in range(steps):
             loss = step()
         _fence(loss)
-        dt = min(dt, time.time() - t0)
+        dts.append(time.time() - t0)
+    dts.sort()
+    dt = dts[1]
     tps = batch * seq * steps / dt
     mfu = tps * 6.0 * n_params / 1e12 / PEAK_TFLOPS
     del engine, params
     gc.collect()
     return {"gpt2_420m_tokens_per_sec_per_chip": round(tps, 1),
-            "gpt2_420m_mfu": round(mfu, 4)}
+            "gpt2_420m_mfu": round(mfu, 4),
+            "gpt2_420m_window_spread": round((dts[-1] - dts[0]) / dt, 4),
+            "gpt2_420m_selection": f"median-of-3 {steps}-step windows"}
 
 
 def _shard_optimizer(dp):
@@ -257,10 +264,12 @@ def _engine_1p5b_subprocess():
         # shared relay chip (0.491 in a post-offload-phase window vs 0.510
         # clean), so a single draw — and especially a best-of draw — biases the
         # round-over-round headline high. The headline is the MEDIAN of up to
-        # three samples (VERDICT "What's weak" #1); best-of stays as a secondary
-        # field and every sample rides the attempts record. Confirmation
-        # samples are optional — shorter timeout, no retry — so a relay hiccup
-        # degrades to fewer samples, never to a dead headline.
+        # three samples (VERDICT "What's weak" #1) with the observed spread
+        # recorded alongside; every sample rides the attempts record (best-of
+        # fields are retired — a reader wanting the max can take it from
+        # attempts). Confirmation samples are optional — shorter timeout, no
+        # retry — so a relay hiccup degrades to fewer samples, never to a dead
+        # headline.
         samples = [got]
         for _ in range(2):
             extra = run_one(policy, batch, chunk, retries=0, timeout=900)
@@ -270,12 +279,12 @@ def _engine_1p5b_subprocess():
         # so the headline is always a genuinely observed sample
         ranked = sorted(samples, key=lambda s: s[1])
         med = ranked[(len(ranked) - 1) // 2]
-        best = ranked[-1]
+        spread = (ranked[-1][1] - ranked[0][1]) / med[1] if med[1] else 0.0
         return {"tps": med[0], "mfu": med[1],
-                "best_tps": best[0], "best_mfu": best[1],
+                "mfu_spread": round(spread, 4),
                 "config": f"remat={policy},batch={batch},chunk={chunk}",
-                "selection": f"median-of-{len(samples)} (best-of kept as "
-                             f"best_tps/best_mfu; see attempts)",
+                "selection": f"median-of-{len(samples)} subprocess samples "
+                             f"(spread = (max-min)/median mfu; see attempts)",
                 "attempts": attempts}
     sys.stderr.write("[bench] PINNED engine 1.5B config failed — headline engine "
                      "metric will read 0.0 (fallbacks reported separately)\n")
@@ -714,9 +723,8 @@ def main():
                   "gpt2_1p5b_engine_attempts": e["attempts"]})
     if "selection" in e:
         extra["gpt2_1p5b_engine_selection"] = e["selection"]
-    if "best_mfu" in e:
-        extra["gpt2_1p5b_engine_best_tokens_per_sec"] = round(e["best_tps"], 1)
-        extra["gpt2_1p5b_engine_best_mfu"] = round(e["best_mfu"], 4)
+    if "mfu_spread" in e:
+        extra["gpt2_1p5b_engine_mfu_spread"] = e["mfu_spread"]
     if e.get("pinned_config_failed"):
         extra["gpt2_1p5b_engine_pinned_config_failed"] = True
         if "fallback" in e:

@@ -100,6 +100,7 @@ class GPT2Model:
         self.tp_axis = None   # set via with_tp() for manual-collective (shard_map) TP
         self.tp_size = 1
         self.seq_axis = None  # set via with_sequence_parallel() for ring attention
+        self.seq_schedule = "zigzag"  # causal ring schedule ("zigzag" | "masked")
         self._sparse_layouts = {}  # seq_len -> block layout (host numpy), built once
         if config.sparse_attention is not None:
             assert config.dropout == 0.0, \
@@ -133,13 +134,20 @@ class GPT2Model:
         m.tp_size = size
         return m
 
-    def with_sequence_parallel(self, axis: str) -> "GPT2Model":
+    def with_sequence_parallel(self, axis: str, schedule: str = "zigzag") -> "GPT2Model":
         """A copy configured for ring-attention sequence parallelism over mesh axis
         ``axis``: call inside shard_map with tokens/activations sharded over the
         SEQUENCE dim (see ``sequence_parallel_loss_fn`` for the packaged wrapper).
-        Position embeddings offset by the rank's chunk start; attention runs the
-        ppermute ring (parallel/ring_attention.py). Long-context path past the
+        ``schedule`` picks the causal ring: ``"zigzag"`` (default — balanced
+        early+late chunk layout, no masked-compute tax; tokens must arrive in the
+        ``zigzag_shard`` order and positions follow the interleave) or
+        ``"masked"`` (contiguous chunks, the original oracle). Position
+        embeddings map local positions to global; attention runs the ppermute
+        ring (parallel/ring_attention.py). Long-context path past the
         single-chip flash kernel's whole-K/V VMEM cap."""
+        from ..parallel.ring_attention import SCHEDULES
+        assert schedule in SCHEDULES, \
+            f"schedule must be one of {SCHEDULES}, got {schedule!r}"
         assert self.tp_axis is None, \
             "sequence parallelism does not compose with manual TP yet"
         assert self.config.sparse_attention is None, \
@@ -150,20 +158,33 @@ class GPT2Model:
         # term is pmean'd unweighted alongside the count-weighted CE
         m = GPT2Model(self.config)
         m.seq_axis = axis
+        m.seq_schedule = schedule
         return m
 
-    def sequence_parallel_loss_fn(self, mesh, axis: str):
+    def sequence_parallel_loss_fn(self, mesh, axis: str, schedule: str = "zigzag"):
         """``model_fn(params, tokens, labels, rng=None) -> loss`` for the engine:
         shard_map over ``axis`` with the sequence dim of tokens/labels sharded and
         ring attention inside. ``labels`` must be globally next-token-shifted
         BEFORE sharding (the shift crosses chunk boundaries). Pass ``rng`` to
         enable dropout (config.dropout > 0): attention dropout runs in-ring with
-        global-coordinate masks; hidden dropout decorrelates per rank."""
+        global-coordinate masks; hidden dropout decorrelates per rank.
+
+        Under the default ``schedule="zigzag"`` the wrapper reorders tokens AND
+        labels into the zigzag layout (one static gather each) before sharding,
+        so callers keep passing natural-order sequences; the scalar loss needs no
+        inverse. The per-token CE is weighted by global valid counts, which is
+        permutation-invariant, so the loss equals the masked schedule's exactly
+        (up to flash-merge rounding)."""
         from jax.sharding import PartitionSpec as P
-        sp = self.with_sequence_parallel(axis)
+        sp = self.with_sequence_parallel(axis, schedule=schedule)
+        n_ranks = mesh.shape[axis]
         tok_spec = P(None, axis)
 
         def model_fn(params, tokens, labels, rng=None):
+            if schedule == "zigzag":
+                from ..parallel.ring_attention import zigzag_shard
+                tokens = zigzag_shard(tokens, n_ranks, axis=1)
+                labels = zigzag_shard(labels, n_ranks, axis=1)
             def local(params, tokens, labels, *r):
                 # sum-of-losses / sum-of-counts across ranks: with ignore labels
                 # (-100) the per-rank VALID counts differ, so a pmean of per-rank
@@ -282,9 +303,13 @@ class GPT2Model:
         return jnp.where(mask, x / jnp.asarray(keep, x.dtype), jnp.zeros((), x.dtype))
 
     def _attention(self, x, p, dropout_rng=None):
+        from jax.ad_checkpoint import checkpoint_name
         c = self.config
         B, T, E = x.shape
         nh = c.n_head // self.tp_size  # local heads under manual TP (all heads otherwise)
+        # announce the fused-qkv dot to the flash remat policies: tagging the dot
+        # input turns the policy's width-signature guess into an exact match
+        x = checkpoint_name(x, "ds_dot:qkv")
         qkv = jnp.dot(x, p["c_attn_w"].astype(x.dtype),
                       preferred_element_type=jnp.float32).astype(x.dtype) + p["c_attn_b"].astype(x.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -302,11 +327,13 @@ class GPT2Model:
                                       jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
             rate = float(c.dropout)
         if self.seq_axis is not None:
-            # sequence-parallel ring: T here is the LOCAL chunk; global causality is
-            # handled by chunk ordering + the diagonal chunk's in-kernel mask
+            # sequence-parallel ring: T here is the LOCAL chunk; global causality
+            # is handled by the schedule's layout + in-kernel global-coordinate
+            # masks (zigzag) or chunk ordering + the diagonal mask (masked)
             from ..parallel.ring_attention import ring_attention
             y = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True,
-                               dropout_rate=rate, dropout_seed=seed)
+                               dropout_rate=rate, dropout_seed=seed,
+                               schedule=self.seq_schedule)
         elif c.sparse_attention is not None:
             from ..ops.pallas.block_sparse_attention import block_sparse_attention
             sc = c.sparse_attention
@@ -343,11 +370,12 @@ class GPT2Model:
                 probs = self._dropout(probs, dropout_rng)
             y = jnp.einsum("bhqk,bhkd->bhqd", probs, v,
                            preferred_element_type=jnp.float32).astype(x.dtype)
-        from jax.ad_checkpoint import checkpoint_name
         # tag for the "attn" remat policy: saving this tensor lets backward skip
         # replaying the attention kernel (the priciest recompute under full remat)
         y = checkpoint_name(y, "attn_out")
         y = y.transpose(0, 2, 1, 3).reshape(B, T, nh * c.head_dim)
+        # announce the square output projection (the 'dots+attn-lean' exclusion)
+        y = checkpoint_name(y, "ds_dot:proj")
         y = jnp.dot(y, p["c_proj_w"].astype(x.dtype), preferred_element_type=jnp.float32)
         if self.tp_axis is not None:
             # row-parallel projection: partial sums over the model axis (Megatron fwd)
@@ -390,8 +418,19 @@ class GPT2Model:
         B, T = tokens.shape
         pos = jnp.arange(T)
         if self.seq_axis is not None:
-            # sequence-sharded: this rank holds global positions [r*T, (r+1)*T)
-            pos = pos + jax.lax.axis_index(self.seq_axis) * T
+            rank = jax.lax.axis_index(self.seq_axis)
+            if self.seq_schedule == "zigzag":
+                # zigzag layout: this rank holds global chunks (rank, 2n-1-rank)
+                # of size T/2 — positions follow the interleave
+                from ..parallel.mesh import axis_size
+                n = axis_size(self.seq_axis)
+                assert T % 2 == 0, f"zigzag needs an even local seq, got {T}"
+                C = T // 2
+                pos = jnp.concatenate([rank * C + jnp.arange(C),
+                                       (2 * n - 1 - rank) * C + jnp.arange(C)])
+            else:
+                # contiguous: this rank holds global positions [r*T, (r+1)*T)
+                pos = pos + rank * T
         x = params["wte"][tokens].astype(c.compute_dtype) + params["wpe"][pos].astype(c.compute_dtype)
         use_dropout = rng is not None and c.dropout > 0
         if use_dropout:

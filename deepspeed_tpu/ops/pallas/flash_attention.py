@@ -105,11 +105,36 @@ LOG2E = 1.4426950408889634  # 1/ln(2): softmax runs in base 2 (exp2 is the cheap
 # VPU transcendental, and folding sm_scale*log2e into q kills a per-tile scale pass)
 
 
-def _fwd_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, threshold):
+def _read_seed_ref(seed_ref, seg):
+    """Unpack the SMEM seed/offset operand.
+
+    Contiguous form (3,): ``[seed, q_off, k_off]`` — global position is local
+    position plus the scalar offset.
+    Segmented form (7,): ``[seed, q_off0, k_off0, q_half, q_off1, k_half, k_off1]``
+    — the local sequence is two concatenated global segments (zigzag ring layout):
+    local positions ``< *_half`` start at ``*_off0``, the rest at ``*_off1``.
+    Returns ``(seed_u32, map_q, map_k)`` where the maps take local int32 position
+    arrays to global coordinates.
+    """
+    seed_u32 = seed_ref[0].astype(jnp.uint32)
+    q_off, k_off = seed_ref[1], seed_ref[2]
+    if seg:
+        q_half, q_off1 = seed_ref[3], seed_ref[4]
+        k_half, k_off1 = seed_ref[5], seed_ref[6]
+        map_q = lambda p: p + jnp.where(p < q_half, q_off, q_off1 - q_half)
+        map_k = lambda p: p + jnp.where(p < k_half, k_off, k_off1 - k_half)
+    else:
+        map_q = lambda p: p + q_off
+        map_k = lambda p: p + k_off
+    return seed_u32, map_q, map_k
+
+
+def _fwd_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, threshold,
+                has_seed, seg):
     i = 0
     seed_ref = None
     bias_ref = None
-    if rate > 0:
+    if has_seed:
         seed_ref = refs[i]
         i += 1
     if has_bias:
@@ -123,19 +148,24 @@ def _fwd_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, thres
     # native mode — upcasting to fp32 before the dot ran the matmuls many times slower.
     # sm_scale*log2e is pre-folded into q: scores come out of the MXU in base-2 units.
     q = (q_ref[...].astype(jnp.float32) * (sm_scale * LOG2E)).astype(q_ref.dtype)
-    if rate > 0:
-        # seed operand is [seed, q_offset, k_offset]: the offsets translate this
-        # call's LOCAL positions into GLOBAL sequence coordinates for the dropout
-        # hash, so chunked long-context tiles and ring-attention shards regenerate
-        # the same bit stream as a single whole-sequence kernel would.
-        seed_u32 = seed_ref[0].astype(jnp.uint32)
-        q_off, k_off = seed_ref[1], seed_ref[2]
+    if has_seed:
+        # see _read_seed_ref: the operand translates this call's LOCAL positions into
+        # GLOBAL sequence coordinates for the dropout hash (and, in the segmented
+        # zigzag layout, the causal mask), so chunked long-context tiles and
+        # ring-attention shards regenerate the same bit stream / mask a single
+        # whole-sequence kernel would.
+        seed_u32, map_q, map_k = _read_seed_ref(seed_ref, seg)
         bh_u32 = pl.program_id(0).astype(jnp.uint32)
+    if rate > 0:
         inv_keep = 1.0 / (1.0 - rate)
 
     num_k_blocks = pl.cdiv(seq_len, block_k)
     if causal:
-        # process k blocks up to and including the diagonal block
+        # process k blocks up to and including the diagonal block. The bounds use
+        # LOCAL indices — exact for segmented layouts too, because causal segmented
+        # calls require identical, monotone q/k segment maps (zigzag: both sides are
+        # the same [chunk i, chunk 2n-1-i] interleave), under which local order
+        # equals global order.
         last_blk = jnp.minimum(num_k_blocks, (q_blk_idx * bq + bq + block_k - 1) // block_k)
         # blocks strictly below the diagonal need no mask: max k_pos <= min q_pos
         n_full = jnp.minimum(last_blk, (q_blk_idx * bq + 1) // block_k)
@@ -158,15 +188,19 @@ def _fwd_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, thres
             if masked or rate > 0:
                 q_pos = q_blk_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
                 k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+                if has_seed:
+                    q_glob, k_glob = map_q(q_pos), map_k(k_pos)
+                else:
+                    q_glob, k_glob = q_pos, k_pos
             if masked:
-                s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+                s = jnp.where(q_glob >= k_glob, s, DEFAULT_MASK_VALUE)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
             p = jnp.exp2(s - m_new)
             alpha = jnp.exp2(m - m_new)
             # the normalizer uses the UNdropped probabilities (torch dropout(softmax(s)))
             l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
             if rate > 0:
-                bits = _dropout_bits(seed_u32, bh_u32, q_pos + q_off, k_pos + k_off)
+                bits = _dropout_bits(seed_u32, bh_u32, q_glob, k_glob)
                 keep = (bits >= jnp.uint32(threshold)).astype(jnp.float32) * inv_keep
                 p_eff = p * keep
             else:
@@ -186,6 +220,11 @@ def _fwd_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, thres
     lse_ref[...] = (m / LOG2E + jnp.log(l)).reshape(1, bq)
 
 
+def _is_segmented(seed) -> bool:
+    """Whether a packed seed/offset operand carries the (7,) segmented layout."""
+    return seed is not None and np.shape(seed)[-1] == 7
+
+
 def _aux_operands(seed, bias, B, H, T, rate, block_k_map=None):
     """(operands, in_specs) for the optional seed/bias inputs shared by all kernels.
 
@@ -193,10 +232,10 @@ def _aux_operands(seed, bias, B, H, T, rate, block_k_map=None):
     (block, index_map) pair for k-blocked bias tiles.
     """
     operands, specs = [], []
-    if rate > 0:
-        # [seed, q_offset, k_offset] — see _fwd_kernel on the global-coordinate
-        # contract for the dropout hash
-        operands.append(jnp.asarray(seed, jnp.int32).reshape(3))
+    if seed is not None:
+        # packed (3,) or (7,) offset operand — see _read_seed_ref on the
+        # global-coordinate contract for the dropout hash and segmented causal mask
+        operands.append(jnp.asarray(seed, jnp.int32))
         specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
     if bias is not None:
         operands.append(jnp.asarray(bias, jnp.float32).reshape(B, 1, T))
@@ -217,7 +256,8 @@ def _flash_fwd(q, k, v, seed, bias, sm_scale, causal, rate, block_q, block_k, in
 
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                                block_k=block_k, seq_len=T, has_bias=bias is not None,
-                               rate=rate, threshold=_keep_threshold(rate))
+                               rate=rate, threshold=_keep_threshold(rate),
+                               has_seed=seed is not None, seg=_is_segmented(seed))
     aux, aux_specs = _aux_operands(seed, bias, B, H, T, rate)
     out, lse = pl.pallas_call(
         kernel,
@@ -246,10 +286,11 @@ def _flash_fwd(q, k, v, seed, bias, sm_scale, causal, rate, block_q, block_k, in
 # backward kernels
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, threshold):
+def _bwd_dq_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, threshold,
+                   has_seed, seg):
     i = 0
     seed_ref = bias_ref = None
-    if rate > 0:
+    if has_seed:
         seed_ref = refs[i]
         i += 1
     if has_bias:
@@ -263,10 +304,10 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, th
     do = do_ref[...]
     lse2 = lse_ref[...].reshape(bq, 1) * LOG2E  # natural -> base-2
     delta = delta_ref[...].reshape(bq, 1)
-    if rate > 0:
-        seed_u32 = seed_ref[0].astype(jnp.uint32)
-        q_off, k_off = seed_ref[1], seed_ref[2]
+    if has_seed:
+        seed_u32, map_q, map_k = _read_seed_ref(seed_ref, seg)
         bh_u32 = pl.program_id(0).astype(jnp.uint32)
+    if rate > 0:
         inv_keep = 1.0 / (1.0 - rate)
 
     num_k_blocks = pl.cdiv(seq_len, block_k)
@@ -287,12 +328,16 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, th
             if masked or rate > 0:
                 q_pos = q_blk_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
                 k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+                if has_seed:
+                    q_glob, k_glob = map_q(q_pos), map_k(k_pos)
+                else:
+                    q_glob, k_glob = q_pos, k_pos
             if masked:
-                s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+                s = jnp.where(q_glob >= k_glob, s, DEFAULT_MASK_VALUE)
             p = jnp.exp2(s - lse2)
             dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
             if rate > 0:
-                bits = _dropout_bits(seed_u32, bh_u32, q_pos + q_off, k_pos + k_off)
+                bits = _dropout_bits(seed_u32, bh_u32, q_glob, k_glob)
                 dp = dp * ((bits >= jnp.uint32(threshold)).astype(jnp.float32) * inv_keep)
             ds = p * (dp - delta)
             return dq + jnp.dot(ds.astype(k_blk.dtype), k_blk, preferred_element_type=jnp.float32)
@@ -304,10 +349,11 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_k, seq_len, has_bias, rate, th
     dq_ref[...] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, seq_len, has_bias, rate, threshold):
+def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, seq_len, has_bias, rate, threshold,
+                    has_seed, seg):
     i = 0
     seed_ref = bias_ref = None
-    if rate > 0:
+    if has_seed:
         seed_ref = refs[i]
         i += 1
     if has_bias:
@@ -319,10 +365,10 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, seq_len, has_bias, rate, t
     # base-2 softmax: fold sm_scale*log2e into K here (q stays raw in this kernel)
     k = (k_ref[...].astype(jnp.float32) * (sm_scale * LOG2E)).astype(k_ref.dtype)
     v = v_ref[...]
-    if rate > 0:
-        seed_u32 = seed_ref[0].astype(jnp.uint32)
-        q_off, k_off = seed_ref[1], seed_ref[2]
+    if has_seed:
+        seed_u32, map_q, map_k = _read_seed_ref(seed_ref, seg)
         bh_u32 = pl.program_id(0).astype(jnp.uint32)
+    if rate > 0:
         inv_keep = 1.0 / (1.0 - rate)
 
     num_q_blocks = pl.cdiv(seq_len, block_q)
@@ -348,11 +394,15 @@ def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, seq_len, has_bias, rate, t
             if masked or rate > 0:
                 q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
                 k_pos = k_blk_idx * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+                if has_seed:
+                    q_glob, k_glob = map_q(q_pos), map_k(k_pos)
+                else:
+                    q_glob, k_glob = q_pos, k_pos
             if masked:
-                s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+                s = jnp.where(q_glob >= k_glob, s, DEFAULT_MASK_VALUE)
             p = jnp.exp2(s - lse2_blk)
             if rate > 0:
-                bits = _dropout_bits(seed_u32, bh_u32, q_pos + q_off, k_pos + k_off)
+                bits = _dropout_bits(seed_u32, bh_u32, q_glob, k_glob)
                 keep = (bits >= jnp.uint32(threshold)).astype(jnp.float32) * inv_keep
                 p_drop = p * keep
             else:
@@ -404,7 +454,8 @@ def _flash_bwd(res, g, seed, bias, sm_scale, causal, rate, block_q, block_k, int
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_k=block_k, seq_len=T, has_bias=has_bias, rate=rate,
-                          threshold=_keep_threshold(rate)),
+                          threshold=_keep_threshold(rate),
+                          has_seed=seed is not None, seg=_is_segmented(seed)),
         grid=(B * H, pl.cdiv(T, block_q)),
         in_specs=aux_specs + [
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
@@ -426,7 +477,8 @@ def _flash_bwd(res, g, seed, bias, sm_scale, causal, rate, block_q, block_k, int
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, seq_len=T, has_bias=has_bias, rate=rate,
-                          threshold=_keep_threshold(rate)),
+                          threshold=_keep_threshold(rate),
+                          has_seed=seed is not None, seg=_is_segmented(seed)),
         grid=(B * H, pl.cdiv(T, block_k)),
         in_specs=aux2_specs + [
             pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
@@ -569,13 +621,33 @@ def _seed_vec(seed, q_offset, k_offset):
                       jnp.asarray(k_offset, jnp.int32).reshape(())])
 
 
+def _seed_vec_seg(seed, q_segments, k_segments, T_q, T_k,
+                  q_offset=0, k_offset=0):
+    """Pack the (7,) segmented operand ``[seed, q_off0, k_off0, q_half, q_off1,
+    k_half, k_off1]`` (see ``_read_seed_ref``). A ``*_segments`` pair gives the
+    global start offsets of the two equal halves of that side's local sequence;
+    ``None`` means the side is contiguous at the plain scalar offset (its half
+    boundary is pushed past the end so the first branch always wins)."""
+    if q_segments is not None:
+        q0, q1, qh = q_segments[0], q_segments[1], T_q // 2
+    else:
+        q0, q1, qh = q_offset, 0, T_q
+    if k_segments is not None:
+        k0, k1, kh = k_segments[0], k_segments[1], T_k // 2
+    else:
+        k0, k1, kh = k_offset, 0, T_k
+    return jnp.stack([jnp.asarray(x, jnp.int32).reshape(())
+                      for x in (seed, q0, k0, qh, q1, kh, k1)])
+
+
 def flash_attention_with_lse(q, k, v, causal: bool = False,
                              sm_scale: Optional[float] = None,
                              block_q: Optional[int] = None,
                              block_k: Optional[int] = None,
                              interpret: Optional[bool] = None,
                              dropout_rate: float = 0.0, dropout_seed=None,
-                             dropout_q_offset=0, dropout_k_offset=0):
+                             dropout_q_offset=0, dropout_k_offset=0,
+                             q_segments=None, k_segments=None):
     """Flash attention returning ``(out, lse)``, BOTH differentiable.
 
     ``lse`` is the per-row log-sum-exp of the scaled scores ([B, H, T_q], natural
@@ -586,10 +658,30 @@ def flash_attention_with_lse(q, k, v, causal: bool = False,
     ``dropout_q_offset``/``dropout_k_offset`` translate this call's local positions
     into global sequence coordinates for the dropout PRNG, so chunk/ring callers
     sample the same mask a whole-sequence kernel would (they may be traced values).
+
+    ``q_segments``/``k_segments``: optional ``(off0, off1)`` pairs declaring that
+    side's local sequence to be TWO concatenated global segments of equal length
+    (the zigzag ring's [chunk i, chunk 2n-1-i] interleave): local position ``p``
+    maps to global ``off0 + p`` in the first half and ``off1 + (p - half)`` in the
+    second. Both the causal mask and the dropout hash then run in global
+    coordinates. A causal segmented call requires q_segments == k_segments with
+    ``off0 < off1`` (identical monotone maps keep the kernel's local block-pruning
+    bounds exact); offsets may be traced. Overrides ``dropout_*_offset`` for the
+    segmented side.
     """
     rate = float(dropout_rate)
     if rate > 0:
         assert dropout_seed is not None, "dropout_rate > 0 requires a dropout_seed"
+    segmented = q_segments is not None or k_segments is not None
+    if segmented and causal:
+        assert q_segments is not None and k_segments is not None, (
+            "causal segmented attention requires BOTH q_segments and k_segments "
+            "(identical maps keep local block pruning exact)")
+    if segmented and (causal or rate > 0):
+        seed = _seed_vec_seg(dropout_seed if dropout_seed is not None else 0,
+                             q_segments, k_segments, q.shape[2], k.shape[2],
+                             dropout_q_offset, dropout_k_offset)
+    elif rate > 0:
         seed = _seed_vec(dropout_seed, dropout_q_offset, dropout_k_offset)
     else:
         seed = None

@@ -26,6 +26,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 
 class TransformerConfig:
@@ -192,6 +193,9 @@ class DeepSpeedTransformerLayer:
         heads = c.heads
         d = H // heads
         dt = x.dtype
+        # announce the fused-qkv dot to the flash remat policies (exact tag match
+        # instead of the width-signature guess)
+        x = checkpoint_name(x, "ds_dot:qkv")
         qkv = (jnp.dot(x, params["attn_qkvw"].astype(dt), preferred_element_type=jnp.float32)
                .astype(dt) + params["attn_qkvb"].astype(dt))
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -235,6 +239,8 @@ class DeepSpeedTransformerLayer:
             ctx = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(dt), v,
                              preferred_element_type=jnp.float32).astype(dt)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(B, T, H)
+        # announce the square output projection (the 'dots+attn-lean' exclusion)
+        ctx = checkpoint_name(ctx, "ds_dot:proj")
         out = (jnp.dot(ctx, params["attn_ow"].astype(dt), preferred_element_type=jnp.float32)
                .astype(dt) + params["attn_ob"].astype(dt))
         return out, rng

@@ -2,4 +2,5 @@ from .topology import (ProcessTopology, PipeDataParallelTopology, PipeModelDataP
                        PipelineParallelGrid)
 from .mesh import build_mesh, single_device_mesh, data_sharding, replicated, mesh_from_mpu, \
     DATA_AXIS, MODEL_AXIS, PIPE_AXIS
-from .ring_attention import ring_attention, ring_attention_sharded
+from .ring_attention import (ring_attention, ring_attention_sharded,
+                             ring_work_schedule, zigzag_shard, zigzag_unshard)

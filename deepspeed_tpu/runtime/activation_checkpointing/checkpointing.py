@@ -149,20 +149,32 @@ def _flash_policy(exclude="qkv", keep_qkv=False):
       projection — frees E per layer (1.25 GB) and the replay is one cheap dot
       whose input (attn_out) is itself saved.
 
-    The classification is purely shape-based, so it is only sound when each
-    width signature is UNIQUE among the model's dots: a square MoE expert dot
-    [F, F] or a head whose vocab happens to equal 3E would silently fall into
-    an exclusion class and lose its save. Each returned policy instance tracks
-    the distinct (contracted, out) rhs shapes it excludes across its trace and
-    raises instead of misclassifying: a second distinct shape in the same
-    exclusion class, or a square width that disagrees with the qkv-implied
-    embed width, is an error directing the caller to an explicit policy."""
+    Dot classification is tag-first: attention call sites announce their dots by
+    emitting ``checkpoint_name(x, "ds_dot:qkv")`` / ``"ds_dot:proj"`` on the
+    dot's INPUT immediately before the dot (gpt2 ``_attention`` and the fused
+    transformer kernel do). The jaxpr records equations in trace order, so the
+    announcement reaches this policy before its dot_general; once ANY ``ds_dot``
+    tag is seen in a trace the width heuristic below is OFF and only announced
+    dots can be excluded — a square MoE expert or a 3E-wide vocab head in a
+    tagged model can no longer be misclassified.
+
+    UNTAGGED FALLBACK: models that never announce keep the pure shape-based
+    classification, which is only sound when each width signature is UNIQUE
+    among the model's dots. Each returned policy instance tracks the distinct
+    (contracted, out) rhs shapes it excludes across its trace and raises instead
+    of misclassifying: a second distinct shape in the same exclusion class, or a
+    square width that disagrees with the qkv-implied embed width, is an error
+    directing the caller to tags or an explicit policy."""
     names = jax.checkpoint_policies.save_only_these_names("attn_out", "attn_lse")
     # per-instance (== per checkpoint_wrapper call, i.e. per trace) signature log:
     # class name -> set of distinct (contracted, out_w) rhs shapes observed. qkv
     # signatures are recorded even when kept so the square check can cross-validate
     # against the qkv-implied embed width.
     seen = {"qkv": set(), "square": set()}
+    # tag-gating state: 'tagged' flips on the first ds_dot announcement; each
+    # announcement queues (class, input-shape) until its dot_general consumes it
+    # (shape-matched so unrelated interleaved dots pass through untouched).
+    tag_state = {"tagged": False, "pending": []}
 
     def _record(cls, shape, excluding):
         seen[cls].add(shape)
@@ -189,11 +201,33 @@ def _flash_policy(exclude="qkv", keep_qkv=False):
     def eff_policy(prim, *avals, **params):
         if names(prim, *avals, **params):
             return True
-        if getattr(prim, "name", "") != "dot_general":
+        pname = getattr(prim, "name", "")
+        if pname == "name":
+            tag = str(params.get("name", ""))
+            if tag.startswith("ds_dot:"):
+                tag_state["tagged"] = True
+                cls = tag.split(":", 2)[1]
+                shape = tuple(getattr(avals[0], "shape", ())) if avals else ()
+                tag_state["pending"].append((cls, shape))
+            return False
+        if pname != "dot_general":
             return False
         (lc, rc), (lb, rb) = params["dimension_numbers"]
         if lb or rb:
             return False
+        if tag_state["tagged"]:
+            # tag-gated mode: only announced dots may be excluded. The pending
+            # announcement is consumed by the first dot whose lhs matches the
+            # tagged input's shape (trace order puts it right after the tag).
+            pending = tag_state["pending"]
+            lhs_shape = tuple(getattr(avals[0], "shape", ())) if avals else ()
+            if pending and pending[0][1] == lhs_shape:
+                cls, _ = pending.pop(0)
+                if cls == "qkv" and not keep_qkv:
+                    return False  # fused-qkv projection: recompute, don't save
+                if cls == "proj" and exclude == "square":
+                    return False  # attn output projection: recompute from attn_out
+            return True
         if len(avals) >= 2 and getattr(avals[1], "ndim", 0) == 2 and len(rc) == 1:
             rhs = avals[1]
             contracted, out_w = rhs.shape[rc[0]], rhs.shape[1 - rc[0]]

@@ -225,6 +225,14 @@ class DeepSpeedConfig:
         if SPARSE_ATTENTION in param_dict:
             self.sparse_attention = SparseAttentionConfig(param_dict[SPARSE_ATTENTION])
 
+        sp_dict = param_dict.get(SEQUENCE_PARALLEL, {})
+        self.sequence_parallel_enabled = get_scalar_param(sp_dict, SEQUENCE_PARALLEL_ENABLED,
+                                                          SEQUENCE_PARALLEL_ENABLED_DEFAULT)
+        self.sequence_parallel_axis = get_scalar_param(sp_dict, SEQUENCE_PARALLEL_AXIS,
+                                                       SEQUENCE_PARALLEL_AXIS_DEFAULT)
+        self.sequence_parallel_schedule = get_scalar_param(sp_dict, SEQUENCE_PARALLEL_SCHEDULE,
+                                                           SEQUENCE_PARALLEL_SCHEDULE_DEFAULT)
+
         self.pipeline = get_pipeline_config(param_dict)
 
     # ---- batch triple inference (reference config.py:562-608) ----

@@ -204,6 +204,17 @@ SPARSE_NUM_SLIDING_WINDOW_BLOCKS = "num_sliding_window_blocks"
 SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT = 3
 
 #############################################
+# Sequence parallelism (ring attention; TPU-native extension, no reference key)
+#############################################
+SEQUENCE_PARALLEL = "sequence_parallel"
+SEQUENCE_PARALLEL_ENABLED = "enabled"
+SEQUENCE_PARALLEL_ENABLED_DEFAULT = False
+SEQUENCE_PARALLEL_AXIS = "axis"
+SEQUENCE_PARALLEL_AXIS_DEFAULT = "data"
+SEQUENCE_PARALLEL_SCHEDULE = "schedule"
+SEQUENCE_PARALLEL_SCHEDULE_DEFAULT = "zigzag"
+
+#############################################
 # Pipeline (engine-level block; PipelineModule takes most knobs in-code)
 #############################################
 PIPELINE = "pipeline"
@@ -261,6 +272,7 @@ TOP_LEVEL_CONFIG_KEYS = frozenset({
     MEMORY_BREAKDOWN,
     TENSORBOARD,
     SPARSE_ATTENTION,
+    SEQUENCE_PARALLEL,
     PIPELINE,
     ZERO_OPTIMIZATION,
     ZERO_ALLOW_UNTESTED_OPTIMIZER,

@@ -198,6 +198,19 @@ class DeepSpeedEngine:
         assert model_parameters is not None, ("model_parameters (the initialized parameter pytree) "
                                               "is required in the functional API")
 
+        # ---- sequence parallelism (ring attention over the mesh axis) ----
+        # The ``sequence_parallel`` config block swaps the loss fn for the model's
+        # sequence-parallel build: tokens/labels stay in natural order at the API
+        # boundary, the model shards them over the axis (zigzag layout by default)
+        # and runs ring attention internally.
+        if self.config.sequence_parallel_enabled:
+            sp_build = getattr(model, "sequence_parallel_loss_fn", None)
+            if sp_build is None:
+                raise TypeError("sequence_parallel requires a model exposing "
+                                "sequence_parallel_loss_fn(mesh, axis, schedule=...)")
+            self.model_fn = sp_build(self.mesh, self.config.sequence_parallel_axis,
+                                     schedule=self.config.sequence_parallel_schedule)
+
         # ---- precision policy ----
         if self.fp16_enabled():
             self.compute_dtype = jnp.float16

@@ -1,0 +1,90 @@
+"""Minimal repro for the two relay-worker-killing decode programs (PERF.md).
+
+Round-5 decode measurements found two configurations that reproducibly take
+down this rig's axon relay TPU worker (the process serving the TPU over the
+relay tunnel) — NOT an XLA OOM: compilation succeeds, the crash lands during
+execution of the decode loop:
+
+  1p5b_decode : GPT-2 1.5B, batch 8, greedy 128-token generation over a
+                1024-token prompt (the PERF.md decode table's missing row);
+  420m_beam   : GPT-2 420M, batch 8, beam-4 128-token generation (runs fine
+                with the pre-round-5 cache path at 38.0 tok/s; crashes with
+                the in-place dynamic_update_slice cache).
+
+Each case is the SMALLEST program observed to kill the worker: one model, one
+prompt, one generate/beam_search call, no timing scaffolding. Run ONE case per
+process — a dead relay worker takes every later test in the process down with
+it, which is why tier-1 must never collect this file (enforced by
+tests/unit/test_tier1_collection.py) and why the pytest entry points carry the
+``slow`` marker for explicit runs.
+
+    python tests/perf/decode_crash_repro.py 1p5b_decode
+    python tests/perf/decode_crash_repro.py 420m_beam
+
+Exit 0 means the rig survived (fixed relay / different topology); the PERF.md
+fencing note tracks which rigs still reproduce.
+"""
+
+import sys
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.slow
+
+T0 = 1024   # prompt length (matches tests/perf/decode_perf.py)
+NEW = 128   # generated tokens
+
+
+def _require_tpu():
+    if jax.devices()[0].platform != "tpu":
+        raise SystemExit("decode_crash_repro targets the relay TPU worker; "
+                         "on CPU/GPU there is nothing to reproduce")
+
+
+def _model(n_embd, n_layer, n_head):
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    cfg = GPT2Config(vocab_size=50304, n_positions=T0 + NEW + 8, n_embd=n_embd,
+                     n_layer=n_layer, n_head=n_head, use_flash_attention=True)
+    model = GPT2Model(cfg)
+    params = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.bfloat16) if p.ndim >= 2 else p,
+        model.init(jax.random.PRNGKey(0)))
+    prompt = jnp.ones((8, T0), jnp.int32)
+    return model, params, prompt
+
+
+@pytest.mark.slow
+def test_1p5b_b8_greedy_decode_survives():
+    """GPT-2 1.5B, batch 8, 128-token greedy decode: the program whose
+    execution kills the relay worker on the round-5 rig."""
+    _require_tpu()
+    model, params, prompt = _model(n_embd=1600, n_layer=48, n_head=25)
+    out = model.generate(params, prompt, NEW)
+    assert jax.device_get(out).shape[1] == T0 + NEW
+
+
+@pytest.mark.slow
+def test_420m_b8_beam4_survives():
+    """GPT-2 420M, batch 8, beam-4 decode: crashes the relay worker with the
+    round-5 in-place KV cache (the pre-round-5 cache path survived)."""
+    _require_tpu()
+    model, params, prompt = _model(n_embd=1024, n_layer=24, n_head=16)
+    seqs, _scores = model.beam_search(params, prompt, NEW, num_beams=4)
+    assert jax.device_get(seqs).shape[-1] == T0 + NEW
+
+
+def main():
+    cases = {"1p5b_decode": test_1p5b_b8_greedy_decode_survives,
+             "420m_beam": test_420m_b8_beam4_survives}
+    if len(sys.argv) != 2 or sys.argv[1] not in cases:
+        raise SystemExit(f"usage: python {sys.argv[0]} {{{'|'.join(cases)}}}\n"
+                         "(one case per process — a killed worker poisons the rest)")
+    cases[sys.argv[1]]()
+    print(f"{sys.argv[1]}: survived — relay worker still up")
+
+
+if __name__ == "__main__":
+    main()

@@ -90,6 +90,8 @@ SWEEP = {
                     ("attr", "tensorboard_job_name", "j")),
     "sparse_attention": ({"mode": "fixed", "block": 16},
                          ("attr_pred", lambda c: c.sparse_attention.mode == "fixed")),
+    "sequence_parallel": ({"enabled": True, "schedule": "masked"},
+                          ("attr", "sequence_parallel_schedule", "masked")),
     "pipeline": ({"stages": 2}, ("attr_pred", lambda c: c.pipeline["stages"] == 2)),
     "zero_optimization": (
         ({"stage": 2}, ("attr", "zero_optimization_stage", 2)),

@@ -1,0 +1,151 @@
+"""Engine-level dynamic loss-scaler coverage (CPU tier).
+
+`test_fp16_optimizer.py` pins the FP16_Optimizer wrapper and
+`test_engine.py` the single-overflow skip; this suite drives the ENGINE's
+in-jit scaler state machine through full ramp/backoff cycles with injected
+overflows and checks the three contracts the training loop relies on:
+
+- the dynamic schedule: doubling after ``loss_scale_window`` clean steps,
+  hysteresis consumed before halving, ``min_loss_scale`` floor;
+- skipped-step accounting: ``skipped_steps`` counts exactly the steps whose
+  parameter update was suppressed, ``global_steps`` counts all of them, and the
+  scaler's own ``iter_count`` ticks every step;
+- recovery: a run that hits overflows ends up on the never-overflowed run's
+  loss trajectory once the bad batches pass (a skipped step must not corrupt
+  optimizer or master state).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from tests.unit.simple_model import SimpleModel, simple_config
+
+HIDDEN = 16
+
+
+def _engine(fp16_cfg, seed=0):
+    model = SimpleModel(HIDDEN)
+    params = model.init(jax.random.PRNGKey(seed))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config_params=simple_config(batch=8, fp16=fp16_cfg))
+    return engine
+
+
+def _clean_batch(i):
+    rng = np.random.default_rng(100 + i)
+    x = rng.normal(size=(8, HIDDEN)).astype(np.float32)
+    return x, np.tanh(x)
+
+
+# targets this size overflow the scaled loss/grads for any scale >= 1
+_OVERFLOW_BATCH = (np.ones((8, HIDDEN), np.float32),
+                   np.full((8, HIDDEN), 1e30, np.float32))
+
+
+def _step(engine, batch):
+    loss = engine(*batch)
+    engine.backward(loss)
+    engine.step()
+    return float(jax.device_get(loss))
+
+
+def test_dynamic_scale_ramp_and_backoff():
+    """Walk the full state machine: window-doubling, hysteresis absorbing the
+    first overflow, the halve on the second, and the post-recovery re-ramp.
+    (Window math: the scaler doubles when (iter_count - last_overflow_iter) is
+    a multiple of the window; last_overflow_iter starts at -1.)"""
+    engine = _engine({"enabled": True, "loss_scale": 0, "initial_scale_power": 4,
+                      "loss_scale_window": 3, "hysteresis": 2,
+                      "min_loss_scale": 1})
+    assert engine.loss_scale() == 16.0
+    scales = []
+    for i in range(5):  # clean ramp: doubles at iter 2 and iter 5
+        _step(engine, _clean_batch(i))
+        scales.append(engine.loss_scale())
+    assert scales == [16.0, 32.0, 32.0, 32.0, 64.0], scales
+
+    _step(engine, _OVERFLOW_BATCH)  # hysteresis 2 -> 1: scale survives
+    assert engine.loss_scale() == 64.0
+    assert engine.skipped_steps == 1
+    _step(engine, _OVERFLOW_BATCH)  # hysteresis exhausted: halve
+    assert engine.loss_scale() == 32.0
+    assert engine.skipped_steps == 2
+
+    for i in range(3):  # window counts from the overflow iter: re-ramp on the 3rd
+        _step(engine, _clean_batch(10 + i))
+    assert engine.loss_scale() == 64.0
+    assert engine.skipped_steps == 2
+
+
+def test_dynamic_scale_respects_min_scale_floor():
+    engine = _engine({"enabled": True, "loss_scale": 0, "initial_scale_power": 2,
+                      "loss_scale_window": 1000, "hysteresis": 1,
+                      "min_loss_scale": 2})
+    assert engine.loss_scale() == 4.0
+    for _ in range(4):  # halves once, then pins at the floor
+        _step(engine, _OVERFLOW_BATCH)
+    assert engine.loss_scale() == 2.0
+    assert engine.skipped_steps == 4
+
+
+def test_skipped_step_accounting_matches_engine_counters():
+    """Every step ticks global_steps and the scaler's iter_count; ONLY the
+    overflowed ones tick skipped_steps; and the number of actual parameter
+    updates observed equals global_steps - skipped_steps."""
+    engine = _engine({"enabled": True, "loss_scale": 0, "initial_scale_power": 4,
+                      "loss_scale_window": 1000, "hysteresis": 1,
+                      "min_loss_scale": 1})
+    overflow_at = {3, 7}
+    updates_seen = 0
+    for i in range(12):
+        before = jax.device_get(engine.master_params)
+        batch = _OVERFLOW_BATCH if i in overflow_at else _clean_batch(i)
+        _step(engine, batch)
+        after = jax.device_get(engine.master_params)
+        changed = any(
+            not np.array_equal(a, b)
+            for a, b in zip(jax.tree_util.tree_leaves(before),
+                            jax.tree_util.tree_leaves(after)))
+        if i in overflow_at:
+            assert not changed, f"overflowed step {i} must not move params"
+        else:
+            assert changed, f"clean step {i} must move params"
+        updates_seen += changed
+    assert engine.global_steps == 12
+    assert engine.skipped_steps == len(overflow_at)
+    assert int(jax.device_get(engine.scaler_state.iter_count)) == 12
+    assert updates_seen == engine.global_steps - engine.skipped_steps
+
+
+def test_post_recovery_trajectory_matches_clean_run():
+    """After the bad batches pass, the overflowed run must rejoin the
+    never-overflowed run's trajectory exactly: a skipped step leaves master
+    params, optimizer state, and the schedule step counter untouched, and the
+    (halved) scale cancels out of the fp32 unscale."""
+    def run(inject):
+        engine = _engine({"enabled": True, "loss_scale": 0,
+                          "initial_scale_power": 6, "loss_scale_window": 1000,
+                          "hysteresis": 1, "min_loss_scale": 1})
+        losses = []
+        for i in range(7):
+            losses.append(_step(engine, _clean_batch(i)))
+        if inject:
+            for _ in range(2):
+                _step(engine, _OVERFLOW_BATCH)
+            assert engine.skipped_steps == 2
+            assert engine.loss_scale() == 16.0  # 64 halved twice (hysteresis 1)
+        for i in range(7, 14):
+            losses.append(_step(engine, _clean_batch(i)))
+        return losses, jax.device_get(engine.master_params)
+
+    losses_ref, params_ref = run(inject=False)
+    losses_ovf, params_ovf = run(inject=True)
+    # the recovery run saw 2 extra (overflowed) steps; drop them for comparison
+    np.testing.assert_allclose(losses_ovf[:7], losses_ref[:7], rtol=1e-6)
+    np.testing.assert_allclose(losses_ovf[7:], losses_ref[7:], rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7),
+        params_ovf, params_ref)

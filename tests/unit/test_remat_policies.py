@@ -114,3 +114,101 @@ def test_all_named_policies_differentiate(name):
     g_ref = jax.grad(lambda x: block(x))(x)
     g = jax.grad(lambda x: checkpoint_wrapper(block, policy=name)(x))(x)
     assert jnp.allclose(g, g_ref)
+
+
+# ---------------------------------------------------------------- tag gating
+def _dot_decisions(pol, fn, *args):
+    """Feed EVERY eqn to the policy in trace order (the announcements are
+    stateful) and return the decisions for the dot_general eqns."""
+    out = []
+    for eqn in _eqns(fn, *args):
+        d = _decide(pol, eqn)
+        if eqn.primitive.name == "dot_general":
+            out.append(d)
+    return out
+
+
+def test_flash_policy_tag_gated_qkv_exclusion():
+    """Announced dots classify by tag, and the width heuristic is OFF in a
+    tagged trace: an untagged dot with a colliding qkv width signature keeps its
+    save and raises no collision error."""
+    w_qkv = jnp.ones((E, 3 * E))
+    w_other = jnp.ones((2 * E, 6 * E))  # same 3x signature, different width
+
+    def block(x, y):
+        t = checkpoint_name(x, "ds_dot:qkv")
+        return (t @ w_qkv).sum() + (y @ w_other).sum()
+
+    pol = _flash_policy(exclude="qkv", keep_qkv=False)
+    decisions = _dot_decisions(pol, block, jnp.ones((4, E)), jnp.ones((4, 2 * E)))
+    assert decisions == [False, True]  # tagged qkv dropped, untagged saved
+
+
+def test_flash_policy_tag_gated_proj_exclusion():
+    """'dots+attn-lean' under tags: the announced proj dot is excluded, the
+    announced qkv dot is kept, and a foreign square dot neither loses its save
+    nor trips the cross-validation error."""
+    w_qkv = jnp.ones((E, 3 * E))
+    w_proj = jnp.ones((E, E))
+    w_moe = jnp.ones((2 * E, 2 * E))
+
+    def block(x, y):
+        t = checkpoint_name(x, "ds_dot:qkv")
+        h = t @ w_qkv
+        u = checkpoint_name(x, "ds_dot:proj")
+        p = u @ w_proj
+        return h.sum() + p.sum() + (y @ w_moe).sum()
+
+    pol = _flash_policy(exclude="square", keep_qkv=True)
+    decisions = _dot_decisions(pol, block, jnp.ones((4, E)), jnp.ones((4, 2 * E)))
+    assert decisions == [True, False, True]
+
+
+def test_tagged_block_with_foreign_square_differentiates():
+    """End-to-end: the tagged-model analog of the collision scenario traces and
+    differentiates cleanly under 'dots+attn-lean' (the untagged version raises —
+    test_flash_policy_collision_raises_through_wrapper)."""
+    w_qkv = jnp.ones((E, 3 * E)) * 0.1
+    w_proj = jnp.ones((E, E)) * 0.1
+    w_moe = jnp.ones((2 * E, 2 * E)) * 0.1
+
+    def block(x):
+        t = checkpoint_name(x, "ds_dot:qkv")
+        h = jnp.tanh(t @ w_qkv)
+        u = checkpoint_name(x, "ds_dot:proj")
+        p = jnp.tanh(u @ w_proj)
+        r = jnp.ones((4, 2 * E)) @ w_moe
+        return h.sum() + p.sum() + r.sum()
+
+    x = jnp.arange(4.0 * E).reshape(4, E) * 0.01
+    g_ref = jax.grad(lambda x: block(x))(x)
+    g = jax.grad(lambda x: checkpoint_wrapper(block, policy="dots+attn-lean")(x))(x)
+    assert jnp.allclose(g, g_ref)
+
+
+def test_gpt2_attention_emits_ds_dot_tags():
+    """The gpt2 training forward announces its qkv and proj dots (the fused
+    transformer kernel does the same — its tags are asserted by its own suite's
+    policy compatibility, this pins the model-side contract)."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    cfg = GPT2Config(vocab_size=32, n_positions=16, n_embd=16, n_layer=1,
+                     n_head=2, compute_dtype=jnp.float32,
+                     use_flash_attention=False)
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    toks = jnp.zeros((1, 16), jnp.int32)
+    jaxpr = jax.make_jaxpr(lambda p: model.apply(p, toks, toks))(params)
+
+    tags = []
+
+    def walk(jxp):
+        for e in jxp.eqns:
+            if e.primitive.name == "name":
+                tags.append(e.params["name"])
+            for v in e.params.values():
+                if hasattr(v, "jaxpr"):
+                    walk(v.jaxpr)
+    walk(jaxpr.jaxpr)
+    assert "ds_dot:qkv" in tags, tags
+    assert "ds_dot:proj" in tags, tags

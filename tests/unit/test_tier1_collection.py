@@ -1,0 +1,43 @@
+"""Guard: the tier-1 gate can never pick up tests/perf measurement scripts.
+
+`scripts/tier1.sh` encodes the ROADMAP.md tier-1 command, which collects
+`tests/` with pytest's default file patterns (``test_*.py`` / ``*_test.py``).
+The perf scripts under tests/perf/ are benchmark drivers — minutes-to-hours of
+wall clock, some requiring a real TPU — and keep deliberately non-matching
+names so tier-1 never imports them. This suite pins both halves of that
+contract: the script stays in sync with ROADMAP.md, and no file under
+tests/perf/ matches a collectable pattern.
+"""
+
+import re
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def test_tier1_script_matches_roadmap_verbatim():
+    roadmap = (REPO / "ROADMAP.md").read_text()
+    m = re.search(r"\*\*Tier-1 verify:\*\* `(.+?)`\n", roadmap, re.DOTALL)
+    assert m, "ROADMAP.md lost its 'Tier-1 verify:' line"
+    script_lines = [ln for ln in (REPO / "scripts" / "tier1.sh").read_text().splitlines()
+                    if ln and not ln.startswith("#")]
+    assert script_lines == [m.group(1)], (
+        "scripts/tier1.sh drifted from the ROADMAP.md tier-1 command — "
+        "update them together, verbatim")
+
+
+def test_perf_scripts_never_collected_by_tier1():
+    perf = REPO / "tests" / "perf"
+    offenders = [p.name for p in perf.glob("*.py")
+                 if p.name.startswith("test_") or p.name.endswith("_test.py")]
+    assert not offenders, (
+        f"tests/perf/ files {offenders} match pytest's default collection "
+        f"patterns and would run (or import-crash) inside the tier-1 gate — "
+        f"rename them (the perf drivers are invoked directly, not collected)")
+
+
+def test_perf_directory_has_no_conftest_collection_override():
+    """A conftest.py in tests/perf/ could re-add collection via collect_ignore
+    tricks or python_files overrides; keep the directory plugin-free."""
+    ini_like = [p.name for p in (REPO / "tests" / "perf").glob("conftest.py")]
+    assert not ini_like, "tests/perf/conftest.py could alter tier-1 collection"
