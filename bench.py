@@ -91,10 +91,53 @@ def bench_420m():
     mfu = tps * 6.0 * n_params / 1e12 / PEAK_TFLOPS
     del engine, params
     gc.collect()
-    return {"gpt2_420m_tokens_per_sec_per_chip": round(tps, 1),
-            "gpt2_420m_mfu": round(mfu, 4),
-            "gpt2_420m_window_spread": round((dts[-1] - dts[0]) / dt, 4),
-            "gpt2_420m_selection": f"median-of-3 {steps}-step windows"}
+    out = {"gpt2_420m_tokens_per_sec_per_chip": round(tps, 1),
+           "gpt2_420m_mfu": round(mfu, 4),
+           "gpt2_420m_window_spread": round((dts[-1] - dts[0]) / dt, 4),
+           "gpt2_420m_selection": f"median-of-3 {steps}-step windows"}
+    try:
+        out["gpt2_420m_telemetry"] = _telemetry_probe_420m(
+            model, cfg, mesh, batch, tokens, labels)
+    except Exception as e:
+        out["gpt2_420m_telemetry"] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+def _telemetry_probe_420m(model, cfg, mesh, batch, tokens, labels, steps=8):
+    """Separate short instrumented run for the BENCH telemetry block. The timed
+    headline windows above run UNtelemetered on purpose: telemetry's one block per
+    step rides the loss fetch, and on the axon relay every device_get is a ~107 ms
+    fence — fine for an observability probe, poison for a 20-step timed median."""
+    import gc
+    import tempfile
+
+    import jax
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    tel_dir = tempfile.mkdtemp(prefix="ds_bench_telemetry_")
+    probe = DeepSpeedEngine(model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+                            mesh=mesh,
+                            config_params={
+                                "train_batch_size": batch,
+                                "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                                "zero_optimization": {"stage": 2},
+                                "telemetry": {"enabled": True,
+                                              "peak_tflops": PEAK_TFLOPS,
+                                              "mfu_window": steps,
+                                              "output_path": tel_dir},
+                            })
+    for _ in range(steps):
+        loss = probe(tokens, labels)
+        probe.backward(loss)
+        probe.step()
+    summary = probe.telemetry.summary()
+    summary["note"] = (f"separate {steps}-step instrumented run; per-step loss "
+                       "fetch fences the relay, so the timed windows above stay "
+                       "untelemetered")
+    probe.telemetry.close()
+    del probe
+    gc.collect()
+    return summary
 
 
 def _shard_optimizer(dp):
@@ -672,6 +715,7 @@ def main():
     fast = os.environ.get("DS_BENCH_FAST", "0") == "1"
 
     if not on_tpu:  # CPU smoke mode: engine path only, tiny shapes
+        import tempfile
         from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
         from deepspeed_tpu.runtime.engine import DeepSpeedEngine
         from deepspeed_tpu.parallel.mesh import build_mesh
@@ -679,11 +723,17 @@ def main():
         model = GPT2Model(cfg)
         params = model.init(jax.random.PRNGKey(0))
         B = max(4, jax.device_count())
+        # the smoke engine carries telemetry directly: on CPU the per-step loss
+        # fetch is cheap, and the smoke JSON doubles as a telemetry demo
         engine = DeepSpeedEngine(model=model, model_parameters=params,
                                  mesh=build_mesh(model=1, pipe=1),
                                  config_params={"train_batch_size": B,
                                                 "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
-                                                "zero_optimization": {"stage": 2}})
+                                                "zero_optimization": {"stage": 2},
+                                                "telemetry": {"enabled": True,
+                                                              "peak_tflops": PEAK_TFLOPS,
+                                                              "output_path": tempfile.mkdtemp(
+                                                                  prefix="ds_bench_telemetry_")}})
         rng = np.random.default_rng(0)
         tokens = rng.integers(0, 512, size=(B, 64)).astype(np.int32)
         t0 = time.time()
@@ -693,8 +743,11 @@ def main():
             engine.step()
         _fence(loss)
         tps = B * 64 * 3 / (time.time() - t0)
+        telemetry = engine.telemetry.summary()
+        engine.telemetry.close()
         print(json.dumps({"metric": "gpt2_tokens_per_sec_per_chip_cpu_smoke",
-                          "value": round(tps, 1), "unit": "tokens/s", "vs_baseline": 0.0}))
+                          "value": round(tps, 1), "unit": "tokens/s", "vs_baseline": 0.0,
+                          "extra": {"telemetry": telemetry}}))
         return
 
     extra = bench_420m()

@@ -518,18 +518,21 @@ class DeepSpeedCPUAdam:
         Returns the busy seconds spent — the blocking D2H wait plus the memcpy."""
         kind, data, r, rel_lo, rel_hi, win = item
         t0 = time.perf_counter()
-        dst = self._grad_buf[r.offset + rel_lo:r.offset + rel_hi]
-        if kind in ("shard", "shard_chunk"):
-            h = np.asarray(data)  # blocks until this item's async copy lands
-            np.copyto(dst, h.reshape(-1)[rel_lo - win:rel_hi - win], casting="unsafe")
-        elif kind == "region_shards":
-            np.copyto(dst, self._region_from_addressable(data, r).reshape(-1),
-                      casting="unsafe")
-        else:  # "leaf": host (or device_get-able) array, sliced region-relative
-            if host_leaves[r.leaf] is None:
-                host_leaves[r.leaf] = np.asarray(jax.device_get(data), np.float32)
-            np.copyto(dst, host_leaves[r.leaf][r.slices].reshape(-1)[rel_lo:rel_hi],
-                      casting="unsafe")
+        # TraceAnnotation (not named_scope): this is host-thread work, invisible
+        # to HLO — the annotation makes the fetch lane show up in profiler traces
+        with jax.profiler.TraceAnnotation("ds_offload_fetch"):
+            dst = self._grad_buf[r.offset + rel_lo:r.offset + rel_hi]
+            if kind in ("shard", "shard_chunk"):
+                h = np.asarray(data)  # blocks until this item's async copy lands
+                np.copyto(dst, h.reshape(-1)[rel_lo - win:rel_hi - win], casting="unsafe")
+            elif kind == "region_shards":
+                np.copyto(dst, self._region_from_addressable(data, r).reshape(-1),
+                          casting="unsafe")
+            else:  # "leaf": host (or device_get-able) array, sliced region-relative
+                if host_leaves[r.leaf] is None:
+                    host_leaves[r.leaf] = np.asarray(jax.device_get(data), np.float32)
+                np.copyto(dst, host_leaves[r.leaf][r.slices].reshape(-1)[rel_lo:rel_hi],
+                          casting="unsafe")
         return time.perf_counter() - t0
 
     def _push_region(self, r, out_host):
@@ -538,6 +541,10 @@ class DeepSpeedCPUAdam:
         global assembly on the caller thread."""
         t0 = time.perf_counter()
         pushed = 0
+        with jax.profiler.TraceAnnotation("ds_offload_push"):
+            return self._push_region_inner(r, out_host, pushed, t0)
+
+    def _push_region_inner(self, r, out_host, pushed, t0):
         if r.devices is None:
             res = ("host", out_host)
         elif (len(r.devices) > 1 and len(self._leaf_regions[r.leaf]) == 1
@@ -629,13 +636,15 @@ class DeepSpeedCPUAdam:
             if sbuf is None:
                 sbuf = staging[r] = np.empty(r.size,
                                              np.uint16 if use_fused_bf16 else out_np)
-            if use_fused_bf16:
-                self._kernel_step(lo, hi, self._grad_buf, step, r_lr, r_b1, r_b2, r_eps,
-                                  r_wd, grad_scale, out_bf16=sbuf[rel_lo:rel_hi])
-            else:
-                self._kernel_step(lo, hi, self._grad_buf, step, r_lr, r_b1, r_b2, r_eps,
-                                  r_wd, grad_scale)
-                np.copyto(sbuf[rel_lo:rel_hi], self.fp32[lo:hi], casting="unsafe")
+            with jax.profiler.TraceAnnotation("ds_offload_adam"):
+                if use_fused_bf16:
+                    self._kernel_step(lo, hi, self._grad_buf, step, r_lr, r_b1, r_b2,
+                                      r_eps, r_wd, grad_scale,
+                                      out_bf16=sbuf[rel_lo:rel_hi])
+                else:
+                    self._kernel_step(lo, hi, self._grad_buf, step, r_lr, r_b1, r_b2,
+                                      r_eps, r_wd, grad_scale)
+                    np.copyto(sbuf[rel_lo:rel_hi], self.fp32[lo:hi], casting="unsafe")
             dt = time.perf_counter() - t
             rr["adam"] += dt
             t_adam += dt

@@ -151,26 +151,29 @@ def _masked_ring(q, k, v, axis_name, causal, sm_scale, interpret, rate, seed):
     o = lse = None
     kc, vc = k, v
     for r in range(n):
-        if r > 0:
-            kc = jax.lax.ppermute(kc, axis_name, perm)
-            vc = jax.lax.ppermute(vc, axis_name, perm)
-        out_r, lse_r = flash_attention_with_lse(
-            q, kc, vc, causal=(causal and r == 0), sm_scale=sm_scale,
-            interpret=interpret, dropout_rate=rate,
-            dropout_seed=seed,
-            dropout_q_offset=rank * T_local,
-            dropout_k_offset=((rank - r) % n) * T_local)
-        if causal and r > 0:
-            src = (rank - r) % n
-            keep = src < rank  # strictly-past chunks attend; future contribute zero
-            lse_r = jnp.where(keep, lse_r, -jnp.inf)
-            out_r = jnp.where(keep, out_r, jnp.zeros((), out_r.dtype))
-        if o is None:
-            o, lse = out_r.astype(jnp.float32), lse_r
-        else:
-            # online-softmax merge of normalized partials (shared with the
-            # single-chip chunked flash path)
-            o, lse = _merge_partial(o, lse, out_r, lse_r)
+        # named_scope: rotations show up as ring_rot{r} in profiler traces
+        # (HLO metadata only — zero instructions, identical wire schedule)
+        with jax.named_scope(f"ring_rot{r}"):
+            if r > 0:
+                kc = jax.lax.ppermute(kc, axis_name, perm)
+                vc = jax.lax.ppermute(vc, axis_name, perm)
+            out_r, lse_r = flash_attention_with_lse(
+                q, kc, vc, causal=(causal and r == 0), sm_scale=sm_scale,
+                interpret=interpret, dropout_rate=rate,
+                dropout_seed=seed,
+                dropout_q_offset=rank * T_local,
+                dropout_k_offset=((rank - r) % n) * T_local)
+            if causal and r > 0:
+                src = (rank - r) % n
+                keep = src < rank  # strictly-past chunks attend; future contribute zero
+                lse_r = jnp.where(keep, lse_r, -jnp.inf)
+                out_r = jnp.where(keep, out_r, jnp.zeros((), out_r.dtype))
+            if o is None:
+                o, lse = out_r.astype(jnp.float32), lse_r
+            else:
+                # online-softmax merge of normalized partials (shared with the
+                # single-chip chunked flash path)
+                o, lse = _merge_partial(o, lse, out_r, lse_r)
     return o.astype(q.dtype)
 
 
@@ -193,15 +196,17 @@ def _zigzag_ring(q, k, v, axis_name, sm_scale, interpret, rate, seed):
     # local order is globally monotone (chunk i entirely precedes chunk 2n-1-i) and
     # q/k segment maps are identical, so the kernel's local causal pruning is exact;
     # the segment operand puts mask + dropout in global coordinates.
-    out0, lse0 = flash_attention_with_lse(
-        q, k, v, causal=True, sm_scale=sm_scale, interpret=interpret,
-        dropout_rate=rate, dropout_seed=seed,
-        q_segments=(lo_off, hi_off), k_segments=(lo_off, hi_off))
-    o_lo, lse_lo = out0[:, :, :C].astype(jnp.float32), lse0[:, :, :C]
-    o_hi, lse_hi = out0[:, :, C:].astype(jnp.float32), lse0[:, :, C:]
+    with jax.named_scope("ring_rot0"):
+        out0, lse0 = flash_attention_with_lse(
+            q, k, v, causal=True, sm_scale=sm_scale, interpret=interpret,
+            dropout_rate=rate, dropout_seed=seed,
+            q_segments=(lo_off, hi_off), k_segments=(lo_off, hi_off))
+        o_lo, lse_lo = out0[:, :, :C].astype(jnp.float32), lse0[:, :, :C]
+        o_hi, lse_hi = out0[:, :, C:].astype(jnp.float32), lse0[:, :, C:]
 
     kc, vc = k, v
     for r in range(1, n):
+      with jax.named_scope(f"ring_rot{r}"):
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
         src = (rank - r) % n

@@ -221,6 +221,32 @@ class DeepSpeedConfig:
                                                         TENSORBOARD_OUTPUT_PATH_DEFAULT)
         self.tensorboard_job_name = get_scalar_param(tb_dict, TENSORBOARD_JOB_NAME, TENSORBOARD_JOB_NAME_DEFAULT)
 
+        tel_dict = param_dict.get(TELEMETRY, {})
+        self.telemetry_enabled = get_scalar_param(tel_dict, TELEMETRY_ENABLED, TELEMETRY_ENABLED_DEFAULT)
+        self.telemetry_trace_dir = get_scalar_param(tel_dict, TELEMETRY_TRACE_DIR, TELEMETRY_TRACE_DIR_DEFAULT)
+        self.telemetry_trace_steps = get_scalar_param(tel_dict, TELEMETRY_TRACE_STEPS,
+                                                      TELEMETRY_TRACE_STEPS_DEFAULT)
+        if self.telemetry_trace_steps is not None:
+            ts = self.telemetry_trace_steps
+            if (not isinstance(ts, (list, tuple)) or len(ts) != 2
+                    or not all(isinstance(v, int) and not isinstance(v, bool) and v >= 0 for v in ts)
+                    or ts[1] <= ts[0]):
+                raise ValueError(
+                    "DeepSpeedConfig: telemetry.trace_steps must be a [start, stop] "
+                    f"pair of non-negative ints with start < stop, got {ts!r}")
+            self.telemetry_trace_steps = (int(ts[0]), int(ts[1]))
+        self.telemetry_perturbing_breakdown = get_scalar_param(tel_dict, TELEMETRY_PERTURBING_BREAKDOWN,
+                                                               TELEMETRY_PERTURBING_BREAKDOWN_DEFAULT)
+        self.telemetry_peak_tflops = float(
+            get_scalar_param(tel_dict, TELEMETRY_PEAK_TFLOPS, TELEMETRY_PEAK_TFLOPS_DEFAULT) or 0.0)
+        self.telemetry_mfu_window = get_scalar_param(tel_dict, TELEMETRY_MFU_WINDOW,
+                                                     TELEMETRY_MFU_WINDOW_DEFAULT)
+        self.telemetry_recompile_warn = get_scalar_param(tel_dict, TELEMETRY_RECOMPILE_WARN,
+                                                         TELEMETRY_RECOMPILE_WARN_DEFAULT)
+        self.telemetry_output_path = get_scalar_param(tel_dict, TELEMETRY_OUTPUT_PATH,
+                                                      TELEMETRY_OUTPUT_PATH_DEFAULT)
+        self.telemetry_job_name = get_scalar_param(tel_dict, TELEMETRY_JOB_NAME, TELEMETRY_JOB_NAME_DEFAULT)
+
         self.sparse_attention = None
         if SPARSE_ATTENTION in param_dict:
             self.sparse_attention = SparseAttentionConfig(param_dict[SPARSE_ATTENTION])

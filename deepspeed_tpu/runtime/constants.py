@@ -162,6 +162,32 @@ TENSORBOARD_JOB_NAME = "job_name"
 TENSORBOARD_JOB_NAME_DEFAULT = "DeepSpeedJobName"
 
 #############################################
+# Telemetry (TPU-native observability; no reference key — replaces the
+# reference's barrier-heavy wall_clock_breakdown path with non-perturbing
+# step metrics, profiler trace windows, a compile watchdog, and an HBM +
+# wire-bytes ledger. See docs/telemetry.md.)
+#############################################
+TELEMETRY = "telemetry"
+TELEMETRY_ENABLED = "enabled"
+TELEMETRY_ENABLED_DEFAULT = False
+TELEMETRY_TRACE_DIR = "trace_dir"
+TELEMETRY_TRACE_DIR_DEFAULT = ""
+TELEMETRY_TRACE_STEPS = "trace_steps"
+TELEMETRY_TRACE_STEPS_DEFAULT = None
+TELEMETRY_PERTURBING_BREAKDOWN = "perturbing_breakdown"
+TELEMETRY_PERTURBING_BREAKDOWN_DEFAULT = False
+TELEMETRY_PEAK_TFLOPS = "peak_tflops"
+TELEMETRY_PEAK_TFLOPS_DEFAULT = 0.0
+TELEMETRY_MFU_WINDOW = "mfu_window"
+TELEMETRY_MFU_WINDOW_DEFAULT = 20
+TELEMETRY_RECOMPILE_WARN = "recompile_warn"
+TELEMETRY_RECOMPILE_WARN_DEFAULT = 3
+TELEMETRY_OUTPUT_PATH = "output_path"
+TELEMETRY_OUTPUT_PATH_DEFAULT = ""
+TELEMETRY_JOB_NAME = "job_name"
+TELEMETRY_JOB_NAME_DEFAULT = "DeepSpeedTelemetry"
+
+#############################################
 # Gradient accumulation fp32 buffer
 #############################################
 FP32_ALLREDUCE = "fp32_allreduce"
@@ -271,6 +297,7 @@ TOP_LEVEL_CONFIG_KEYS = frozenset({
     WALL_CLOCK_BREAKDOWN,
     MEMORY_BREAKDOWN,
     TENSORBOARD,
+    TELEMETRY,
     SPARSE_ATTENTION,
     SEQUENCE_PARALLEL,
     PIPELINE,

@@ -419,6 +419,22 @@ class DeepSpeedEngine:
             self.monitor = SummaryMonitor(self.config.tensorboard_output_path or None,
                                           self.config.tensorboard_job_name)
 
+        # ---- telemetry (docs/telemetry.md): compile watchdog, trace windows,
+        # non-perturbing step metrics + resource ledger. Created BEFORE
+        # _compile_steps so the step programs compile through the watchdog.
+        self.telemetry = None
+        if self.config.telemetry_enabled:
+            from ..utils.telemetry import TelemetrySession
+            self.telemetry = TelemetrySession(
+                monitor=self.monitor,
+                peak_tflops=self.config.telemetry_peak_tflops or None,
+                trace_dir=self.config.telemetry_trace_dir or None,
+                trace_steps=self.config.telemetry_trace_steps,
+                mfu_window=self.config.telemetry_mfu_window,
+                recompile_warn=self.config.telemetry_recompile_warn,
+                output_path=self.config.telemetry_output_path or None,
+                job_name=self.config.telemetry_job_name)
+
         self._compile_steps()
 
         if self.config.dump_state:
@@ -503,7 +519,25 @@ class DeepSpeedEngine:
         return self.config.allreduce_always_fp32
 
     def wall_clock_breakdown(self):
+        # With telemetry active, the barrier-per-section breakdown timers are
+        # perturbing instrumentation (each section boundary drains the device
+        # queue, serializing the async dispatch telemetry exists to preserve):
+        # they run only behind the explicit telemetry.perturbing_breakdown flag.
+        if self.telemetry is not None:
+            if self.config.telemetry_perturbing_breakdown:
+                self.telemetry.warn_perturbing_once()
+                return True
+            if self.config.wall_clock_breakdown:
+                self.telemetry.note_breakdown_suppressed_once()
+            return False
         return self.config.wall_clock_breakdown
+
+    def _watch(self, name, jitted):
+        """Route a jitted step program through the telemetry compile watchdog
+        (identity when telemetry is off)."""
+        if self.telemetry is None or jitted is None:
+            return jitted
+        return self.telemetry.watch(name, jitted)
 
     def dynamic_loss_scale(self):
         return self._dynamic_scale
@@ -709,15 +743,18 @@ class DeepSpeedEngine:
         self._grad_dtype = grad_dtype
 
         def local_loss_and_grad(params, scale, *batch):
-            def scaled_loss_fn(p):
-                out = model_fn(p, *batch)
-                loss = out[0] if isinstance(out, (tuple, list)) else out
-                factor = scale / grad_acc_steps
-                if prescale:
-                    factor = factor / predivide
-                return loss * factor, loss
-            (_, loss), grads = jax.value_and_grad(scaled_loss_fn, has_aux=True)(params)
-            grads = jax.tree_util.tree_map(lambda g: g.astype(grad_dtype), grads)
+            # named_scope is HLO metadata only (zero instructions — asserted by
+            # tests/unit/test_telemetry.py), so the trace annotation is unconditional
+            with jax.named_scope("ds_fwd_bwd"):
+                def scaled_loss_fn(p):
+                    out = model_fn(p, *batch)
+                    loss = out[0] if isinstance(out, (tuple, list)) else out
+                    factor = scale / grad_acc_steps
+                    if prescale:
+                        factor = factor / predivide
+                    return loss * factor, loss
+                (_, loss), grads = jax.value_and_grad(scaled_loss_fn, has_aux=True)(params)
+                grads = jax.tree_util.tree_map(lambda g: g.astype(grad_dtype), grads)
             return loss, grads
 
         def shard_mapped_loss_and_grad(reduce_grads, grad_out_specs):
@@ -833,18 +870,19 @@ class DeepSpeedEngine:
         self._acc_dtype = acc_dtype
 
         def accumulate(acc, grads):
-            return jax.tree_util.tree_map(lambda a, g: a + g.astype(acc_dtype), acc, grads)
+            with jax.named_scope("ds_accumulate"):
+                return jax.tree_util.tree_map(lambda a, g: a + g.astype(acc_dtype), acc, grads)
 
-        self._jit_accumulate = jax.jit(
+        self._jit_accumulate = self._watch("accumulate", jax.jit(
             accumulate,
             in_shardings=(self._grad_shardings, self._grad_shardings),
             out_shardings=self._grad_shardings,
-            donate_argnums=(0,))
+            donate_argnums=(0,)))
         # (no donation: a compute-dtype buffer can't back the wider fp32 output)
-        self._jit_adopt_acc = (None if acc_dtype == grad_dtype else jax.jit(
+        self._jit_adopt_acc = (None if acc_dtype == grad_dtype else self._watch("adopt_acc", jax.jit(
             lambda g: jax.tree_util.tree_map(lambda x: x.astype(acc_dtype), g),
             in_shardings=(self._grad_shardings,),
-            out_shardings=self._grad_shardings))
+            out_shardings=self._grad_shardings)))
 
         def prep_grads(acc_grads, scaler_state):
             """Shared update prologue (standard + external-master paths): fp16
@@ -890,7 +928,8 @@ class DeepSpeedEngine:
             def skip_update(_):
                 return master, opt_state
 
-            new_master, new_opt = jax.lax.cond(overflow, skip_update, do_update, operand=None)
+            with jax.named_scope("ds_apply_update"):
+                new_master, new_opt = jax.lax.cond(overflow, skip_update, do_update, operand=None)
             new_scaler = ls.update(scaler_state, overflow, dynamic=dynamic, scale_window=scale_window,
                                    min_scale=min_scale, hysteresis=hysteresis)
             # params enter only to donate their buffer to the re-cast output
@@ -912,14 +951,15 @@ class DeepSpeedEngine:
                             else jnp.zeros((), jnp.bool_))
                 return global_norm(grads), overflow
 
-            self._jit_grad_stats = jax.jit(grad_stats, out_shardings=(scalar, scalar))
+            self._jit_grad_stats = self._watch(
+                "grad_stats", jax.jit(grad_stats, out_shardings=(scalar, scalar)))
             same_layout = all(
                 m.is_equivalent_to(p, l.ndim)
                 for m, p, l in zip(jax.tree_util.tree_leaves(self._master_shardings),
                                    jax.tree_util.tree_leaves(self._param_shardings),
                                    jax.tree_util.tree_leaves(self.params)))
-            self._jit_offload_push = (None if same_layout else jax.jit(
-                lambda t: t, out_shardings=self._param_shardings))
+            self._jit_offload_push = (None if same_layout else self._watch(
+                "offload_push", jax.jit(lambda t: t, out_shardings=self._param_shardings)))
             return  # no jitted optimizer update; Adam runs on the host tier
 
         scalar_shard = NamedSharding(self.mesh, P())
@@ -936,21 +976,22 @@ class DeepSpeedEngine:
                     _, new_state = opt_apply(grads, opt_state, None, step, hyper)
                     return new_state
 
-                new_opt = jax.lax.cond(overflow, lambda _: opt_state, do_update,
-                                       operand=None)
+                with jax.named_scope("ds_apply_update"):
+                    new_opt = jax.lax.cond(overflow, lambda _: opt_state, do_update,
+                                           operand=None)
                 new_scaler = ls.update(scaler_state, overflow, dynamic=dynamic,
                                        scale_window=scale_window, min_scale=min_scale,
                                        hysteresis=hysteresis)
                 return new_opt, new_scaler, overflow, norm
 
-            self._jit_apply_update = jax.jit(
+            self._jit_apply_update = self._watch("apply_update", jax.jit(
                 apply_update_ext,
                 out_shardings=(self._opt_shardings, scaler_shards,
                                scalar_shard, scalar_shard),
                 # donate the grad buffer too (the standard path donates arg 3): at
                 # 1.5B the undonated fp32 grad tree would raise peak HBM through
                 # the update by a full param-tree
-                donate_argnums=(0, 2))
+                donate_argnums=(0, 2)))
 
             # Fused single-jit train step (gas == 1): forward+backward+update in ONE
             # program, so the full gradient tree never materializes as jit outputs —
@@ -971,18 +1012,19 @@ class DeepSpeedEngine:
                         _, new_state = opt_apply(grads, opt_state, None, step, hyper)
                         return new_state
 
-                    new_opt = jax.lax.cond(overflow, lambda _: opt_state, do_update,
-                                           operand=None)
+                    with jax.named_scope("ds_apply_update"):
+                        new_opt = jax.lax.cond(overflow, lambda _: opt_state, do_update,
+                                               operand=None)
                     new_scaler = ls.update(scaler_state, overflow, dynamic=dynamic,
                                            scale_window=scale_window,
                                            min_scale=min_scale, hysteresis=hysteresis)
                     return loss, new_opt, new_scaler, overflow, norm
 
-                jit_fused = jax.jit(
+                jit_fused = self._watch("fused_step", jax.jit(
                     fused_step,
                     out_shardings=(scalar_shard, self._opt_shardings, scaler_shards,
                                    scalar_shard, scalar_shard),
-                    donate_argnums=(0,))
+                    donate_argnums=(0,)))
                 self._jit_fused = jit_fused  # exposed for flops_profile
 
                 def run_fused(batch):
@@ -998,12 +1040,12 @@ class DeepSpeedEngine:
                 self._run_fused_step = run_fused
             return
 
-        self._jit_apply_update = jax.jit(
+        self._jit_apply_update = self._watch("apply_update", jax.jit(
             apply_update,
             out_shardings=(self._master_shardings, self._opt_shardings,
                            jax.tree_util.tree_map(lambda _: scalar_shard, self.scaler_state),
                            self._param_shardings, scalar_shard, scalar_shard),
-            donate_argnums=(0, 1, 3, 4))
+            donate_argnums=(0, 1, 3, 4)))
 
         # Opt-in fused step for STANDARD engines ({"fused_step": true}, gas == 1):
         # same single-program structure as the external-master fused step — the
@@ -1024,12 +1066,12 @@ class DeepSpeedEngine:
                 return (loss,) + apply_update(master, opt_state, scaler_state,
                                               grads, params, step, hyper)
 
-            jit_fused_std = jax.jit(
+            jit_fused_std = self._watch("fused_step", jax.jit(
                 fused_step_std,
                 out_shardings=(scalar_shard, self._master_shardings,
                                self._opt_shardings, scaler_shards,
                                self._param_shardings, scalar_shard, scalar_shard),
-                donate_argnums=(0, 1, 3))
+                donate_argnums=(0, 1, 3)))
             self._jit_fused = jit_fused_std  # exposed for flops_profile
 
             def run_fused_std(batch):
@@ -1084,11 +1126,12 @@ class DeepSpeedEngine:
         call (a post-first-step reconfigure cannot retroactively change the jit)."""
         if self._jit_loss_and_grad_cached is None:
             if self._cpu_checkpointing_active():
-                self._jit_loss_and_grad_cached = jax.jit(self._loss_and_grad_fn)
+                jitted = jax.jit(self._loss_and_grad_fn)
             else:
-                self._jit_loss_and_grad_cached = jax.jit(
+                jitted = jax.jit(
                     self._loss_and_grad_fn,
                     out_shardings=(NamedSharding(self.mesh, P()), self._grad_shardings))
+            self._jit_loss_and_grad_cached = self._watch("loss_and_grad", jitted)
         return self._jit_loss_and_grad_cached
 
     @property
@@ -1104,14 +1147,18 @@ class DeepSpeedEngine:
                 return out[0] if isinstance(out, (tuple, list)) else out
 
             if self._cpu_checkpointing_active():
-                self._jit_eval_cached = jax.jit(eval_loss)
+                jitted = jax.jit(eval_loss)
             else:
-                self._jit_eval_cached = jax.jit(
-                    eval_loss, out_shardings=NamedSharding(self.mesh, P()))
+                jitted = jax.jit(eval_loss, out_shardings=NamedSharding(self.mesh, P()))
+            self._jit_eval_cached = self._watch("eval_loss", jitted)
         return self._jit_eval_cached
 
     def forward(self, *inputs):
         """Compute the loss (and cache this micro-batch's gradients for backward)."""
+        if (self.telemetry is not None and self._in_training
+                and self.micro_steps % self.gradient_accumulation_steps() == 0):
+            # first micro-step of an optimizer-step window: trace-window bookkeeping
+            self.telemetry.on_step_begin(self.global_steps)
         if self.wall_clock_breakdown():
             self.timers("forward_microstep").start()
         batch = tuple(self.shard_batch(x) if not isinstance(x, jax.Array) else x for x in inputs)
@@ -1318,6 +1365,11 @@ class DeepSpeedEngine:
                 self.monitor.add_scalar("Train/Samples/grad_norm",
                                         float(jax.device_get(self._last_grad_norm)), samples)
             self.monitor.flush()  # reference flushes per emission (engine.py:790)
+        if self.telemetry is not None:
+            # non-perturbing step boundary: rides the loss fetch (above, or here
+            # when no monitor is attached) — no extra barrier enters the step
+            self.telemetry.end_step(self.global_steps, self.train_batch_size(),
+                                    pending=self._window_losses)
         self._window_losses = []
         if self.wall_clock_breakdown():
             self.timers("step_microstep").stop()

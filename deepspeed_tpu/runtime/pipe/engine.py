@@ -353,9 +353,10 @@ class PipelineEngine(DeepSpeedEngine):
         apply_layer = self._apply_layer
 
         def stage_body(stage_params, x):
-            for j, idx in enumerate(core_idx0):
-                x = (layers[idx](x) if core_keys[j] is None
-                     else layers[idx].apply(stage_params[j], x))
+            with jax.named_scope("ds_pipe_stage"):
+                for j, idx in enumerate(core_idx0):
+                    x = (layers[idx](x) if core_keys[j] is None
+                         else layers[idx].apply(stage_params[j], x))
             return x
 
         # remat the stage body: backward recomputes the stage forward per scan step,
@@ -365,17 +366,19 @@ class PipelineEngine(DeepSpeedEngine):
         first_fn = None
         if prefix:
             def first_fn(x, *pvals):
-                env = dict(zip(pkeys, pvals))
-                for idx in prefix:
-                    x = apply_layer(idx, env, x)
+                with jax.named_scope("ds_pipe_first"):
+                    env = dict(zip(pkeys, pvals))
+                    for idx in prefix:
+                        x = apply_layer(idx, env, x)
                 return x
 
         def last_fn(y, labels_all, *rest):
-            svals, mb = rest[:-1], rest[-1]
-            env = dict(zip(skeys, svals))
-            for idx in suffix:
-                y = apply_layer(idx, env, y)
-            return loss_fn(y, labels_all[mb])
+            with jax.named_scope("ds_pipe_last"):
+                svals, mb = rest[:-1], rest[-1]
+                env = dict(zip(skeys, svals))
+                for idx in suffix:
+                    y = apply_layer(idx, env, y)
+                return loss_fn(y, labels_all[mb])
 
         def model_fn(params, x_mb, labels_mb):
             last_args = (labels_mb,) + tuple(params[k] for k in skeys)
@@ -574,6 +577,8 @@ class PipelineEngine(DeepSpeedEngine):
         if self._spmd:
             return self._train_batch_spmd(data_iter)
 
+        if self.telemetry is not None:
+            self.telemetry.on_step_begin(self.global_steps)
         mb = self.micro_batches
         S = self.num_stages
         scheds = [schedule.TrainSchedule(micro_batches=mb, stages=S, stage_id=s)
@@ -696,6 +701,10 @@ class PipelineEngine(DeepSpeedEngine):
         self.agg_train_loss = jnp.mean(jnp.stack(micro_losses)) if micro_losses else None
         self.global_steps += 1
         self.micro_steps += mb
+        if self.telemetry is not None:
+            self.telemetry.end_step(
+                self.global_steps, self.train_batch_size(),
+                pending=[self.agg_train_loss] if self.agg_train_loss is not None else None)
         if breakdown:
             self.timers("train_batch").stop()
             if self.global_steps % self.steps_per_print() == 0:

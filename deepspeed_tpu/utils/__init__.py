@@ -1,2 +1,3 @@
 from .logging import logger, log_dist, LoggerFactory
 from .timer import SynchronizedWallClockTimer, ThroughputTimer
+from .telemetry import TelemetrySession, CompileWatchdog

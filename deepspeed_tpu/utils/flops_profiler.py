@@ -40,7 +40,9 @@ def profile(fn, *args, peak_tflops: Optional[float] = None,
     """
     import jax
 
-    if isinstance(fn, jax.stages.Wrapped):  # already a jit object
+    # a jit object, or anything lowerable like it (e.g. the telemetry
+    # watchdog's _WatchedJit proxy) passes through unchanged
+    if isinstance(fn, jax.stages.Wrapped) or hasattr(fn, "lower"):
         jitted = fn
     else:
         jitted = jax.jit(fn, static_argnums=static_argnums)

@@ -23,6 +23,18 @@ _DTYPE_BYTES = {"s8": 1, "u8": 1, "pred": 1, "s16": 2, "u16": 2, "bf16": 2,
                 "f64": 8}
 
 
+# one HLO instruction per `name = type op(...)` line (ROOT-prefixed or not);
+# computation headers / ENTRY lines carry no ` = ` and don't match
+_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%?[\w.-]+ = ", re.M)
+
+
+def instruction_count(hlo_text):
+    """Total HLO instructions across all computations of the optimized program.
+    The telemetry HLO-identity guarantee is stated in these terms: default-mode
+    telemetry (named_scope metadata + AOT watchdog) must not change this count."""
+    return len(_INSTR_RE.findall(hlo_text))
+
+
 def optimized_hlo(jitted, *args):
     """Optimized (post-SPMD-partitioner) HLO text of ``jitted`` on ``args``."""
     return jitted.lower(*args).compile().as_text()

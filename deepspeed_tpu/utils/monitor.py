@@ -26,12 +26,14 @@ class SummaryMonitor:
         self.enabled = enabled and jax.process_index() == 0
         self._tb = None
         self._jsonl = None
-        if not self.enabled:
-            return
+        # log_dir is part of the public surface on EVERY rank (rank-agnostic
+        # callers read it), so it must be set before the disabled early-return.
         output_path = output_path or os.path.join(os.environ.get("DLWS_JOB_ID", "."),
                                                   "deepspeed_monitor")
         job_name = job_name or "DeepSpeedJobName"
         self.log_dir = os.path.join(output_path, job_name)
+        if not self.enabled:
+            return
         os.makedirs(self.log_dir, exist_ok=True)
         self._jsonl = open(os.path.join(self.log_dir, "scalars.jsonl"), "a", buffering=1)
         atexit.register(self.close)  # flush TB events on normal interpreter exit

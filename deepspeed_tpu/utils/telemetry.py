@@ -1,0 +1,422 @@
+"""Non-perturbing telemetry: step metrics, trace windows, compile watchdog, ledger.
+
+The reference line of this framework observed training with host-blocking
+wall-clock timers (deepspeed/utils/timer.py) plus ad-hoc TensorBoard scalars —
+every timed section drained the device queue, so turning on observability
+CHANGED the thing being observed (it serializes exactly the async dispatch the
+offload pipeline and ring schedules exploit). This module is the TPU-native
+replacement: instrumentation that rides on XLA's own machinery, in four pillars.
+
+1. **Step metrics** (``TelemetrySession.end_step``): the default path blocks
+   once per step — on a loss scalar the engine fetches anyway — and derives step
+   time, samples/sec and a rolling MFU from the compiled programs' own cost
+   analysis. Zero extra barriers; the barrier-per-section breakdown timers
+   survive only behind ``telemetry.perturbing_breakdown`` with a loud warning.
+2. **Trace windows** (``on_step_begin``): config-driven
+   ``jax.profiler.start_trace``/``stop_trace`` around a chosen step range, with
+   ``jax.named_scope`` annotations threaded through the engines so the captured
+   trace is readable. named_scope adds HLO metadata only — zero instructions
+   (asserted by tests/unit/test_telemetry.py against utils/hlo.py counts).
+3. **Compile watchdog** (``CompileWatchdog`` + ``_WatchedJit``): every engine
+   jit runs through an AOT-caching proxy keyed by the abstract input signature,
+   so each compile is observed exactly — wall time, ``memory_analysis()``
+   argument/output/temp bytes, ``cost_analysis()`` flops, and the program's
+   collective wire bytes (utils/hlo.py) — and recompile storms (the classic
+   silent TPU perf killer) warn by name.
+4. **Resource ledger**: per-step ``device.memory_stats()`` HBM in-use/peak
+   watermarks and collective wire bytes actually executed, emitted as scalars
+   through ``SummaryMonitor`` (JSONL always, TensorBoard when available).
+"""
+
+import atexit
+import os
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from .logging import logger
+
+
+def _abstract_signature(args) -> tuple:
+    """Per-leaf (shape, dtype, sharding) signature of a call's inputs — the
+    compile-cache key jit itself retraces on. Shardings are hashable jax objects;
+    host arrays carry ``None`` (they adopt the compiled program's layout)."""
+    sig = []
+    for leaf in jax.tree_util.tree_leaves(args):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            a = np.asarray(leaf)
+            shape, dtype = a.shape, a.dtype
+        sig.append((tuple(shape), dtype, getattr(leaf, "sharding", None)))
+    return tuple(sig)
+
+
+def _analyze_compiled(compiled):
+    """(flops, argument/output/temp bytes, collective wire bytes) of a compiled
+    executable, each 0 when the backend doesn't report it."""
+    flops = 0.0
+    arg_b = out_b = tmp_b = wire = 0
+    try:
+        ca = compiled.cost_analysis()
+        if not isinstance(ca, dict):  # older jax returned [dict]
+            ca = ca[0] if ca else {}
+        flops = max(float(ca.get("flops", 0.0)), 0.0)
+    except Exception:
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        arg_b = int(getattr(mem, "argument_size_in_bytes", 0) or 0)
+        out_b = int(getattr(mem, "output_size_in_bytes", 0) or 0)
+        tmp_b = int(getattr(mem, "temp_size_in_bytes", 0) or 0)
+    except Exception:
+        pass
+    try:
+        from .hlo import collective_bytes
+        wire = collective_bytes(compiled.as_text())
+    except Exception:
+        pass
+    return flops, arg_b, out_b, tmp_b, wire
+
+
+class CompileRecord:
+    """One observed compile of one program signature."""
+
+    __slots__ = ("signature", "compile_seconds", "flops", "argument_bytes",
+                 "output_bytes", "temp_bytes", "wire_bytes", "count")
+
+    def __init__(self, signature, compile_seconds, flops=0.0, argument_bytes=0,
+                 output_bytes=0, temp_bytes=0, wire_bytes=0):
+        self.signature = signature
+        self.compile_seconds = compile_seconds
+        self.flops = flops
+        self.argument_bytes = argument_bytes
+        self.output_bytes = output_bytes
+        self.temp_bytes = temp_bytes
+        self.wire_bytes = wire_bytes
+        self.count = 1
+
+
+class CompileWatchdog:
+    """Registry of every observed jit compile, keyed (program name, abstract
+    input signature). A program accumulating ``recompile_warn`` distinct
+    signatures warns once by name — recompiles are silent on TPU and can
+    dominate wall-clock without ever surfacing in step timings."""
+
+    def __init__(self, recompile_warn: int = 3):
+        self.recompile_warn = max(int(recompile_warn), 2)
+        self.records: Dict[str, Dict[tuple, CompileRecord]] = {}
+        self._storm_warned = set()
+
+    def record(self, name: str, sig, seconds: float, compiled=None) -> CompileRecord:
+        per = self.records.setdefault(name, {})
+        rec = per.get(sig)
+        if rec is not None:  # same-signature recompile (e.g. fallback jit cache miss)
+            rec.count += 1
+            rec.compile_seconds += seconds
+        else:
+            if compiled is not None:
+                flops, arg_b, out_b, tmp_b, wire = _analyze_compiled(compiled)
+            else:
+                flops = arg_b = out_b = tmp_b = wire = 0
+            rec = per[sig] = CompileRecord(sig, seconds, flops, arg_b, out_b,
+                                           tmp_b, wire)
+        n = sum(r.count for r in per.values())
+        if len(per) >= self.recompile_warn and name not in self._storm_warned:
+            self._storm_warned.add(name)
+            logger.warning(
+                f"[deepspeed_tpu] telemetry: recompile storm — program {name!r} has "
+                f"compiled {n} times ({len(per)} distinct input signatures, "
+                f"{self.compile_seconds(name):.1f} s total). Varying shapes/dtypes/"
+                f"shardings are reaching the jitted step; pad or bucket them.")
+        return rec
+
+    def compiles(self, name: Optional[str] = None) -> int:
+        per = ([self.records.get(name, {})] if name is not None
+               else self.records.values())
+        return sum(r.count for d in per for r in d.values())
+
+    def recompiles(self, name: Optional[str] = None) -> int:
+        """Compiles beyond each program's first — the waste the watchdog hunts."""
+        names = [name] if name is not None else list(self.records)
+        return sum(max(self.compiles(n) - 1, 0) for n in names)
+
+    def compile_seconds(self, name: Optional[str] = None) -> float:
+        per = ([self.records.get(name, {})] if name is not None
+               else self.records.values())
+        return sum(r.compile_seconds for d in per for r in d.values())
+
+    def peak_temp_bytes(self) -> int:
+        return max((r.temp_bytes for d in self.records.values()
+                    for r in d.values()), default=0)
+
+
+class _WatchedJit:
+    """Watchdog proxy around one jitted program: executes through per-signature
+    AOT-compiled executables so every compile is timed and analyzed exactly, and
+    every execution feeds the session's flops / wire-bytes counters. Adds no
+    device work — the executable is the same one jit would run. If AOT
+    lowering/execution is unsupported for this program (host callbacks etc.) the
+    proxy falls back permanently to the raw jit, keeping signature tracking."""
+
+    def __init__(self, name: str, jitted, session: "TelemetrySession"):
+        self._name = name
+        self._jit = jitted
+        self._session = session
+        self._cache: Dict[tuple, tuple] = {}
+        self._fallback = False
+
+    def lower(self, *args, **kwargs):  # flops_profiler / hlo audits delegate
+        return self._jit.lower(*args, **kwargs)
+
+    def _call_fallback(self, sig, *args):
+        per = self._session.watchdog.records.get(self._name, {})
+        if sig in per:
+            return self._jit(*args)
+        # first call on a new signature pays the compile inside the dispatch;
+        # the timed wall includes one execution (upper bound, noted as opaque)
+        t0 = time.perf_counter()
+        out = self._jit(*args)
+        self._session.watchdog.record(self._name, sig,
+                                      time.perf_counter() - t0)
+        return out
+
+    def __call__(self, *args):
+        sig = _abstract_signature(args)
+        if self._fallback:
+            return self._call_fallback(sig, *args)
+        entry = self._cache.get(sig)
+        if entry is None:
+            t0 = time.perf_counter()
+            try:
+                compiled = self._jit.lower(*args).compile()
+            except Exception as e:
+                self._fallback = True
+                logger.warning(f"[deepspeed_tpu] telemetry: AOT compile unavailable "
+                               f"for program {self._name!r} ({e!r}); falling back to "
+                               "the raw jit (signature tracking only)")
+                return self._call_fallback(sig, *args)
+            rec = self._session.watchdog.record(
+                self._name, sig, time.perf_counter() - t0, compiled)
+            entry = self._cache[sig] = (compiled, rec.flops, rec.wire_bytes)
+        compiled, flops, wire = entry
+        try:
+            out = compiled(*args)
+        except Exception as e:
+            self._fallback = True
+            self._cache.clear()
+            logger.warning(f"[deepspeed_tpu] telemetry: AOT execution failed for "
+                           f"program {self._name!r} ({e!r}); falling back to the "
+                           "raw jit (signature tracking only)")
+            return self._jit(*args)
+        self._session.note_execution(flops, wire)
+        return out
+
+
+def hbm_stats() -> Optional[Dict[str, int]]:
+    """device 0's memory_stats dict, or None where the backend doesn't report
+    them (CPU returns None; TPU/GPU report bytes_in_use / peak_bytes_in_use)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    return stats or None
+
+
+class TelemetrySession:
+    """One engine's telemetry: watchdog-wrapped programs, per-step scalars
+    through a SummaryMonitor, and the configured profiler trace window.
+
+    ``monitor``: an existing SummaryMonitor to emit through; when None, the
+    session opens its own at ``output_path``/``job_name`` (scalars.jsonl always;
+    TensorBoard when importable)."""
+
+    def __init__(self, monitor=None, peak_tflops: Optional[float] = None,
+                 trace_dir: Optional[str] = None, trace_steps=None,
+                 mfu_window: int = 20, recompile_warn: int = 3,
+                 output_path: Optional[str] = None, job_name: Optional[str] = None):
+        self.watchdog = CompileWatchdog(recompile_warn=recompile_warn)
+        self.peak_tflops = float(peak_tflops) if peak_tflops else None
+        self.trace_dir = trace_dir or "deepspeed_telemetry_trace"
+        self.trace_steps = tuple(trace_steps) if trace_steps is not None else None
+        self._owns_monitor = monitor is None
+        if monitor is None:
+            from .monitor import SummaryMonitor
+            monitor = SummaryMonitor(output_path or None,
+                                     job_name or "DeepSpeedTelemetry")
+        self.monitor = monitor
+
+        # step-metric state: everything is a host counter fed by the proxies;
+        # end_step differences them — no device work, no barriers
+        self.flops_executed = 0.0
+        self.wire_bytes_executed = 0
+        self.steps_recorded = 0
+        self.last_mfu = None
+        self.last_step_ms = None
+        self.last_wire_bytes = 0
+        self._window = deque(maxlen=max(int(mfu_window), 1))  # (dt, flops)
+        self._last_end = time.perf_counter()
+        self._last_flops = 0.0
+        self._last_wire = 0
+        self._last_compiles = 0
+
+        self._trace_active = False
+        self._trace_done = False
+        self._trace_failed = False
+        self._warned_perturbing = False
+        self._noted_suppressed = False
+        self._closed = False
+        atexit.register(self.close)
+
+    # ------------------------------------------------------------- watchdog
+    def watch(self, name: str, jitted):
+        """Wrap a jitted program in the compile watchdog (None passes through)."""
+        if jitted is None:
+            return None
+        return _WatchedJit(name, jitted, self)
+
+    def note_execution(self, flops: float, wire_bytes: int):
+        self.flops_executed += flops
+        self.wire_bytes_executed += wire_bytes
+
+    # ------------------------------------------------------------- trace window
+    def on_step_begin(self, global_step: int):
+        """Trace-window bookkeeping; called at the first micro-step of a window
+        with the number of COMPLETED optimizer steps (captures steps a..b-1 for
+        ``trace_steps = [a, b]``)."""
+        if self.trace_steps is None or self._trace_failed:
+            return
+        a, b = self.trace_steps
+        if self._trace_active and global_step >= b:
+            self._stop_trace()
+        if not self._trace_active and not self._trace_done and a <= global_step < b:
+            self._start_trace()
+
+    def _start_trace(self):
+        a, b = self.trace_steps
+        try:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+        except Exception as e:
+            self._trace_failed = True
+            logger.warning(f"[deepspeed_tpu] telemetry: profiler trace unavailable "
+                           f"({e!r}); trace window [{a}, {b}) skipped")
+            return
+        self._trace_active = True
+        logger.info(f"[deepspeed_tpu] telemetry: profiler trace started for steps "
+                    f"{a}..{b - 1} -> {self.trace_dir}")
+
+    def _stop_trace(self):
+        try:
+            jax.profiler.stop_trace()
+            logger.info(f"[deepspeed_tpu] telemetry: profiler trace written to "
+                        f"{self.trace_dir}")
+        except Exception as e:
+            logger.warning(f"[deepspeed_tpu] telemetry: stop_trace failed ({e!r})")
+        self._trace_active = False
+        self._trace_done = True
+
+    # ------------------------------------------------------------- step metrics
+    def end_step(self, global_step: int, samples_per_step: int, pending=None):
+        """Close one optimizer step's metrics. The ONLY blocking operation is a
+        device_get of ``pending``'s last loss scalar (already computed; the
+        engine fetches it for its monitor anyway) — the step boundary rides that
+        fetch instead of a queue-draining barrier, so the offload/ring pipelines
+        stay fully async. ``global_step`` is the count of completed steps."""
+        if pending:
+            try:
+                jax.device_get(pending[-1])
+            except Exception:
+                pass
+        now = time.perf_counter()
+        compiles = self.watchdog.compiles()
+        dt = now - self._last_end
+        flops_d = self.flops_executed - self._last_flops
+        wire_d = self.wire_bytes_executed - self._last_wire
+        had_compile = compiles != self._last_compiles
+        self._last_end = now
+        self._last_flops = self.flops_executed
+        self._last_wire = self.wire_bytes_executed
+        self._last_compiles = compiles
+
+        samples = global_step * samples_per_step
+        mon = self.monitor
+        self.last_step_ms = dt * 1000.0
+        self.last_wire_bytes = wire_d
+        self.steps_recorded += 1
+        mon.add_scalar("Telemetry/Samples/step_time_ms", dt * 1000.0, samples)
+        if dt > 0:
+            mon.add_scalar("Telemetry/Samples/samples_per_sec",
+                           samples_per_step / dt, samples)
+        mon.add_scalar("Telemetry/Samples/wire_bytes", wire_d, samples)
+        # rolling MFU over compile-free steps: a step that paid a compile would
+        # poison the window with compile wall-time that is not execution
+        if not had_compile and flops_d > 0 and dt > 0:
+            self._window.append((dt, flops_d))
+        if self.peak_tflops and self._window:
+            from .flops_profiler import mfu as _mfu
+            tot_dt = sum(d for d, _ in self._window)
+            tot_f = sum(f for _, f in self._window)
+            self.last_mfu = _mfu({"flops": tot_f}, tot_dt, self.peak_tflops)
+            mon.add_scalar("Telemetry/Samples/mfu", self.last_mfu, samples)
+        stats = hbm_stats()
+        if stats is not None:
+            mon.add_scalar("Telemetry/Samples/hbm_in_use_bytes",
+                           stats.get("bytes_in_use", 0), samples)
+            mon.add_scalar("Telemetry/Samples/hbm_peak_bytes",
+                           stats.get("peak_bytes_in_use", 0), samples)
+        mon.add_scalar("Telemetry/Samples/compile_count", compiles, samples)
+        mon.flush()
+        if self._trace_active and self.trace_steps is not None \
+                and global_step >= self.trace_steps[1]:
+            self._stop_trace()
+
+    # ------------------------------------------------------------- breakdown gate
+    def warn_perturbing_once(self):
+        if not self._warned_perturbing:
+            self._warned_perturbing = True
+            logger.warning(
+                "[deepspeed_tpu] telemetry.perturbing_breakdown=true: barrier-per-"
+                "section timers are ACTIVE — every section boundary drains the "
+                "device queue (jax.effects_barrier), serializing async dispatch and "
+                "the offload/ring pipelines. The numbers are for debugging section "
+                "attribution only; disable for performance runs.")
+
+    def note_breakdown_suppressed_once(self):
+        if not self._noted_suppressed:
+            self._noted_suppressed = True
+            logger.info(
+                "[deepspeed_tpu] telemetry: wall_clock_breakdown=true is suppressed "
+                "while telemetry is enabled (its per-section barriers would perturb "
+                "the run being measured); set telemetry.perturbing_breakdown=true "
+                "to force the breakdown timers anyway.")
+
+    # ------------------------------------------------------------- reporting
+    def summary(self) -> Dict[str, Any]:
+        """One-shot digest for benches/reports: rolling MFU, HBM watermarks,
+        wire bytes of the last step, and the watchdog's compile accounting."""
+        stats = hbm_stats() or {}
+        return {
+            "mfu": self.last_mfu,
+            "step_time_ms": self.last_step_ms,
+            "steps_recorded": self.steps_recorded,
+            "wire_bytes_per_step": self.last_wire_bytes,
+            "hbm_in_use_bytes": int(stats.get("bytes_in_use", 0)),
+            "hbm_peak_bytes": int(stats.get("peak_bytes_in_use", 0)),
+            "compile_count": self.watchdog.compiles(),
+            "recompile_count": self.watchdog.recompiles(),
+            "compile_seconds": round(self.watchdog.compile_seconds(), 3),
+            "compiled_temp_bytes_peak": self.watchdog.peak_temp_bytes(),
+        }
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        if self._trace_active:
+            self._stop_trace()
+        if self._owns_monitor and self.monitor is not None:
+            self.monitor.close()

@@ -13,13 +13,22 @@ from typing import Callable, Dict, List, Optional
 from .logging import logger
 
 
+_sync_failure_warned = False
+
+
 def _default_sync() -> None:
     # Dispatch is async in JAX; timing boundaries must drain the device queue.
+    global _sync_failure_warned
     try:
         import jax
         jax.effects_barrier()
-    except Exception:
-        pass
+    except Exception as e:
+        if not _sync_failure_warned:
+            _sync_failure_warned = True
+            logger.warning(
+                f"[deepspeed_tpu] timer sync failed ({e!r}): jax.effects_barrier "
+                "is unavailable, so timers are measuring DISPATCH, not device "
+                "compute — treat wall-clock breakdown numbers as unreliable")
 
 
 class SynchronizedWallClockTimer:

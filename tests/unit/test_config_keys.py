@@ -88,6 +88,12 @@ SWEEP = {
     "memory_breakdown": (True, ("attr", "memory_breakdown", True)),
     "tensorboard": ({"enabled": True, "job_name": "j"},
                     ("attr", "tensorboard_job_name", "j")),
+    "telemetry": (
+        ({"enabled": True, "peak_tflops": 123.0}, ("attr", "telemetry_peak_tflops", 123.0)),
+        ({"enabled": True, "trace_steps": [2, 5]},
+         ("attr", "telemetry_trace_steps", (2, 5))),
+        ({"enabled": True, "trace_steps": [5, 2]}, ("raise", ValueError)),
+    ),
     "sparse_attention": ({"mode": "fixed", "block": 16},
                          ("attr_pred", lambda c: c.sparse_attention.mode == "fixed")),
     "sequence_parallel": ({"enabled": True, "schedule": "masked"},

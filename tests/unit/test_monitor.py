@@ -33,6 +33,15 @@ def test_monitor_disabled_is_noop(tmp_path):
     assert not os.path.exists(os.path.join(str(tmp_path), "job2"))
 
 
+def test_monitor_disabled_still_exposes_log_dir(tmp_path):
+    """Regression: the disabled early-return used to skip the log_dir assignment,
+    so any rank-agnostic caller touching monitor.log_dir raised AttributeError."""
+    mon = SummaryMonitor(str(tmp_path), "job3", enabled=False)
+    assert mon.log_dir == os.path.join(str(tmp_path), "job3")
+    mon_default = SummaryMonitor(enabled=False)
+    assert isinstance(mon_default.log_dir, str) and mon_default.log_dir
+
+
 def test_engine_emits_scalars(tmp_path):
     cfg = simple_config()
     cfg["tensorboard"] = {"enabled": True, "output_path": str(tmp_path), "job_name": "run0"}

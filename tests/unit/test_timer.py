@@ -1,8 +1,13 @@
 """Timer tests (reference utils/timer.py: SynchronizedWallClockTimer l.20,
 ThroughputTimer l.100)."""
 
+import logging
 import time
 
+import pytest
+
+from deepspeed_tpu.utils import timer as timer_mod
+from deepspeed_tpu.utils import logger
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 
 
@@ -26,6 +31,38 @@ def test_wallclock_timer_log_runs(caplog):
     timers("b").start(); timers("b").stop()
     timers.log(["a", "b"])          # must not raise; resets by default
     assert timers("a").elapsed(reset=False) == 0.0
+
+
+def test_default_sync_failure_warns_once(monkeypatch):
+    """Regression: a failed effects_barrier was swallowed silently, so timers
+    quietly measured dispatch instead of device compute. The first failure must
+    warn through the package logger (once — not per timer boundary)."""
+    import jax
+
+    class _Capture(logging.Handler):
+        def __init__(self):
+            super().__init__(level=logging.DEBUG)
+            self.records = []
+
+        def emit(self, record):
+            self.records.append(record)
+
+    def boom():
+        raise RuntimeError("barrier exploded")
+
+    monkeypatch.setattr(jax, "effects_barrier", boom)
+    monkeypatch.setattr(timer_mod, "_sync_failure_warned", False)
+    h = _Capture()
+    logger.addHandler(h)
+    try:
+        timer_mod._default_sync()  # must not raise
+        timer_mod._default_sync()
+    finally:
+        logger.removeHandler(h)
+    warnings = [r for r in h.records if r.levelno >= logging.WARNING
+                and "timer sync failed" in r.getMessage()]
+    assert len(warnings) == 1, [r.getMessage() for r in h.records]
+    assert "DISPATCH" in warnings[0].getMessage()
 
 
 def test_throughput_timer_reports_samples_per_sec():
