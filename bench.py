@@ -125,6 +125,8 @@ def _telemetry_probe_420m(model, cfg, mesh, batch, tokens, labels, steps=8):
                                               "peak_tflops": PEAK_TFLOPS,
                                               "mfu_window": steps,
                                               "output_path": tel_dir},
+                                "numerics": {"enabled": True,
+                                             "audit_interval": 4},
                             })
     for _ in range(steps):
         loss = probe(tokens, labels)
@@ -134,6 +136,16 @@ def _telemetry_probe_420m(model, cfg, mesh, batch, tokens, labels, steps=8):
     summary["note"] = (f"separate {steps}-step instrumented run; per-step loss "
                        "fetch fences the relay, so the timed windows above stay "
                        "untelemetered")
+    if probe._numerics is not None:
+        num = probe._numerics.summary()
+        step_ms = summary.get("step_time_ms")
+        try:
+            total_s = float(step_ms) * steps / 1000.0
+            num["audit_overhead_pct"] = round(100.0 * num["audit_seconds"] / total_s, 3) \
+                if total_s > 0 else None
+        except (TypeError, ValueError):
+            num["audit_overhead_pct"] = None
+        summary["numerics"] = num
     probe.telemetry.close()
     del probe
     gc.collect()
@@ -733,7 +745,9 @@ def main():
                                                 "telemetry": {"enabled": True,
                                                               "peak_tflops": PEAK_TFLOPS,
                                                               "output_path": tempfile.mkdtemp(
-                                                                  prefix="ds_bench_telemetry_")}})
+                                                                  prefix="ds_bench_telemetry_")},
+                                                "numerics": {"enabled": True,
+                                                             "audit_interval": 2}})
         rng = np.random.default_rng(0)
         tokens = rng.integers(0, 512, size=(B, 64)).astype(np.int32)
         t0 = time.time()
@@ -744,10 +758,11 @@ def main():
         _fence(loss)
         tps = B * 64 * 3 / (time.time() - t0)
         telemetry = engine.telemetry.summary()
+        numerics = engine._numerics.summary() if engine._numerics is not None else None
         engine.telemetry.close()
         print(json.dumps({"metric": "gpt2_tokens_per_sec_per_chip_cpu_smoke",
                           "value": round(tps, 1), "unit": "tokens/s", "vs_baseline": 0.0,
-                          "extra": {"telemetry": telemetry}}))
+                          "extra": {"telemetry": telemetry, "numerics": numerics}}))
         return
 
     extra = bench_420m()

@@ -247,6 +247,30 @@ class DeepSpeedConfig:
                                                       TELEMETRY_OUTPUT_PATH_DEFAULT)
         self.telemetry_job_name = get_scalar_param(tel_dict, TELEMETRY_JOB_NAME, TELEMETRY_JOB_NAME_DEFAULT)
 
+        num_dict = param_dict.get(NUMERICS, {})
+        self.numerics_enabled = get_scalar_param(num_dict, NUMERICS_ENABLED, NUMERICS_ENABLED_DEFAULT)
+        self.numerics_subtree_depth = get_scalar_param(num_dict, NUMERICS_SUBTREE_DEPTH,
+                                                       NUMERICS_SUBTREE_DEPTH_DEFAULT)
+        self.numerics_audit_interval = get_scalar_param(num_dict, NUMERICS_AUDIT_INTERVAL,
+                                                        NUMERICS_AUDIT_INTERVAL_DEFAULT)
+        self.numerics_dump_dir = get_scalar_param(num_dict, NUMERICS_DUMP_DIR, NUMERICS_DUMP_DIR_DEFAULT)
+        self.numerics_ring_size = get_scalar_param(num_dict, NUMERICS_RING_SIZE, NUMERICS_RING_SIZE_DEFAULT)
+        self.numerics_consecutive_skip_trigger = get_scalar_param(
+            num_dict, NUMERICS_CONSECUTIVE_SKIP_TRIGGER, NUMERICS_CONSECUTIVE_SKIP_TRIGGER_DEFAULT)
+        self.numerics_trigger_on_nonfinite_loss = get_scalar_param(
+            num_dict, NUMERICS_TRIGGER_ON_NONFINITE_LOSS, NUMERICS_TRIGGER_ON_NONFINITE_LOSS_DEFAULT)
+        self.numerics_install_signal_handlers = get_scalar_param(
+            num_dict, NUMERICS_INSTALL_SIGNAL_HANDLERS, NUMERICS_INSTALL_SIGNAL_HANDLERS_DEFAULT)
+        for attr, minimum in ((("numerics_subtree_depth"), 1),
+                              (("numerics_audit_interval"), 0),
+                              (("numerics_ring_size"), 1),
+                              (("numerics_consecutive_skip_trigger"), 0)):
+            val = getattr(self, attr)
+            if isinstance(val, bool) or not isinstance(val, int) or val < minimum:
+                raise ValueError(
+                    f"DeepSpeedConfig: numerics.{attr[len('numerics_'):]} must be an "
+                    f"int >= {minimum}, got {val!r}")
+
         self.sparse_attention = None
         if SPARSE_ATTENTION in param_dict:
             self.sparse_attention = SparseAttentionConfig(param_dict[SPARSE_ATTENTION])

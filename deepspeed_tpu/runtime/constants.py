@@ -188,6 +188,30 @@ TELEMETRY_JOB_NAME = "job_name"
 TELEMETRY_JOB_NAME_DEFAULT = "DeepSpeedTelemetry"
 
 #############################################
+# Numerics observatory (TPU-native health layer on top of telemetry; no
+# reference key — in-graph per-subtree anomaly sentinel, loss-scale event
+# journal, cross-rank desync audit, and black-box flight recorder. See
+# docs/numerics.md.)
+#############################################
+NUMERICS = "numerics"
+NUMERICS_ENABLED = "enabled"
+NUMERICS_ENABLED_DEFAULT = False
+NUMERICS_SUBTREE_DEPTH = "subtree_depth"
+NUMERICS_SUBTREE_DEPTH_DEFAULT = 1
+NUMERICS_AUDIT_INTERVAL = "audit_interval"
+NUMERICS_AUDIT_INTERVAL_DEFAULT = 0  # 0 = desync audit off
+NUMERICS_DUMP_DIR = "dump_dir"
+NUMERICS_DUMP_DIR_DEFAULT = ""
+NUMERICS_RING_SIZE = "ring_size"
+NUMERICS_RING_SIZE_DEFAULT = 256
+NUMERICS_CONSECUTIVE_SKIP_TRIGGER = "consecutive_skip_trigger"
+NUMERICS_CONSECUTIVE_SKIP_TRIGGER_DEFAULT = 8
+NUMERICS_TRIGGER_ON_NONFINITE_LOSS = "trigger_on_nonfinite_loss"
+NUMERICS_TRIGGER_ON_NONFINITE_LOSS_DEFAULT = True
+NUMERICS_INSTALL_SIGNAL_HANDLERS = "install_signal_handlers"
+NUMERICS_INSTALL_SIGNAL_HANDLERS_DEFAULT = False
+
+#############################################
 # Gradient accumulation fp32 buffer
 #############################################
 FP32_ALLREDUCE = "fp32_allreduce"
@@ -298,6 +322,7 @@ TOP_LEVEL_CONFIG_KEYS = frozenset({
     MEMORY_BREAKDOWN,
     TENSORBOARD,
     TELEMETRY,
+    NUMERICS,
     SPARSE_ATTENTION,
     SEQUENCE_PARALLEL,
     PIPELINE,

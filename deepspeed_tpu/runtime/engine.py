@@ -21,6 +21,7 @@ but the mechanics are functional JAX:
 
 import functools
 import os
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -39,7 +40,7 @@ from .constants import (ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER, ONEBIT_
 from .dataloader import DeepSpeedDataLoader
 from .fp16 import loss_scaler as ls
 from .lr_schedules import get_scheduler
-from .utils import (clip_grads_by_global_norm, global_norm, has_inf_or_nan_tree)
+from .utils import (clip_grads_by_global_norm, detect_overflow, global_norm)
 from .zero.sharding import replicated_sharding, zero_sharding
 
 MEMORY_OPT_ALLREDUCE_SIZE = 500000000
@@ -435,6 +436,44 @@ class DeepSpeedEngine:
                 output_path=self.config.telemetry_output_path or None,
                 job_name=self.config.telemetry_job_name)
 
+        # ---- numerics observatory (docs/numerics.md): in-graph sentinel,
+        # loss-scale journal, cross-rank desync audit, flight recorder. Built
+        # BEFORE _compile_steps so the step programs fold the per-subtree
+        # bucketing into the already-jitted update (no extra host syncs).
+        self._numerics = None
+        self._sentinel_index = None
+        self._pending_sentinel = None
+        self._audit_fn_cached = None
+        if self.config.numerics_enabled:
+            from ..utils.numerics import (FlightRecorder, NumericsMonitor,
+                                          build_subtree_index)
+            self._sentinel_index = build_subtree_index(
+                master_fp32, self.config.numerics_subtree_depth)
+            journal = None
+            if self.fp16_enabled():
+                # host shadow of the device scaler — seeded from config, never
+                # from a device fetch (ls.init_state uses the same derivation)
+                init_scale = (float(self.config.loss_scale)
+                              if self.config.loss_scale and self.config.loss_scale > 0
+                              else float(2 ** self.config.initial_scale_power))
+                journal = ls.LossScaleJournal(
+                    self._dynamic_scale, init_scale,
+                    scale_window=self.config.loss_scale_window,
+                    min_scale=self.config.min_loss_scale,
+                    hysteresis=self.config.hysteresis)
+            recorder = FlightRecorder(
+                capacity=self.config.numerics_ring_size,
+                dump_dir=self.config.numerics_dump_dir or "numerics_dumps",
+                telemetry=self.telemetry,
+                host_id=jax.process_index())
+            recorder.install(self.config.numerics_install_signal_handlers)
+            self._numerics = NumericsMonitor(
+                self._sentinel_index, monitor=self.monitor,
+                telemetry=self.telemetry, journal=journal, recorder=recorder,
+                audit_interval=self.config.numerics_audit_interval,
+                consecutive_skip_trigger=self.config.numerics_consecutive_skip_trigger,
+                trigger_on_nonfinite_loss=self.config.numerics_trigger_on_nonfinite_loss)
+
         self._compile_steps()
 
         if self.config.dump_state:
@@ -723,6 +762,12 @@ class DeepSpeedEngine:
         predivide = float(self.config.gradient_predivide_factor or 1.0)
         prescale = self.config.prescale_gradients
         use_stacked = self._use_stacked_grads
+        # numerics sentinel: a STATIC trace-time switch. When None the step
+        # functions return their historical tuples with the historical ops —
+        # HLO-instruction-identical to pre-sentinel programs by construction.
+        sentinel_index = self._sentinel_index
+        if sentinel_index is not None:
+            from ..utils.numerics import bucket_sumsq
         # ZeRO stage >= 2 and ZeRO-Offload keep device grads in the compute dtype —
         # the reference's fp16 grad partitions (stage2.py:333-349, upcast only at the
         # fp32 master update) — halving the grad HBM footprint that bounds max model
@@ -886,9 +931,13 @@ class DeepSpeedEngine:
 
         def prep_grads(acc_grads, scaler_state):
             """Shared update prologue (standard + external-master paths): fp16
-            overflow check and unscale, optional predivide, global norm, clip."""
+            overflow check and unscale, optional predivide, global norm, clip.
+            With the numerics sentinel enabled, additionally returns per-subtree
+            grad sumsq + nonfinite counts (the global norm and overflow bool are
+            then DERIVED from those vectors — one pass over the tree either way,
+            and no extra collectives)."""
             scale = scaler_state.cur_scale
-            overflow = has_inf_or_nan_tree(acc_grads) if fp16 else jnp.zeros((), jnp.bool_)
+            overflow, nonfinite = detect_overflow(acc_grads, fp16, sentinel_index)
             if fp16:
                 inv = jnp.where(scale > 0, 1.0 / scale, 1.0)
 
@@ -912,15 +961,22 @@ class DeepSpeedEngine:
             if use_stacked:
                 # stacked per-worker grads: the logical gradient is the worker mean —
                 # clip/report on that, not on the sqrt(dp)-inflated stacked norm
-                norm = global_norm(jax.tree_util.tree_map(lambda g: jnp.mean(g, axis=0), grads))
+                norm_tree = jax.tree_util.tree_map(lambda g: jnp.mean(g, axis=0), grads)
             else:
-                norm = global_norm(grads)
+                norm_tree = grads
+            if sentinel_index is not None:
+                gss = bucket_sumsq(norm_tree, sentinel_index)
+                norm = jnp.sqrt(jnp.sum(gss))
+                sent = {"grad_sumsq": gss, "grad_nonfinite": nonfinite}
+            else:
+                norm = global_norm(norm_tree)
+                sent = None
             if clip > 0:
                 grads = clip_grads_by_global_norm(grads, clip, norm=norm)
-            return grads, overflow, norm
+            return grads, overflow, norm, sent
 
         def apply_update(master, opt_state, scaler_state, acc_grads, params, step, hyper):
-            grads, overflow, norm = prep_grads(acc_grads, scaler_state)
+            grads, overflow, norm, sent = prep_grads(acc_grads, scaler_state)
 
             def do_update(_):
                 return opt_apply(grads, opt_state, master, step, hyper)
@@ -935,6 +991,16 @@ class DeepSpeedEngine:
             # params enter only to donate their buffer to the re-cast output
             del params
             new_params = jax.tree_util.tree_map(lambda p: p.astype(compute_dtype), new_master)
+            if sent is not None:
+                # weight norm + update magnitude per subtree (update is exactly
+                # zero on a skipped step — the cond selected the old master)
+                sent = dict(sent,
+                            weight_sumsq=bucket_sumsq(new_master, sentinel_index),
+                            update_sumsq=bucket_sumsq(
+                                jax.tree_util.tree_map(lambda a, b: a - b,
+                                                       new_master, master),
+                                sentinel_index))
+                return new_master, new_opt, new_scaler, new_params, overflow, norm, sent
             return new_master, new_opt, new_scaler, new_params, overflow, norm
 
         if self._offload is not None:
@@ -947,12 +1013,18 @@ class DeepSpeedEngine:
             scalar = NamedSharding(self.mesh, P())
 
             def grad_stats(grads):
-                overflow = (has_inf_or_nan_tree(grads) if fp16
-                            else jnp.zeros((), jnp.bool_))
+                overflow, nonfinite = detect_overflow(grads, fp16, sentinel_index)
+                if sentinel_index is not None:
+                    gss = bucket_sumsq(grads, sentinel_index)
+                    return (jnp.sqrt(jnp.sum(gss)), overflow,
+                            {"grad_sumsq": gss, "grad_nonfinite": nonfinite})
                 return global_norm(grads), overflow
 
+            stats_out = ((scalar, scalar) if sentinel_index is None else
+                         (scalar, scalar, {"grad_sumsq": scalar,
+                                           "grad_nonfinite": scalar}))
             self._jit_grad_stats = self._watch(
-                "grad_stats", jax.jit(grad_stats, out_shardings=(scalar, scalar)))
+                "grad_stats", jax.jit(grad_stats, out_shardings=stats_out))
             same_layout = all(
                 m.is_equivalent_to(p, l.ndim)
                 for m, p, l in zip(jax.tree_util.tree_leaves(self._master_shardings),
@@ -964,13 +1036,17 @@ class DeepSpeedEngine:
 
         scalar_shard = NamedSharding(self.mesh, P())
         scaler_shards = jax.tree_util.tree_map(lambda _: scalar_shard, self.scaler_state)
+        # per-subtree sentinel vectors are tiny replicated arrays
+        grad_sent_shards = {"grad_sumsq": scalar_shard, "grad_nonfinite": scalar_shard}
+        full_sent_shards = dict(grad_sent_shards, weight_sumsq=scalar_shard,
+                                update_sumsq=scalar_shard)
         if self._external_master:
             # The optimizer owns its parameter state: the update touches only
             # opt_state (there is no engine master, and compute params are not
             # re-derived — a real ZeRO rank refreshes them from the all-gather of
             # every rank's updated shard).
             def apply_update_ext(opt_state, scaler_state, acc_grads, step, hyper):
-                grads, overflow, norm = prep_grads(acc_grads, scaler_state)
+                grads, overflow, norm, sent = prep_grads(acc_grads, scaler_state)
 
                 def do_update(_):
                     _, new_state = opt_apply(grads, opt_state, None, step, hyper)
@@ -982,12 +1058,18 @@ class DeepSpeedEngine:
                 new_scaler = ls.update(scaler_state, overflow, dynamic=dynamic,
                                        scale_window=scale_window, min_scale=min_scale,
                                        hysteresis=hysteresis)
+                if sent is not None:
+                    # no engine-held master here: the sentinel carries grad stats
+                    # only (weight/update norms need master storage)
+                    return new_opt, new_scaler, overflow, norm, sent
                 return new_opt, new_scaler, overflow, norm
 
+            ext_out = (self._opt_shardings, scaler_shards, scalar_shard, scalar_shard)
+            if sentinel_index is not None:
+                ext_out = ext_out + (grad_sent_shards,)
             self._jit_apply_update = self._watch("apply_update", jax.jit(
                 apply_update_ext,
-                out_shardings=(self._opt_shardings, scaler_shards,
-                               scalar_shard, scalar_shard),
+                out_shardings=ext_out,
                 # donate the grad buffer too (the standard path donates arg 3): at
                 # 1.5B the undonated fp32 grad tree would raise peak HBM through
                 # the update by a full param-tree
@@ -1006,7 +1088,7 @@ class DeepSpeedEngine:
                 def fused_step(opt_state, scaler_state, params, step, hyper, *batch):
                     loss, grads = local_loss_and_grad(params, scaler_state.cur_scale,
                                                       *batch)
-                    grads, overflow, norm = prep_grads(grads, scaler_state)
+                    grads, overflow, norm, sent = prep_grads(grads, scaler_state)
 
                     def do_update(_):
                         _, new_state = opt_apply(grads, opt_state, None, step, hyper)
@@ -1018,33 +1100,44 @@ class DeepSpeedEngine:
                     new_scaler = ls.update(scaler_state, overflow, dynamic=dynamic,
                                            scale_window=scale_window,
                                            min_scale=min_scale, hysteresis=hysteresis)
+                    if sent is not None:
+                        return loss, new_opt, new_scaler, overflow, norm, sent
                     return loss, new_opt, new_scaler, overflow, norm
 
+                fused_out = (scalar_shard, self._opt_shardings, scaler_shards,
+                             scalar_shard, scalar_shard)
+                if sentinel_index is not None:
+                    fused_out = fused_out + (grad_sent_shards,)
                 jit_fused = self._watch("fused_step", jax.jit(
                     fused_step,
-                    out_shardings=(scalar_shard, self._opt_shardings, scaler_shards,
-                                   scalar_shard, scalar_shard),
+                    out_shardings=fused_out,
                     donate_argnums=(0,)))
                 self._jit_fused = jit_fused  # exposed for flops_profile
 
                 def run_fused(batch):
                     step_no = jnp.asarray(self.global_steps + 1 - self.skipped_steps,
                                           jnp.int32)
-                    loss, new_opt, new_scaler, overflow, norm = jit_fused(
+                    outs = jit_fused(
                         self.opt_state, self.scaler_state, self.params, step_no,
                         self.optimizer.current_hyper(), *batch)
+                    if sentinel_index is not None:
+                        loss, new_opt, new_scaler, overflow, norm, sent = outs
+                    else:
+                        (loss, new_opt, new_scaler, overflow, norm), sent = outs, None
                     self.opt_state = new_opt
                     self.scaler_state = new_scaler
-                    return loss, (overflow, norm)
+                    return loss, (overflow, norm, sent)
 
                 self._run_fused_step = run_fused
             return
 
+        std_out = (self._master_shardings, self._opt_shardings, scaler_shards,
+                   self._param_shardings, scalar_shard, scalar_shard)
+        if sentinel_index is not None:
+            std_out = std_out + (full_sent_shards,)
         self._jit_apply_update = self._watch("apply_update", jax.jit(
             apply_update,
-            out_shardings=(self._master_shardings, self._opt_shardings,
-                           jax.tree_util.tree_map(lambda _: scalar_shard, self.scaler_state),
-                           self._param_shardings, scalar_shard, scalar_shard),
+            out_shardings=std_out,
             donate_argnums=(0, 1, 3, 4)))
 
         # Opt-in fused step for STANDARD engines ({"fused_step": true}, gas == 1):
@@ -1066,26 +1159,30 @@ class DeepSpeedEngine:
                 return (loss,) + apply_update(master, opt_state, scaler_state,
                                               grads, params, step, hyper)
 
+            fused_std_out = (scalar_shard,) + std_out
             jit_fused_std = self._watch("fused_step", jax.jit(
                 fused_step_std,
-                out_shardings=(scalar_shard, self._master_shardings,
-                               self._opt_shardings, scaler_shards,
-                               self._param_shardings, scalar_shard, scalar_shard),
+                out_shardings=fused_std_out,
                 donate_argnums=(0, 1, 3)))
             self._jit_fused = jit_fused_std  # exposed for flops_profile
 
             def run_fused_std(batch):
                 step_no = jnp.asarray(self.global_steps + 1 - self.skipped_steps,
                                       jnp.int32)
-                (loss, new_master, new_opt, new_scaler, new_params, overflow,
-                 norm) = jit_fused_std(
+                outs = jit_fused_std(
                     self.master_params, self.opt_state, self.scaler_state,
                     self.params, step_no, self.optimizer.current_hyper(), *batch)
+                if sentinel_index is not None:
+                    (loss, new_master, new_opt, new_scaler, new_params, overflow,
+                     norm, sent) = outs
+                else:
+                    (loss, new_master, new_opt, new_scaler, new_params, overflow,
+                     norm), sent = outs, None
                 self.master_params = new_master
                 self.opt_state = new_opt
                 self.scaler_state = new_scaler
                 self.params = new_params
-                return loss, (overflow, norm)
+                return loss, (overflow, norm, sent)
 
             self._run_fused_step = run_fused_std
 
@@ -1258,9 +1355,10 @@ class DeepSpeedEngine:
         if self._fused_pending is not None:
             # state was adopted at forward() (its buffers were donated); commit the
             # host-side bookkeeping here
-            overflow, norm = self._fused_pending
+            overflow, norm, sent = self._fused_pending
             self._fused_pending = None
             self._last_grad_norm = norm
+            self._pending_sentinel = sent
             self._finish_step(self.fp16_enabled() and bool(jax.device_get(overflow)))
             return
         if self._offload is not None:
@@ -1270,15 +1368,25 @@ class DeepSpeedEngine:
         hyper = self.optimizer.current_hyper()
         step = jnp.asarray(self.global_steps + 1 - self.skipped_steps, jnp.int32)
         if self._external_master:
-            (self.opt_state, self.scaler_state, overflow,
-             self._last_grad_norm) = self._jit_apply_update(
+            outs = self._jit_apply_update(
                 self.opt_state, self.scaler_state, self._grad_acc, step, hyper)
+            if self._sentinel_index is not None:
+                (self.opt_state, self.scaler_state, overflow,
+                 self._last_grad_norm, self._pending_sentinel) = outs
+            else:
+                (self.opt_state, self.scaler_state, overflow,
+                 self._last_grad_norm) = outs
             self._finish_step(self.fp16_enabled() and bool(jax.device_get(overflow)))
             return
-        (self.master_params, self.opt_state, self.scaler_state, self.params,
-         overflow, self._last_grad_norm) = self._jit_apply_update(
+        outs = self._jit_apply_update(
             self.master_params, self.opt_state, self.scaler_state, self._grad_acc,
             self.params, step, hyper)
+        if self._sentinel_index is not None:
+            (self.master_params, self.opt_state, self.scaler_state, self.params,
+             overflow, self._last_grad_norm, self._pending_sentinel) = outs
+        else:
+            (self.master_params, self.opt_state, self.scaler_state, self.params,
+             overflow, self._last_grad_norm) = outs
         self._finish_step(self.fp16_enabled() and bool(jax.device_get(overflow)))
 
     def _offload_step(self) -> bool:
@@ -1298,7 +1406,11 @@ class DeepSpeedEngine:
         Wall-clock ≈ max(D2H, host Adam) + all-gather instead of their sum.
         """
         handles = self._offload.begin_grad_fetch(self._grad_acc)
-        norm_dev, overflow_dev = self._jit_grad_stats(self._grad_acc)
+        if self._sentinel_index is not None:
+            norm_dev, overflow_dev, sent_dev = self._jit_grad_stats(self._grad_acc)
+        else:
+            norm_dev, overflow_dev = self._jit_grad_stats(self._grad_acc)
+            sent_dev = None
         scale = float(jax.device_get(self.scaler_state.cur_scale))
         overflow = bool(jax.device_get(overflow_dev)) if self.fp16_enabled() else False
 
@@ -1310,6 +1422,10 @@ class DeepSpeedEngine:
             factor *= predivide
         norm = float(jax.device_get(norm_dev)) * factor
         self._last_grad_norm = norm
+        # sumsq of the raw (still loss-scaled) grads; factor**2 converts to the
+        # post-unscale semantics the standard path's sentinel reports. Captured
+        # BEFORE the clip branch folds the clip coefficient into factor.
+        unscale_sq = factor * factor
         clip = float(self.gradient_clipping() or 0.0)
         if clip > 0 and norm > clip:
             factor *= clip / (norm + 1e-6)
@@ -1333,6 +1449,14 @@ class DeepSpeedEngine:
             self.scaler_state, jnp.asarray(overflow), dynamic=self._dynamic_scale,
             scale_window=self.config.loss_scale_window, min_scale=self.config.min_loss_scale,
             hysteresis=self.config.hysteresis)
+        if sent_dev is not None:
+            # this path already blocked on overflow/norm above, so the fetch
+            # rides the existing sync — no new barrier
+            host = jax.device_get(sent_dev)
+            self._pending_sentinel = {
+                "grad_sumsq": host["grad_sumsq"] * unscale_sq,
+                "grad_nonfinite": host["grad_nonfinite"],
+            }
         return overflow
 
     def _finish_step(self, overflowed: bool):
@@ -1365,11 +1489,17 @@ class DeepSpeedEngine:
                 self.monitor.add_scalar("Train/Samples/grad_norm",
                                         float(jax.device_get(self._last_grad_norm)), samples)
             self.monitor.flush()  # reference flushes per emission (engine.py:790)
+        numerics_host = None
         if self.telemetry is not None:
             # non-perturbing step boundary: rides the loss fetch (above, or here
             # when no monitor is attached) — no extra barrier enters the step
-            self.telemetry.end_step(self.global_steps, self.train_batch_size(),
-                                    pending=self._window_losses)
+            numerics_host = self.telemetry.end_step(
+                self.global_steps, self.train_batch_size(),
+                pending=self._window_losses, numerics=self._pending_sentinel)
+        elif self._pending_sentinel is not None:
+            numerics_host = jax.device_get(self._pending_sentinel)
+        if self._numerics is not None:
+            self._commit_numerics(numerics_host, overflowed, self._window_losses)
         self._window_losses = []
         if self.wall_clock_breakdown():
             self.timers("step_microstep").stop()
@@ -1380,6 +1510,111 @@ class DeepSpeedEngine:
         lr = self.get_lr()
         mom = self.get_mom()
         log_dist(f"step={step}, skipped={self.skipped_steps}, lr={lr}, mom={mom}", ranks=[0])
+
+    # ------------------------------------------------------------------ numerics
+    def _commit_numerics(self, numerics_host, overflowed, pending_losses):
+        """Feed one step's host-side sentinel values into the numerics monitor
+        and run the cross-rank desync audit when its interval is due. Every
+        input is already on the host (the sentinel rode the loss fetch), so
+        this adds no sync point to the step."""
+        self._pending_sentinel = None
+        loss_host = None
+        if pending_losses:
+            # these loss scalars were fetched above for the monitor/telemetry;
+            # device_get on an already-materialized array is a copy, not a sync
+            loss_host = float(jax.device_get(pending_losses[-1]))
+        gn = None
+        if self._last_grad_norm is not None:
+            gn = float(jax.device_get(self._last_grad_norm))
+        self._numerics.commit_step(self.global_steps, numerics_host,
+                                   loss=loss_host, overflowed=bool(overflowed),
+                                   grad_norm=gn)
+        if self._numerics.audit_due(self.global_steps):
+            self._desync_audit()
+
+    def _desync_audit(self):
+        """Cross-rank replica-consistency audit (docs/numerics.md §audit): one
+        small all-gather of per-subtree uint32 checksums, ONLY on audit steps."""
+        if self.dp_size <= 1:
+            return
+        if self._audit_fn_cached is None:
+            try:
+                self._audit_fn_cached = self._build_audit_fn() or False
+            except Exception as e:
+                logger.warning(f"[numerics] desync audit unavailable: {e!r}")
+                self._audit_fn_cached = False
+        if self._audit_fn_cached is False:
+            return
+        fn, names = self._audit_fn_cached
+        try:
+            t0 = time.perf_counter()
+            matrix = jax.device_get(fn(
+                self.params,
+                getattr(self, "opt_state", None) if self._offload is None else None))
+            seconds = time.perf_counter() - t0
+        except Exception as e:
+            logger.warning(f"[numerics] desync audit failed, disabling: {e!r}")
+            self._audit_fn_cached = False
+            return
+        self._numerics.commit_audit(self.global_steps, matrix, names, seconds=seconds)
+
+    def _build_audit_fn(self):
+        """Compile the audit program once: per-subtree uint32 checksums of every
+        REPLICATED param/optimizer leaf, all-gathered over the data axis so the
+        host can compare rows. shard_map with replicated in_specs is what makes
+        this observable — under plain GSPMD the compiler assumes replicated
+        arrays are bit-identical across replicas and would fold the comparison
+        away; shard_map hands the local copy of each replica to the program."""
+        from ..parallel.mesh import shard_map
+        from ..utils.numerics import leaf_checksum, subtree_name
+
+        depth = self.config.numerics_subtree_depth
+        repl = NamedSharding(self.mesh, P())
+        trees = [("params", self.params, self._param_shardings, depth)]
+        opt_state = getattr(self, "opt_state", None)
+        opt_shardings = getattr(self, "_opt_shardings", None)
+        if self._offload is None and opt_state is not None and opt_shardings is not None:
+            # optimizer pytrees nest one level deeper (e.g. {"m": {...}, "v": {...}})
+            trees.append(("opt", opt_state, opt_shardings, depth + 1))
+
+        names, name_to_id, seg, picks = [], {}, [], []
+        for ti, (tag, tree, shardings, d) in enumerate(trees):
+            leaves_p = jax.tree_util.tree_flatten_with_path(tree)[0]
+            sh_leaves = jax.tree_util.tree_leaves(shardings)
+            for li, ((path, leaf), sh) in enumerate(zip(leaves_p, sh_leaves)):
+                try:
+                    if not sh.is_equivalent_to(repl, leaf.ndim):
+                        continue  # sharded leaf: local shards legitimately differ
+                except Exception:
+                    continue
+                name = f"{tag}/{subtree_name(path, d)}"
+                if name not in name_to_id:
+                    name_to_id[name] = len(names)
+                    names.append(name)
+                seg.append(name_to_id[name])
+                picks.append((ti, li))
+        if not picks:
+            return None
+        seg_arr = jnp.asarray(seg, jnp.int32)
+        n = len(names)
+
+        def local(*leaves):
+            vals = jnp.stack([leaf_checksum(l) for l in leaves])
+            vec = jax.ops.segment_sum(vals, seg_arr, num_segments=n)
+            return jax.lax.all_gather(vec, DATA_AXIS)  # [dp, n_subtrees]
+
+        mapped = shard_map(local, mesh=self.mesh,
+                           in_specs=tuple(P() for _ in picks),
+                           out_specs=P(), check_vma=False)
+        n_trees = len(trees)
+
+        def audit(params, opt_state):
+            flat = [jax.tree_util.tree_leaves(params)]
+            if n_trees > 1:
+                flat.append(jax.tree_util.tree_leaves(opt_state))
+            return mapped(*[flat[ti][li] for ti, li in picks])
+
+        return self._watch("desync_audit", jax.jit(audit)), names
 
     # ------------------------------------------------------------------ checkpointing
     def _ckpt_export(self, tree, kind):

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from ...ops import adam as adam_opt
 from ...ops import lamb as lamb_opt
 from ...utils import logger
-from ..utils import clip_grads_by_global_norm, has_inf_or_nan_tree
+from ..utils import clip_grads_by_global_norm, detect_overflow
 from . import loss_scaler as ls
 
 
@@ -88,6 +88,14 @@ class FP16_Optimizer:
         self.master = jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32), init_params)
         self.state = self._init(self.master)
         self.scaler = ls.init_state(static_loss_scale, initial_scale_power, hysteresis)
+        # host-side shadow of the device scaler: structured loss-scale events
+        # (ramp/backoff/skip) instead of silence — see docs/numerics.md
+        init_scale = float(static_loss_scale) if static_loss_scale and static_loss_scale > 0 \
+            else float(2**initial_scale_power)
+        self.journal = ls.LossScaleJournal(self.dynamic, init_scale,
+                                           scale_window=scale_window,
+                                           min_scale=min_loss_scale,
+                                           hysteresis=hysteresis)
         self.steps = jnp.asarray(0, jnp.int32)
         self._jit_step = jax.jit(self._step_impl, donate_argnums=(0, 1, 2, 3))
         # Per-loss_fn compiled backward cache, LRU-bounded: the jitted closure holds a
@@ -142,7 +150,9 @@ class FP16_Optimizer:
     def _step_impl(self, master, state, scaler, steps, grads, hyper):
         inv = jnp.where(scaler.cur_scale > 0, 1.0 / scaler.cur_scale, 1.0)
         grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
-        overflow = has_inf_or_nan_tree(grads)
+        # shared engine-level overflow helper (inf/nan survives the unscale, so
+        # checking post-unscale matches the raw-grad check the engine performs)
+        overflow, _ = detect_overflow(grads, fp16_active=True)
         if self.clip_grad > 0:
             grads = clip_grads_by_global_norm(grads, self.clip_grad)
         new_steps = jnp.where(overflow, steps, steps + 1)
@@ -166,6 +176,7 @@ class FP16_Optimizer:
          params16, overflow) = self._jit_step(self.master, self.state, self.scaler,
                                               self.steps, grads, self.hyper)
         self.overflow = bool(jax.device_get(overflow))
+        self.journal.record(self.journal.iter_count + 1, self.overflow)
         if self.overflow:
             logger.info(f"[fp16] OVERFLOW — skipping step, new loss scale {self.cur_scale}")
         return params16

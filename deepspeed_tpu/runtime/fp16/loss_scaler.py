@@ -7,6 +7,7 @@ so overflow handling costs no host round-trip (reference hard part §7: "dynamic
 scaling with step-skip inside jit").
 """
 
+from collections import deque
 from typing import NamedTuple
 
 import jax.numpy as jnp
@@ -65,3 +66,71 @@ def update(state: LossScaleState,
         last_overflow_iter=jnp.where(overflow, it, state.last_overflow_iter),
         iter_count=it,
     )
+
+
+class LossScaleJournal:
+    """Host-side shadow of :func:`update` that turns the silent device-state
+    transitions into structured events (ramp, backoff, skip, min-scale floor,
+    consecutive-skip streaks — the numerics-observatory journal).
+
+    The device scaler state never leaves the accelerator on the hot path, so
+    the journal REPLAYS the exact update semantics on Python floats from the
+    one host fact the engine already fetches per step: the overflow bool. At
+    every step ``journal.cur_scale == float(engine.loss_scale())`` — tested in
+    tests/unit/test_numerics.py.
+    """
+
+    def __init__(self, dynamic, init_scale, scale_window=1000, scale_factor=2.0,
+                 min_scale=1.0, hysteresis=2, emit=None, max_events=1024):
+        self.dynamic = bool(dynamic)
+        self.cur_scale = float(init_scale)
+        self.scale_window = int(scale_window)
+        self.scale_factor = float(scale_factor)
+        self.min_scale = float(min_scale)
+        self.hysteresis = int(hysteresis)
+        self.cur_hysteresis = int(hysteresis)
+        self.last_overflow_iter = -1
+        self.iter_count = 0
+        self.skip_streak = 0
+        self.emit = emit  # callable(event_dict, step) — set by NumericsMonitor
+        self.events = deque(maxlen=int(max_events))
+
+    def _event(self, step, kind, **fields):
+        ev = dict(fields, kind=kind, step=step, scale=self.cur_scale)
+        self.events.append(ev)
+        if self.emit is not None:
+            self.emit(ev, step)
+        return ev
+
+    def record(self, step, overflowed):
+        """Advance the shadow state one step; returns the events it emitted."""
+        emitted = []
+        it = self.iter_count + 1
+        if overflowed:
+            self.skip_streak += 1
+            if self.dynamic:
+                if self.cur_hysteresis <= 1:
+                    prev = self.cur_scale
+                    self.cur_scale = max(self.cur_scale / self.scale_factor,
+                                         self.min_scale)
+                    emitted.append(self._event(step, "backoff", previous=prev))
+                    if self.cur_scale <= self.min_scale:
+                        emitted.append(self._event(step, "min_scale_floor"))
+                else:
+                    self.cur_hysteresis -= 1
+                    emitted.append(self._event(
+                        step, "hysteresis", remaining=self.cur_hysteresis))
+            self.last_overflow_iter = it
+            emitted.append(self._event(step, "skip", streak=self.skip_streak))
+        else:
+            if self.skip_streak:
+                emitted.append(self._event(step, "recovered",
+                                           streak=self.skip_streak))
+            self.skip_streak = 0
+            if self.dynamic and (it - self.last_overflow_iter) % self.scale_window == 0:
+                prev = self.cur_scale
+                self.cur_scale *= self.scale_factor
+                self.cur_hysteresis = self.hysteresis
+                emitted.append(self._event(step, "ramp", previous=prev))
+        self.iter_count = it
+        return emitted

@@ -701,10 +701,18 @@ class PipelineEngine(DeepSpeedEngine):
         self.agg_train_loss = jnp.mean(jnp.stack(micro_losses)) if micro_losses else None
         self.global_steps += 1
         self.micro_steps += mb
+        pending_losses = [self.agg_train_loss] if self.agg_train_loss is not None else None
+        numerics_host = None
         if self.telemetry is not None:
-            self.telemetry.end_step(
+            numerics_host = self.telemetry.end_step(
                 self.global_steps, self.train_batch_size(),
-                pending=[self.agg_train_loss] if self.agg_train_loss is not None else None)
+                pending=pending_losses, numerics=self._pending_sentinel)
+        elif self._pending_sentinel is not None:
+            numerics_host = jax.device_get(self._pending_sentinel)
+        if self._numerics is not None:
+            self._commit_numerics(numerics_host,
+                                  getattr(self, "_pipe_overflowed", False),
+                                  pending_losses or [])
         if breakdown:
             self.timers("train_batch").stop()
             if self.global_steps % self.steps_per_print() == 0:
@@ -747,13 +755,20 @@ class PipelineEngine(DeepSpeedEngine):
                 full_grads[k] = jax.tree_util.tree_map(lambda a: jnp.zeros(a.shape, jnp.float32), p)
         hyper = self.optimizer.current_hyper()
         step = jnp.asarray(self.global_steps + 1 - self.skipped_steps, jnp.int32)
-        (self.master_params, self.opt_state, self.scaler_state, self.params,
-         overflow, self._last_grad_norm) = self._jit_apply_update(
+        outs = self._jit_apply_update(
             self.master_params, self.opt_state, self.scaler_state, full_grads,
             self.params, step, hyper)
+        if self._sentinel_index is not None:
+            (self.master_params, self.opt_state, self.scaler_state, self.params,
+             overflow, self._last_grad_norm, self._pending_sentinel) = outs
+        else:
+            (self.master_params, self.opt_state, self.scaler_state, self.params,
+             overflow, self._last_grad_norm) = outs
+        self._pipe_overflowed = False
         if self.fp16_enabled() and bool(jax.device_get(overflow)):
             # jit already skipped the master update and backed off the scale; mirror
             # the host-side accounting (reference _take_model_step overflow branch)
+            self._pipe_overflowed = True
             self.skipped_steps += 1
             logger.info("[deepspeed_tpu] OVERFLOW! Skipping pipeline step.")
         elif self.lr_scheduler is not None:

@@ -91,6 +91,32 @@ def has_inf_or_nan_tree(tree) -> jnp.ndarray:
     return out
 
 
+def detect_overflow(tree, fp16_active: bool, index=None):
+    """Single overflow-detection entry point for every engine/optimizer branch.
+
+    Replaces the three historically-divergent call sites (standard prep_grads,
+    offload grad_stats, fused FP16_Optimizer). Returns ``(overflow, nonfinite)``:
+
+    - ``index is None`` — exactly the historical semantics: a single global
+      bool from :func:`has_inf_or_nan_tree` when fp16 is active, a constant
+      False otherwise; ``nonfinite`` is None. The disabled-numerics step
+      program stays HLO-identical to pre-sentinel code.
+    - ``index`` set (a ``utils.numerics.SubtreeIndex``) — additionally returns
+      the per-subtree nonfinite element counts (i32[index.n]) feeding the
+      sentinel's overflow localization; the global bool is derived from that
+      same vector so no second pass over the tree is emitted.
+    """
+    if index is None:
+        overflow = has_inf_or_nan_tree(tree) if fp16_active \
+            else jnp.zeros((), jnp.bool_)
+        return overflow, None
+    from ..utils.numerics import bucket_nonfinite
+    nonfinite = bucket_nonfinite(tree, index)
+    overflow = (jnp.sum(nonfinite) > 0) if fp16_active \
+        else jnp.zeros((), jnp.bool_)
+    return overflow, nonfinite
+
+
 # ---------------------------------------------------------------------------
 # Partitioning math (pipeline layer balancing, ZeRO sub-partitions)
 # ---------------------------------------------------------------------------

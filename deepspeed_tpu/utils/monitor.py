@@ -26,6 +26,7 @@ class SummaryMonitor:
         self.enabled = enabled and jax.process_index() == 0
         self._tb = None
         self._jsonl = None
+        self._events = None
         # log_dir is part of the public surface on EVERY rank (rank-agnostic
         # callers read it), so it must be set before the disabled early-return.
         output_path = output_path or os.path.join(os.environ.get("DLWS_JOB_ID", "."),
@@ -53,6 +54,18 @@ class SummaryMonitor:
         if self._tb is not None:
             self._tb.add_scalar(name, value, global_step)
 
+    def event(self, name: str, payload, step: Optional[int] = None):
+        """Structured (non-scalar) event sink — loss-scale journal entries,
+        desync-audit results, etc. Written to events.jsonl beside scalars.jsonl;
+        the file is created lazily so scalar-only jobs keep a clean log dir."""
+        if not self.enabled:
+            return
+        if self._events is None:
+            self._events = open(os.path.join(self.log_dir, "events.jsonl"), "a", buffering=1)
+        self._events.write(json.dumps(
+            {"event": name, "step": None if step is None else int(step),
+             "payload": payload, "time": time.time()}, default=repr) + "\n")
+
     def flush(self):
         if self._tb is not None:
             self._tb.flush()
@@ -62,6 +75,9 @@ class SummaryMonitor:
         if self._jsonl is not None:
             self._jsonl.close()
             self._jsonl = None
+        if self._events is not None:
+            self._events.close()
+            self._events = None
         if self._tb is not None:
             self._tb.close()
             self._tb = None

@@ -1,0 +1,529 @@
+"""Numerics observatory: in-graph anomaly sentinel + training flight recorder.
+
+Four cooperating pieces (docs/numerics.md):
+
+1. **Sentinel bucketing** — pure in-graph helpers (`bucket_sumsq`,
+   `bucket_nonfinite`) that fold per-leaf statistics into per-parameter-subtree
+   vectors with `jax.ops.segment_sum`. The engine computes these inside the
+   already-jitted step; they leave the device through the telemetry session's
+   existing loss fetch, never through an extra host sync.
+
+2. **Cross-rank desync audit** — `leaf_checksum` produces a uint32 bitwise
+   checksum per leaf (exact integer addition: reduction order cannot make
+   in-sync replicas disagree); `compare_audit_rows` is the host-side
+   comparator over the `[replicas, n_subtrees]` matrix an audit-step
+   all-gather returns.
+
+3. **Flight recorder** — `FlightRecorder` keeps a bounded per-host ring of
+   step records and structured events, and dumps a JSON post-mortem bundle on
+   trigger (nonfinite loss, consecutive overflow skips, desync, signal/atexit).
+
+4. **Inspector** — `inspect_dump_main` backs `bin/ds-tpu inspect-dump`,
+   printing first-bad-step, the offending subtree, and the loss-scale
+   trajectory from a dump bundle.
+
+Invariant enforced by tests/unit/test_no_sync_guard.py: this module performs
+NO host synchronisation itself — no ``jax.device_get``, no
+``block_until_ready``, no ``np.asarray`` of device values. Everything
+host-side here operates on values the engine already fetched.
+"""
+
+import argparse
+import atexit
+import json
+import math
+import os
+import signal
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+
+from .logging import logger
+
+NUMERICS_DUMP_VERSION = 1
+
+# ------------------------------------------------------------------ subtrees
+
+
+def subtree_name(path, depth=1):
+    """Join the first `depth` components of a tree_util key path."""
+    parts = []
+    for p in path[:depth]:
+        key = getattr(p, "key", None)
+        if key is None:
+            key = getattr(p, "idx", None)
+        if key is None:
+            key = getattr(p, "name", None)
+        if key is None:
+            key = p
+        parts.append(str(key))
+    return "/".join(parts) if parts else "<root>"
+
+
+class SubtreeIndex:
+    """Static mapping of tree leaves to named parameter subtrees.
+
+    Built once at init from the parameter pytree structure; the per-leaf
+    bucket ids are closure constants inside the jitted step, so bucketing
+    compiles to a single segment_sum with no dynamic indexing.
+    """
+
+    __slots__ = ("names", "leaf_buckets")
+
+    def __init__(self, names, leaf_buckets):
+        self.names = list(names)
+        self.leaf_buckets = list(leaf_buckets)
+
+    @property
+    def n(self):
+        return len(self.names)
+
+
+def build_subtree_index(tree, depth=1):
+    leaves_with_path = jax.tree_util.tree_flatten_with_path(tree)[0]
+    names = []
+    name_to_id = {}
+    buckets = []
+    for path, _ in leaves_with_path:
+        name = subtree_name(path, depth)
+        if name not in name_to_id:
+            name_to_id[name] = len(names)
+            names.append(name)
+        buckets.append(name_to_id[name])
+    return SubtreeIndex(names, buckets)
+
+
+# ------------------------------------------------------------- in-graph math
+
+
+def bucket_sumsq(tree, index):
+    """Per-subtree sum of squares (fp32) -> f32[index.n]. In-graph only."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    vals = jnp.stack([jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves])
+    seg = jnp.asarray(index.leaf_buckets, dtype=jnp.int32)
+    return jax.ops.segment_sum(vals, seg, num_segments=index.n)
+
+
+def bucket_nonfinite(tree, index):
+    """Per-subtree nonfinite element count -> i32[index.n]. In-graph only."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    vals = jnp.stack([
+        jnp.sum((~jnp.isfinite(l.astype(jnp.float32))).astype(jnp.int32))
+        for l in leaves
+    ])
+    seg = jnp.asarray(index.leaf_buckets, dtype=jnp.int32)
+    return jax.ops.segment_sum(vals, seg, num_segments=index.n)
+
+
+def leaf_checksum(leaf):
+    """uint32 bitwise checksum of one array. Exact (integer addition), so the
+    reduction order chosen by XLA cannot make identical replicas disagree —
+    a float-sum checksum would false-positive on benign reassociation."""
+    x = leaf
+    if x.dtype == jnp.bool_:
+        bits = x.astype(jnp.uint32)
+    else:
+        itemsize = x.dtype.itemsize
+        if itemsize == 8:  # fold 64-bit leaves to 32-bit before bitcasting
+            x = x.astype(jnp.float32) if jnp.issubdtype(x.dtype, jnp.floating) \
+                else x.astype(jnp.int32)
+            itemsize = 4
+        target = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32}[itemsize]
+        bits = jax.lax.bitcast_convert_type(x, target).astype(jnp.uint32)
+    return jnp.sum(bits, dtype=jnp.uint32)
+
+
+# --------------------------------------------------------- host-side compare
+
+
+def compare_audit_rows(matrix, names):
+    """Host comparator for the audit all-gather result.
+
+    `matrix` is a [replicas, n_subtrees] array of uint32 checksums (already
+    fetched by the engine). Returns None when every replica agrees, else a
+    dict naming the FIRST diverging subtree and which replicas disagree with
+    replica 0.
+    """
+    rows = [[int(v) for v in row] for row in matrix]
+    if len(rows) <= 1:
+        return None
+    n = len(rows[0])
+    for j in range(n):
+        col = [row[j] for row in rows]
+        if any(c != col[0] for c in col):
+            return {
+                "subtree": names[j] if j < len(names) else f"<{j}>",
+                "index": j,
+                "checksums": col,
+                "diverging_replicas": [i for i, c in enumerate(col) if c != col[0]],
+            }
+    return None
+
+
+# ------------------------------------------------------------ flight recorder
+
+
+class FlightRecorder:
+    """Bounded per-host ring buffer of step records + structured events that
+    dumps a JSON post-mortem bundle when triggered."""
+
+    def __init__(self, capacity=256, dump_dir=None, telemetry=None, host_id=0):
+        self.capacity = int(capacity)
+        self.dump_dir = dump_dir
+        self.telemetry = telemetry
+        self.host_id = int(host_id)
+        self.steps = deque(maxlen=self.capacity)
+        self.events = deque(maxlen=max(self.capacity * 4, 64))
+        self.dump_count = 0
+        self.last_dump_path = None
+        self._pending_anomaly = False
+        self._installed = False
+
+    # -- recording ---------------------------------------------------------
+    def record_step(self, record):
+        self.steps.append(record)
+
+    def record_event(self, name, payload, step=None):
+        self.events.append({"event": name, "step": step, "payload": payload,
+                            "time": time.time()})
+
+    def note_anomaly(self):
+        self._pending_anomaly = True
+
+    # -- bundle assembly ---------------------------------------------------
+    def first_bad_step(self):
+        for rec in self.steps:
+            if rec.get("anomaly") or rec.get("overflow"):
+                return rec
+        return None
+
+    def bundle(self, reason, detail=None):
+        bad = self.first_bad_step()
+        compile_records = []
+        if self.telemetry is not None and getattr(self.telemetry, "watchdog", None):
+            for prog, sigs in self.telemetry.watchdog.records.items():
+                for rec in sigs.values():
+                    compile_records.append({
+                        "program": prog,
+                        "compile_seconds": rec.compile_seconds,
+                        "count": rec.count,
+                    })
+        return {
+            "version": NUMERICS_DUMP_VERSION,
+            "reason": reason,
+            "detail": detail,
+            "host": self.host_id,
+            "time": time.time(),
+            "first_bad_step": bad.get("step") if bad else None,
+            "offending_subtree": (bad.get("anomaly") or {}).get("subtree")
+                                 if bad else None,
+            "loss_scale_trajectory": [[r.get("step"), r.get("loss_scale")]
+                                      for r in self.steps
+                                      if r.get("loss_scale") is not None],
+            "steps": list(self.steps),
+            "events": list(self.events),
+            "compile_records": compile_records,
+        }
+
+    # -- triggering --------------------------------------------------------
+    def trigger(self, reason, detail=None, quiet=False):
+        if not self.dump_dir:
+            return None
+        try:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            path = os.path.join(
+                self.dump_dir,
+                f"numerics_dump_host{self.host_id}_{self.dump_count}.json")
+            with open(path, "w") as f:
+                json.dump(self.bundle(reason, detail), f, default=float)
+            self.dump_count += 1
+            self.last_dump_path = path
+            self._pending_anomaly = False
+            if not quiet:
+                logger.warning("numerics: flight recorder dumped post-mortem "
+                               f"({reason}) -> {path}")
+            return path
+        except OSError as e:  # dump failure must never kill the training job
+            if not quiet:
+                logger.warning(f"numerics: dump failed: {e}")
+            return None
+
+    def install(self, install_signal_handlers=False):
+        if self._installed:
+            return
+        self._installed = True
+        atexit.register(self._atexit_dump)
+        if install_signal_handlers:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    prev = signal.getsignal(sig)
+
+                    def _handler(signum, frame, _prev=prev):
+                        self.trigger("signal", {"signum": signum})
+                        if callable(_prev):
+                            _prev(signum, frame)
+                        else:
+                            signal.signal(signum, signal.SIG_DFL)
+                            signal.raise_signal(signum)
+
+                    signal.signal(sig, _handler)
+                except (ValueError, OSError):
+                    pass  # not the main thread / unsupported platform
+
+    def _atexit_dump(self):
+        # Only dump at exit when an anomaly was seen but never dumped — a
+        # healthy run must leave the dump dir untouched. quiet: log streams
+        # may already be closed this late in interpreter shutdown.
+        if self._pending_anomaly and self.dump_count == 0:
+            self.trigger("atexit", quiet=True)
+
+
+# --------------------------------------------------------- numerics monitor
+
+
+class NumericsMonitor:
+    """Host-side coordinator: consumes the per-step sentinel stats (already
+    fetched through the telemetry loss ride-along), feeds the journal,
+    monitor scalars/events, and the flight recorder, and decides triggers."""
+
+    def __init__(self, index, *, monitor=None, telemetry=None, journal=None,
+                 recorder=None, audit_interval=0, consecutive_skip_trigger=8,
+                 trigger_on_nonfinite_loss=True):
+        self.index = index
+        self.monitor = monitor
+        self.telemetry = telemetry
+        self.journal = journal
+        self.recorder = recorder
+        self.audit_interval = int(audit_interval)
+        self.consecutive_skip_trigger = int(consecutive_skip_trigger)
+        self.trigger_on_nonfinite_loss = bool(trigger_on_nonfinite_loss)
+        self.anomaly_count = 0
+        self.audit_runs = 0
+        self.audit_seconds = 0.0
+        self.desync = None
+        self.last_record = None
+        self._warned = 0
+        if journal is not None:
+            journal.emit = self._on_journal_event
+
+    # -- plumbing ----------------------------------------------------------
+    def _on_journal_event(self, ev, step):
+        if self.monitor is not None:
+            self.monitor.event("loss_scale", ev, step)
+        if self.recorder is not None:
+            self.recorder.record_event("loss_scale", ev, step)
+
+    def _scalar(self, tag, value, step):
+        if self.monitor is not None:
+            self.monitor.add_scalar(tag, value, step)
+
+    # -- per-step commit ---------------------------------------------------
+    def commit_step(self, step, stats, *, loss=None, overflowed=False,
+                    grad_norm=None):
+        """All inputs are HOST values (the engine fetched them alongside the
+        loss). `stats` maps sentinel keys to per-subtree vectors, or is None
+        on paths that produce no sentinel (e.g. a pure-eval step)."""
+        if self.journal is not None:
+            self.journal.record(step, overflowed)
+        loss_scale = self.journal.cur_scale if self.journal is not None else None
+
+        names = self.index.names
+        anomaly = None
+        record = {"step": step, "overflow": bool(overflowed), "loss": loss,
+                  "loss_scale": loss_scale, "grad_norm": grad_norm,
+                  "subtrees": names}
+
+        if stats is not None:
+            gss = [float(v) for v in stats.get("grad_sumsq", [])]
+            wss = [float(v) for v in stats.get("weight_sumsq", [])]
+            uss = [float(v) for v in stats.get("update_sumsq", [])]
+            nonfinite = [int(v) for v in stats.get("grad_nonfinite", [])]
+
+            record["grad_norm_per_subtree"] = [
+                math.sqrt(max(v, 0.0)) for v in gss]
+            if wss:
+                record["weight_norm_per_subtree"] = [
+                    math.sqrt(max(v, 0.0)) for v in wss]
+            if uss and wss:
+                record["update_ratio_per_subtree"] = [
+                    (math.sqrt(max(u, 0.0)) / math.sqrt(w))
+                    if w > 0.0 else 0.0
+                    for u, w in zip(uss, wss)]
+            record["nonfinite_total"] = sum(nonfinite)
+            record["nonfinite_per_subtree"] = nonfinite
+
+            for j, name in enumerate(names):
+                if j < len(gss):
+                    self._scalar(f"Numerics/grad_norm/{name}",
+                                 record["grad_norm_per_subtree"][j], step)
+                if j < len(wss):
+                    self._scalar(f"Numerics/weight_norm/{name}",
+                                 record["weight_norm_per_subtree"][j], step)
+                if "update_ratio_per_subtree" in record and j < len(uss):
+                    self._scalar(f"Numerics/update_ratio/{name}",
+                                 record["update_ratio_per_subtree"][j], step)
+
+            bad = [j for j, c in enumerate(nonfinite) if c > 0]
+            if bad:
+                anomaly = {"kind": "nonfinite_grad",
+                           "subtree": names[bad[0]],
+                           "count": nonfinite[bad[0]],
+                           "per_subtree": {names[j]: nonfinite[j] for j in bad}}
+
+        if loss is not None and not math.isfinite(loss):
+            if anomaly is None:
+                anomaly = {"kind": "nonfinite_loss", "subtree": None}
+            anomaly["nonfinite_loss"] = True
+
+        record["anomaly"] = anomaly
+        self.last_record = record
+        if self.recorder is not None:
+            self.recorder.record_step(record)
+
+        if anomaly is not None:
+            self.anomaly_count += 1
+            if self.recorder is not None:
+                self.recorder.note_anomaly()
+            if self._warned < 3:
+                self._warned += 1
+                logger.warning(
+                    f"numerics: anomaly at step {step}: {anomaly['kind']}"
+                    + (f" in subtree '{anomaly['subtree']}'"
+                       if anomaly.get("subtree") else ""))
+
+        # triggers
+        if self.recorder is not None:
+            if (self.trigger_on_nonfinite_loss and loss is not None
+                    and not math.isfinite(loss)):
+                self.recorder.trigger("nonfinite_loss", {"step": step})
+            elif (self.journal is not None and self.consecutive_skip_trigger > 0
+                  and self.journal.skip_streak == self.consecutive_skip_trigger):
+                self.recorder.trigger(
+                    "consecutive_overflow_skips",
+                    {"step": step, "streak": self.journal.skip_streak})
+        return record
+
+    # -- audit -------------------------------------------------------------
+    def audit_due(self, step):
+        return self.audit_interval > 0 and step > 0 \
+            and step % self.audit_interval == 0
+
+    def commit_audit(self, step, matrix, names, seconds=0.0):
+        """`matrix` is the host-fetched [replicas, n] checksum matrix."""
+        self.audit_runs += 1
+        self.audit_seconds += float(seconds)
+        divergence = compare_audit_rows(matrix, names)
+        payload = {"replicas": len(matrix), "subtrees": len(names),
+                   "seconds": seconds,
+                   "divergence": divergence}
+        if self.monitor is not None:
+            self.monitor.event("desync_audit", payload, step)
+        if self.recorder is not None:
+            self.recorder.record_event("desync_audit", payload, step)
+        if divergence is not None:
+            self.desync = dict(divergence, step=step)
+            logger.error(
+                f"numerics: CROSS-RANK DESYNC at step {step}: subtree "
+                f"'{divergence['subtree']}' disagrees on replicas "
+                f"{divergence['diverging_replicas']}")
+            if self.recorder is not None:
+                self.recorder.note_anomaly()
+                self.recorder.trigger("desync", dict(divergence, step=step))
+        return divergence
+
+    # -- reporting ---------------------------------------------------------
+    def summary(self):
+        return {
+            "anomaly_count": self.anomaly_count,
+            "journal_events": len(self.journal.events)
+            if self.journal is not None else 0,
+            "audit_runs": self.audit_runs,
+            "audit_seconds": self.audit_seconds,
+            "desync": self.desync is not None,
+            "dumps": self.recorder.dump_count if self.recorder is not None else 0,
+        }
+
+
+# ---------------------------------------------------------------- inspector
+
+
+def summarize_dump(bundle):
+    """Derive the headline facts from a dump bundle, recomputing anything a
+    partial/old bundle is missing."""
+    steps = bundle.get("steps", [])
+    first_bad = bundle.get("first_bad_step")
+    offending = bundle.get("offending_subtree")
+    if first_bad is None:
+        for rec in steps:
+            if rec.get("anomaly") or rec.get("overflow"):
+                first_bad = rec.get("step")
+                offending = (rec.get("anomaly") or {}).get("subtree")
+                break
+    return {
+        "reason": bundle.get("reason"),
+        "detail": bundle.get("detail"),
+        "host": bundle.get("host"),
+        "first_bad_step": first_bad,
+        "offending_subtree": offending,
+        "steps_recorded": len(steps),
+        "events_recorded": len(bundle.get("events", [])),
+        "loss_scale_trajectory": bundle.get("loss_scale_trajectory", []),
+        "desync": next((e["payload"]["divergence"]
+                        for e in bundle.get("events", [])
+                        if e.get("event") == "desync_audit"
+                        and (e.get("payload") or {}).get("divergence")), None),
+        "compile_records": bundle.get("compile_records", []),
+    }
+
+
+def inspect_dump_main(argv=None):
+    """Entry point for `ds-tpu inspect-dump <dump.json>`."""
+    parser = argparse.ArgumentParser(
+        prog="ds-tpu inspect-dump",
+        description="Summarize a numerics flight-recorder post-mortem bundle.")
+    parser.add_argument("dump", help="path to a numerics_dump_*.json bundle")
+    parser.add_argument("--json", action="store_true",
+                        help="print the machine-readable summary instead")
+    args = parser.parse_args(argv)
+
+    with open(args.dump) as f:
+        bundle = json.load(f)
+    s = summarize_dump(bundle)
+
+    if args.json:
+        print(json.dumps(s, indent=2, default=float))
+        return 0
+
+    print(f"numerics post-mortem: {args.dump}")
+    print(f"  trigger reason    : {s['reason']}")
+    if s["detail"]:
+        print(f"  trigger detail    : {s['detail']}")
+    print(f"  host              : {s['host']}")
+    print(f"  first bad step    : {s['first_bad_step']}")
+    print(f"  offending subtree : {s['offending_subtree']}")
+    print(f"  steps recorded    : {s['steps_recorded']}")
+    print(f"  events recorded   : {s['events_recorded']}")
+    if s["desync"]:
+        d = s["desync"]
+        print(f"  DESYNC            : subtree '{d.get('subtree')}' on replicas "
+              f"{d.get('diverging_replicas')}")
+    traj = s["loss_scale_trajectory"]
+    if traj:
+        print("  loss-scale trajectory (step, scale):")
+        shown = traj if len(traj) <= 16 else traj[:8] + traj[-8:]
+        for step, scale in shown:
+            print(f"    {step:>8}  {scale}")
+        if len(traj) > 16:
+            print(f"    ... ({len(traj)} points total)")
+    if s["compile_records"]:
+        print("  compile records:")
+        for rec in s["compile_records"]:
+            print(f"    {rec['program']}: {rec['count']} run(s), "
+                  f"{rec['compile_seconds']:.3f}s compile")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(inspect_dump_main())

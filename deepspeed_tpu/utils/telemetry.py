@@ -320,17 +320,26 @@ class TelemetrySession:
         self._trace_done = True
 
     # ------------------------------------------------------------- step metrics
-    def end_step(self, global_step: int, samples_per_step: int, pending=None):
+    def end_step(self, global_step: int, samples_per_step: int, pending=None,
+                 numerics=None):
         """Close one optimizer step's metrics. The ONLY blocking operation is a
         device_get of ``pending``'s last loss scalar (already computed; the
         engine fetches it for its monitor anyway) — the step boundary rides that
         fetch instead of a queue-draining barrier, so the offload/ring pipelines
-        stay fully async. ``global_step`` is the count of completed steps."""
-        if pending:
-            try:
-                jax.device_get(pending[-1])
-            except Exception:
-                pass
+        stay fully async. ``global_step`` is the count of completed steps.
+
+        ``numerics`` (optional) is the step's in-graph sentinel output (a small
+        pytree of per-subtree stat vectors); it is fetched JOINTLY with the loss
+        in the same device_get, so enabling the numerics sentinel adds no host
+        sync point. Returns the host-side numerics stats (or None)."""
+        numerics_host = None
+        try:
+            if pending:
+                _, numerics_host = jax.device_get((pending[-1], numerics))
+            elif numerics is not None:
+                numerics_host = jax.device_get(numerics)
+        except Exception:
+            pass
         now = time.perf_counter()
         compiles = self.watchdog.compiles()
         dt = now - self._last_end
@@ -373,6 +382,7 @@ class TelemetrySession:
         if self._trace_active and self.trace_steps is not None \
                 and global_step >= self.trace_steps[1]:
             self._stop_trace()
+        return numerics_host
 
     # ------------------------------------------------------------- breakdown gate
     def warn_perturbing_once(self):

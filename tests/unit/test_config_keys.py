@@ -94,6 +94,11 @@ SWEEP = {
          ("attr", "telemetry_trace_steps", (2, 5))),
         ({"enabled": True, "trace_steps": [5, 2]}, ("raise", ValueError)),
     ),
+    "numerics": (
+        ({"enabled": True, "audit_interval": 7}, ("attr", "numerics_audit_interval", 7)),
+        ({"enabled": True, "subtree_depth": 0}, ("raise", ValueError)),
+        ({"enabled": True, "ring_size": 0}, ("raise", ValueError)),
+    ),
     "sparse_attention": ({"mode": "fixed", "block": 16},
                          ("attr_pred", lambda c: c.sparse_attention.mode == "fixed")),
     "sequence_parallel": ({"enabled": True, "schedule": "masked"},

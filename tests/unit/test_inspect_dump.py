@@ -1,0 +1,85 @@
+"""CLI smoke test: forced-NaN toy run -> flight-recorder dump -> inspector.
+
+The acceptance path for the whole observatory: a training run that goes bad
+must leave a post-mortem bundle that ``ds-tpu inspect-dump`` resolves to the
+first bad step and the offending parameter subtree — with no access to the
+dead process.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.utils.numerics import inspect_dump_main, summarize_dump
+from simple_model import SimpleModel, random_dataset, simple_config
+
+HIDDEN = 16
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _forced_nan_dump(tmp_path):
+    """Run a tiny fp16 job, poison w2's grads for two consecutive steps, and
+    return the dump the consecutive-skip trigger wrote."""
+    model = SimpleModel(HIDDEN)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+        config_params=simple_config(
+            fp16={"enabled": True, "initial_scale_power": 4},
+            numerics={"enabled": True, "consecutive_skip_trigger": 2,
+                      "dump_dir": str(tmp_path)}))
+    data = random_dataset(8, HIDDEN, seed=0)
+    xs = np.stack([d[0] for d in data])
+    ys = np.stack([d[1] for d in data])
+    for step in range(3):
+        loss = eng(xs, ys)
+        eng.backward(loss)
+        if step >= 1:  # step 0 healthy, then two poisoned steps in a row
+            g = dict(eng._grad_acc)
+            g["w2"] = jax.device_put(
+                jnp.full(g["w2"].shape, jnp.nan, g["w2"].dtype), g["w2"].sharding)
+            eng._grad_acc = g
+        eng.step()
+    rec = eng._numerics.recorder
+    assert rec.dump_count == 1, "consecutive-skip trigger did not fire"
+    return rec.last_dump_path
+
+
+def test_forced_nan_run_dump_resolves(tmp_path, capsys):
+    path = _forced_nan_dump(tmp_path)
+    bundle = json.load(open(path))
+    assert bundle["reason"] == "consecutive_overflow_skips"
+    s = summarize_dump(bundle)
+    assert s["first_bad_step"] == 2          # first poisoned global step
+    assert s["offending_subtree"] == "w2"
+    assert s["loss_scale_trajectory"], "journal trajectory missing from bundle"
+
+    # in-process inspector: human-readable output names the step and subtree
+    assert inspect_dump_main([path]) == 0
+    out = capsys.readouterr().out
+    assert "first bad step    : 2" in out
+    assert "offending subtree : w2" in out
+    assert "loss-scale trajectory" in out
+
+    # --json mode round-trips the summary
+    assert inspect_dump_main([path, "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["offending_subtree"] == "w2"
+
+
+def test_ds_tpu_inspect_dump_subprocess(tmp_path):
+    """The shipped CLI entry point resolves the dump end to end."""
+    path = _forced_nan_dump(tmp_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds-tpu"), "inspect-dump", path],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "first bad step    : 2" in proc.stdout
+    assert "offending subtree : w2" in proc.stdout

@@ -33,6 +33,33 @@ def test_monitor_disabled_is_noop(tmp_path):
     assert not os.path.exists(os.path.join(str(tmp_path), "job2"))
 
 
+def test_monitor_event_api_writes_events_jsonl(tmp_path):
+    mon = SummaryMonitor(str(tmp_path), "jobev")
+    mon.event("loss_scale", {"kind": "backoff", "scale": 64.0}, step=3)
+    mon.event("desync_audit", {"divergence": None})  # step-less event
+    mon.close()
+    lines = [json.loads(l) for l in
+             open(os.path.join(str(tmp_path), "jobev", "events.jsonl"))]
+    assert [l["event"] for l in lines] == ["loss_scale", "desync_audit"]
+    assert lines[0]["step"] == 3 and lines[0]["payload"]["kind"] == "backoff"
+    assert lines[1]["step"] is None
+
+
+def test_monitor_event_disabled_is_noop(tmp_path):
+    mon = SummaryMonitor(str(tmp_path), "jobev2", enabled=False)
+    mon.event("x", {"y": 1}, step=0)  # must not raise or create files
+    mon.close()
+    assert not os.path.exists(os.path.join(str(tmp_path), "jobev2"))
+
+
+def test_monitor_event_file_is_lazy(tmp_path):
+    """Scalar-only jobs must not grow an empty events.jsonl."""
+    mon = SummaryMonitor(str(tmp_path), "jobev3")
+    mon.add_scalar("x", 1.0, 0)
+    mon.close()
+    assert not os.path.exists(os.path.join(str(tmp_path), "jobev3", "events.jsonl"))
+
+
 def test_monitor_disabled_still_exposes_log_dir(tmp_path):
     """Regression: the disabled early-return used to skip the log_dir assignment,
     so any rank-agnostic caller touching monitor.log_dir raised AttributeError."""
