@@ -700,6 +700,68 @@ def collect_workload_evidence():
     return out
 
 
+def _pipeline_goodput_probe(stages=4, micro=8, steps=2):
+    """Post-window pipeline goodput probe (docs/pipeline-trace.md): build a tiny
+    instruction-mode pipeline with span tracing on, run a couple of
+    train_batches after a compile warmup, and report the measured bubble
+    fraction next to the analytic simulator replayed at the measured mean
+    fwd/bwd costs. Runs AFTER the headline timed window — the smoke tokens/s
+    number is never measured with tracing enabled."""
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.parallel.pipe import LayerSpec, PipelineModule
+    from deepspeed_tpu.utils.pipeline_trace import measured_costs, simulate_schedule
+
+    hidden = 16
+
+    class _Lin:
+        def init(self, rng, x):
+            return {"w": jax.random.normal(rng, (x.shape[-1], hidden), jnp.float32) * 0.3}
+
+        def apply(self, params, x):
+            return jnp.tanh(x @ params["w"].astype(x.dtype))
+
+    def _mse(out, target):
+        return jnp.mean(jnp.square(out.astype(jnp.float32) - target.astype(jnp.float32)))
+
+    module = PipelineModule(layers=[LayerSpec(_Lin) for _ in range(stages)],
+                            num_stages=stages, loss_fn=_mse)
+    params = module.init_params(jax.random.PRNGKey(0), jnp.zeros((2, hidden), jnp.float32))
+    world = jax.device_count()
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=module, model_parameters=params,
+        config_params={"train_batch_size": 2 * micro * world,
+                       "gradient_accumulation_steps": micro,
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                       "pipeline": {"spmd": False},
+                       "telemetry": {"pipeline_trace": {"enabled": True}}})
+    rng = np.random.default_rng(0)
+
+    def it():
+        while True:
+            x = rng.normal(size=(2 * world, hidden)).astype(np.float32)
+            yield x, np.tanh(x)
+
+    gen = it()
+    for _ in range(steps + 1):  # first batch carries the stage-fn compiles
+        eng.train_batch(gen)
+    g = eng.pipe_trace.last_goodput
+    t_fwd, t_bwd = measured_costs(eng.pipe_trace.steps[-1])
+    sim = simulate_schedule(micro, stages, "train", t_fwd=t_fwd, t_bwd=t_bwd)
+    return {"stages": stages, "micro_batches": micro,
+            "measured_bubble_fraction": round(g["bubble_fraction"], 4),
+            "simulated_bubble_fraction": round(sim["bubble_fraction"], 4),
+            "analytic_uniform_bubble_fraction": round(
+                (stages - 1) / (micro + stages - 1), 4),
+            "per_stage_busy_seconds": [round(b, 6) for b in g["per_stage_busy_seconds"]],
+            "fwd_seconds": round(g["fwd_seconds"], 6),
+            "bwd_seconds": round(g["bwd_seconds"], 6),
+            "p2p_seconds": round(g["p2p_seconds"], 6),
+            "opt_seconds": round(g["opt_seconds"], 6),
+            "straggler": g["straggler"]}
+
+
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)) or ".")
     # Persistent compilation cache (works over the axon relay: measured 13.0s ->
@@ -760,9 +822,14 @@ def main():
         telemetry = engine.telemetry.summary()
         numerics = engine._numerics.summary() if engine._numerics is not None else None
         engine.telemetry.close()
+        try:  # instrumented post-window probe; headline window above stays untraced
+            pipeline_goodput = _pipeline_goodput_probe()
+        except Exception as e:
+            pipeline_goodput = {"error": f"{type(e).__name__}: {e}"}
         print(json.dumps({"metric": "gpt2_tokens_per_sec_per_chip_cpu_smoke",
                           "value": round(tps, 1), "unit": "tokens/s", "vs_baseline": 0.0,
-                          "extra": {"telemetry": telemetry, "numerics": numerics}}))
+                          "extra": {"telemetry": telemetry, "numerics": numerics,
+                                    "pipeline_goodput": pipeline_goodput}}))
         return
 
     extra = bench_420m()

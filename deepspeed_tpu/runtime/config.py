@@ -246,6 +246,18 @@ class DeepSpeedConfig:
         self.telemetry_output_path = get_scalar_param(tel_dict, TELEMETRY_OUTPUT_PATH,
                                                       TELEMETRY_OUTPUT_PATH_DEFAULT)
         self.telemetry_job_name = get_scalar_param(tel_dict, TELEMETRY_JOB_NAME, TELEMETRY_JOB_NAME_DEFAULT)
+        pt_dict = tel_dict.get(TELEMETRY_PIPELINE_TRACE, {}) or {}
+        self.pipeline_trace_enabled = get_scalar_param(pt_dict, PIPELINE_TRACE_ENABLED,
+                                                       PIPELINE_TRACE_ENABLED_DEFAULT)
+        self.pipeline_trace_capacity = get_scalar_param(pt_dict, PIPELINE_TRACE_CAPACITY,
+                                                        PIPELINE_TRACE_CAPACITY_DEFAULT)
+        cap = self.pipeline_trace_capacity
+        if isinstance(cap, bool) or not isinstance(cap, int) or cap < 1:
+            raise ValueError(
+                "DeepSpeedConfig: telemetry.pipeline_trace.capacity must be an "
+                f"int >= 1, got {cap!r}")
+        self.pipeline_trace_dump_dir = get_scalar_param(pt_dict, PIPELINE_TRACE_DUMP_DIR,
+                                                        PIPELINE_TRACE_DUMP_DIR_DEFAULT)
 
         num_dict = param_dict.get(NUMERICS, {})
         self.numerics_enabled = get_scalar_param(num_dict, NUMERICS_ENABLED, NUMERICS_ENABLED_DEFAULT)

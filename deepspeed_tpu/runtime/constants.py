@@ -187,6 +187,16 @@ TELEMETRY_OUTPUT_PATH_DEFAULT = ""
 TELEMETRY_JOB_NAME = "job_name"
 TELEMETRY_JOB_NAME_DEFAULT = "DeepSpeedTelemetry"
 
+# telemetry.pipeline_trace sub-block: per-instruction span timeline for the
+# pipeline instruction executor (docs/pipeline-trace.md)
+TELEMETRY_PIPELINE_TRACE = "pipeline_trace"
+PIPELINE_TRACE_ENABLED = "enabled"
+PIPELINE_TRACE_ENABLED_DEFAULT = False
+PIPELINE_TRACE_CAPACITY = "capacity"
+PIPELINE_TRACE_CAPACITY_DEFAULT = 64
+PIPELINE_TRACE_DUMP_DIR = "dump_dir"
+PIPELINE_TRACE_DUMP_DIR_DEFAULT = ""
+
 #############################################
 # Numerics observatory (TPU-native health layer on top of telemetry; no
 # reference key — in-graph per-subtree anomaly sentinel, loss-scale event

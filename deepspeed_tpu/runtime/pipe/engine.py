@@ -50,6 +50,7 @@ For *multi-chip pipe-axis* execution with homogeneous transformer stages, see
 ``parallel/pipeline_spmd.py`` (shard_map + ppermute inside one jit).
 """
 
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -191,6 +192,30 @@ class PipelineEngine(DeepSpeedEngine):
         if not self._spmd:
             self._compile_stage_fns()
         self.agg_train_loss = None
+
+        # ---- pipeline schedule observatory (docs/pipeline-trace.md) ----
+        # Disabled (the default) leaves ``pipe_trace`` as None: the executor
+        # takes the untraced branch and the compiled stage programs are
+        # HLO-instruction-identical to a build without the subsystem.
+        self.pipe_trace = None
+        if getattr(self.config, "pipeline_trace_enabled", False):
+            if self._spmd:
+                logger.warning(
+                    "[deepspeed_tpu] telemetry.pipeline_trace: the SPMD executor "
+                    "folds the whole schedule into one jitted scan — there is no "
+                    "instruction stream to trace; set pipeline.spmd=false to "
+                    "record spans")
+            else:
+                from ...utils.pipeline_trace import PipelineTracer
+                self.pipe_trace = PipelineTracer(
+                    stages=self.num_stages,
+                    capacity=self.config.pipeline_trace_capacity,
+                    dump_dir=self.config.pipeline_trace_dump_dir or None,
+                    host_id=jax.process_index())
+                rec = getattr(self._numerics, "recorder", None) if self._numerics else None
+                if rec is not None:
+                    rec.pipeline_trace = self.pipe_trace
+
         d = self._spmd_decomp
         log_dist(
             f"PipelineEngine[{'SPMD' if self._spmd else 'instruction'}]: "
@@ -579,6 +604,7 @@ class PipelineEngine(DeepSpeedEngine):
 
         if self.telemetry is not None:
             self.telemetry.on_step_begin(self.global_steps)
+        tracer = self.pipe_trace
         mb = self.micro_batches
         S = self.num_stages
         scheds = [schedule.TrainSchedule(micro_batches=mb, stages=S, stage_id=s)
@@ -696,7 +722,37 @@ class PipelineEngine(DeepSpeedEngine):
             exec_cmd(s, cmd)
             self.timers(name).stop()
 
-        self._run_streams(streams, timed_exec)
+        def trace_mb(s, cmd):
+            # best-effort micro-batch attribution from the live buffer state
+            # (read BEFORE exec_cmd mutates it; Load/Recv use their counters)
+            if isinstance(cmd, schedule.LoadMicroBatch):
+                return fwd_count[s]
+            if isinstance(cmd, (schedule.ForwardPass, schedule.BackwardPass)):
+                return in_mb[s].get(cmd.buffer_id)
+            if isinstance(cmd, schedule.SendActivation):
+                return (act_out[s].get(cmd.buffer_id) or (None,))[0]
+            if isinstance(cmd, schedule.SendGrad):
+                return (dx_buf[s].get(cmd.buffer_id) or (None,))[0]
+            if isinstance(cmd, schedule.RecvActivation):
+                return recv_act_count[s]
+            if isinstance(cmd, schedule.RecvGrad):
+                return recv_grad_count[s]
+            return None
+
+        def traced_exec(s, cmd, step_id):
+            if tracer is None:
+                timed_exec(s, cmd)
+                return
+            mb_id = trace_mb(s, cmd)
+            t0 = time.perf_counter()
+            timed_exec(s, cmd)
+            tracer.record(s, step_id, cmd.name, mb_id,
+                          getattr(cmd, "buffer_id", None), t0, time.perf_counter())
+
+        if tracer is not None:
+            tracer.begin_step(self.global_steps, "TrainSchedule", mb)
+        self._run_streams(streams, traced_exec)
+        goodput = tracer.end_step() if tracer is not None else None
 
         self.agg_train_loss = jnp.mean(jnp.stack(micro_losses)) if micro_losses else None
         self.global_steps += 1
@@ -706,7 +762,8 @@ class PipelineEngine(DeepSpeedEngine):
         if self.telemetry is not None:
             numerics_host = self.telemetry.end_step(
                 self.global_steps, self.train_batch_size(),
-                pending=pending_losses, numerics=self._pending_sentinel)
+                pending=pending_losses, numerics=self._pending_sentinel,
+                goodput=goodput)
         elif self._pending_sentinel is not None:
             numerics_host = jax.device_get(self._pending_sentinel)
         if self._numerics is not None:
@@ -730,17 +787,19 @@ class PipelineEngine(DeepSpeedEngine):
         """Execute per-stage instruction streams merged by step index. Within one
         merged step all Sends/Loads run before any Recv — the scheduling invariant
         that lets the reference's blocking p2p broadcasts rendezvous (its even/odd
-        orderings serialize to exactly this)."""
+        orderings serialize to exactly this). ``exec_cmd`` receives the merged
+        step index so the pipeline tracer can stamp spans with their schedule
+        position."""
         S = len(streams)
         for step_id in range(len(streams[0])):
             for s in range(S):
                 for cmd in streams[s][step_id]:
                     if isinstance(cmd, _SEND_CMDS):
-                        exec_cmd(s, cmd)
+                        exec_cmd(s, cmd, step_id)
             for s in range(S):
                 for cmd in streams[s][step_id]:
                     if not isinstance(cmd, _SEND_CMDS):
-                        exec_cmd(s, cmd)
+                        exec_cmd(s, cmd, step_id)
 
     def _select_params(self, stage_id):
         return {k: self.params[k] for k in self._stage_param_keys(stage_id)}
@@ -783,6 +842,7 @@ class PipelineEngine(DeepSpeedEngine):
         if self._spmd:
             x, y = self._stack_window(data_iter)
             return self._jit_eval(self.params, x, y)
+        tracer = self.pipe_trace
         mb = self.micro_batches
         S = self.num_stages
         scheds = [schedule.InferenceSchedule(micro_batches=mb, stages=S, stage_id=s)
@@ -832,5 +892,30 @@ class PipelineEngine(DeepSpeedEngine):
                 act_in[s][cmd.buffer_id] = chan_act.pop((s - 1, mb_id))
                 in_mb[s][cmd.buffer_id] = mb_id
 
-        self._run_streams(streams, exec_cmd)
+        def trace_mb(s, cmd):
+            if isinstance(cmd, schedule.LoadMicroBatch):
+                return load_count[s]
+            if isinstance(cmd, schedule.ForwardPass):
+                return in_mb[s].get(cmd.buffer_id)
+            if isinstance(cmd, schedule.SendActivation):
+                return (act_out[s].get(cmd.buffer_id) or (None,))[0]
+            if isinstance(cmd, schedule.RecvActivation):
+                return recv_act_count[s]
+            return None
+
+        def traced_exec(s, cmd, step_id):
+            if tracer is None:
+                exec_cmd(s, cmd)
+                return
+            mb_id = trace_mb(s, cmd)
+            t0 = time.perf_counter()
+            exec_cmd(s, cmd)
+            tracer.record(s, step_id, cmd.name, mb_id,
+                          getattr(cmd, "buffer_id", None), t0, time.perf_counter())
+
+        if tracer is not None:
+            tracer.begin_step(self.global_steps, "InferenceSchedule", mb, kind="eval")
+        self._run_streams(streams, traced_exec)
+        if tracer is not None:
+            tracer.end_step()
         return jnp.mean(jnp.stack(micro_losses))

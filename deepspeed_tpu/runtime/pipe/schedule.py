@@ -222,7 +222,9 @@ class PipeInstruction:
             setattr(self, key, val)
 
     def __repr__(self):
-        return call_to_str(self.name, **self.kwargs)
+        # sorted kwargs: two equal instructions built with different keyword
+        # orders must print identically (schedule goldens / lint diffs)
+        return call_to_str(self.name, **{k: self.kwargs[k] for k in sorted(self.kwargs)})
 
     def __eq__(self, other):
         return type(self) is type(other) and self.kwargs == other.kwargs

@@ -169,10 +169,14 @@ class FlightRecorder:
     """Bounded per-host ring buffer of step records + structured events that
     dumps a JSON post-mortem bundle when triggered."""
 
-    def __init__(self, capacity=256, dump_dir=None, telemetry=None, host_id=0):
+    def __init__(self, capacity=256, dump_dir=None, telemetry=None, host_id=0,
+                 pipeline_trace=None):
         self.capacity = int(capacity)
         self.dump_dir = dump_dir
         self.telemetry = telemetry
+        # optional PipelineTracer: its span bundle rides along in every dump so
+        # ``ds-tpu timeline`` can reconstruct the schedule of a dead run
+        self.pipeline_trace = pipeline_trace
         self.host_id = int(host_id)
         self.steps = deque(maxlen=self.capacity)
         self.events = deque(maxlen=max(self.capacity * 4, 64))
@@ -210,7 +214,7 @@ class FlightRecorder:
                         "compile_seconds": rec.compile_seconds,
                         "count": rec.count,
                     })
-        return {
+        out = {
             "version": NUMERICS_DUMP_VERSION,
             "reason": reason,
             "detail": detail,
@@ -226,6 +230,9 @@ class FlightRecorder:
             "events": list(self.events),
             "compile_records": compile_records,
         }
+        if self.pipeline_trace is not None:
+            out["pipeline_trace"] = self.pipeline_trace.bundle()
+        return out
 
     # -- triggering --------------------------------------------------------
     def trigger(self, reason, detail=None, quiet=False):

@@ -321,7 +321,7 @@ class TelemetrySession:
 
     # ------------------------------------------------------------- step metrics
     def end_step(self, global_step: int, samples_per_step: int, pending=None,
-                 numerics=None):
+                 numerics=None, goodput=None):
         """Close one optimizer step's metrics. The ONLY blocking operation is a
         device_get of ``pending``'s last loss scalar (already computed; the
         engine fetches it for its monitor anyway) — the step boundary rides that
@@ -331,7 +331,11 @@ class TelemetrySession:
         ``numerics`` (optional) is the step's in-graph sentinel output (a small
         pytree of per-subtree stat vectors); it is fetched JOINTLY with the loss
         in the same device_get, so enabling the numerics sentinel adds no host
-        sync point. Returns the host-side numerics stats (or None)."""
+        sync point. Returns the host-side numerics stats (or None).
+
+        ``goodput`` (optional) is the pipeline tracer's per-step decomposition
+        (utils/pipeline_trace.goodput_decomposition) — already computed from
+        host timestamps, so emitting it here adds scalars only."""
         numerics_host = None
         try:
             if pending:
@@ -378,6 +382,15 @@ class TelemetrySession:
             mon.add_scalar("Telemetry/Samples/hbm_peak_bytes",
                            stats.get("peak_bytes_in_use", 0), samples)
         mon.add_scalar("Telemetry/Samples/compile_count", compiles, samples)
+        if goodput:
+            for key in ("fwd_seconds", "bwd_seconds", "p2p_seconds", "load_seconds",
+                        "reduce_seconds", "opt_seconds", "bubble_seconds",
+                        "pipeline_seconds"):
+                if key in goodput:
+                    mon.add_scalar(f"Pipeline/Goodput/{key}", goodput[key], samples)
+            if goodput.get("bubble_fraction") is not None:
+                mon.add_scalar("Pipeline/Goodput/bubble_fraction",
+                               goodput["bubble_fraction"], samples)
         mon.flush()
         if self._trace_active and self.trace_steps is not None \
                 and global_step >= self.trace_steps[1]:

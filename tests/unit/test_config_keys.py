@@ -93,6 +93,11 @@ SWEEP = {
         ({"enabled": True, "trace_steps": [2, 5]},
          ("attr", "telemetry_trace_steps", (2, 5))),
         ({"enabled": True, "trace_steps": [5, 2]}, ("raise", ValueError)),
+        ({"pipeline_trace": {"enabled": True, "capacity": 7}},
+         ("attr", "pipeline_trace_capacity", 7)),
+        ({"pipeline_trace": {"enabled": True, "dump_dir": "/tmp/pt"}},
+         ("attr", "pipeline_trace_dump_dir", "/tmp/pt")),
+        ({"pipeline_trace": {"enabled": True, "capacity": 0}}, ("raise", ValueError)),
     ),
     "numerics": (
         ({"enabled": True, "audit_interval": 7}, ("attr", "numerics_audit_interval", 7)),

@@ -14,6 +14,7 @@ import ast
 import os
 
 import deepspeed_tpu.utils.numerics as numerics_mod
+import deepspeed_tpu.utils.pipeline_trace as pipeline_trace_mod
 import deepspeed_tpu.utils.telemetry as telemetry_mod
 
 FORBIDDEN_ATTRS = ("device_get", "block_until_ready")
@@ -63,6 +64,12 @@ def test_numerics_module_never_syncs():
     assert _scan(numerics_mod) == []
 
 
+def test_pipeline_trace_module_never_syncs():
+    """utils/pipeline_trace.py records host timestamps at boundaries the
+    executor already crosses: zero blocking primitives, zero exceptions."""
+    assert _scan(pipeline_trace_mod) == []
+
+
 def test_telemetry_module_sync_allowlist_is_exact():
     """utils/telemetry.py gets exactly two occurrences: the end_step loss-ride
     fetch (the one sanctioned block per step) and the np.asarray inside the
@@ -78,6 +85,6 @@ def test_telemetry_module_sync_allowlist_is_exact():
 
 
 def test_guard_scans_the_real_files():
-    for mod in (numerics_mod, telemetry_mod):
+    for mod in (numerics_mod, telemetry_mod, pipeline_trace_mod):
         assert os.path.exists(mod.__file__)
         assert mod.__file__.endswith(".py")

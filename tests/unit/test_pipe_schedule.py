@@ -145,3 +145,17 @@ def test_pipe_stagequery():
     assert not sched.is_first_stage and not sched.is_last_stage
     sched = schedule.TrainSchedule(stages=3, micro_batches=2, stage_id=2)
     assert not sched.is_first_stage and sched.is_last_stage
+
+
+def test_instruction_repr_and_eq_are_deterministic():
+    """Sorted-kwargs repr: equal instructions built with different keyword
+    orders print identically, so schedule goldens and lint diffs are stable."""
+    a = schedule.PipeInstruction(zeta=1, alpha=2)
+    b = schedule.PipeInstruction(alpha=2, zeta=1)
+    assert a == b
+    assert repr(a) == repr(b) == "PipeInstruction(alpha=2, zeta=1)"
+    fwd = schedule.ForwardPass(buffer_id=3)
+    assert repr(fwd) == "ForwardPass(buffer_id=3)"
+    assert fwd == schedule.ForwardPass(buffer_id=3)
+    assert fwd != schedule.BackwardPass(buffer_id=3)  # type-sensitive equality
+    assert fwd != schedule.ForwardPass(buffer_id=4)

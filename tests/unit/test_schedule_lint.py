@@ -1,0 +1,93 @@
+"""Static schedule lint: every TrainSchedule/InferenceSchedule stream must obey
+the send/recv rendezvous and buffer-lifetime invariants the instruction executor
+relies on. A cheap regression fence for future schedule changes — the symbolic
+replay in utils/pipeline_trace.py re-executes the merged streams exactly the way
+runtime/pipe/engine.py does (sends before recvs within a merged step) and fails
+on the first violated invariant instead of a KeyError deep inside a train run.
+"""
+
+import pytest
+
+import deepspeed_tpu.runtime.pipe.schedule as schedule
+from deepspeed_tpu.utils.pipeline_trace import (ScheduleLintError,
+                                                _instruction_streams, _replay,
+                                                lint_schedule, simulate_schedule)
+
+GRID = [(m, p) for p in (1, 2, 3, 4, 6) for m in (1, 2, 3, 4, 8, 16)]
+
+
+@pytest.mark.parametrize("micro_batches,stages", GRID)
+def test_train_schedule_lints_clean(micro_batches, stages):
+    stats = lint_schedule(micro_batches, stages, "train")
+    assert stats["total_steps"] == 2 * (micro_batches + stages - 1)
+
+
+@pytest.mark.parametrize("micro_batches,stages", GRID)
+def test_inference_schedule_lints_clean(micro_batches, stages):
+    stats = lint_schedule(micro_batches, stages, "inference")
+    assert stats["total_steps"] == micro_batches + stages - 1
+
+
+def test_lint_catches_dropped_send():
+    """Removing one SendActivation strands its receiver: the matching recv must
+    be reported against the adjacent stage."""
+    streams, rings = _instruction_streams(4, 2, "train")
+    for step in streams[0]:
+        drop = [c for c in step if isinstance(c, schedule.SendActivation)]
+        if drop:
+            step.remove(drop[0])
+            break
+    with pytest.raises(ScheduleLintError, match="no matching SendActivation"):
+        _replay(streams, rings, 4, "train")
+
+
+def test_lint_catches_corrupted_buffer_id():
+    """Pointing a ForwardPass at a never-loaded buffer is a use-before-load."""
+    streams, rings = _instruction_streams(4, 2, "train")
+    for step in streams[0]:
+        for i, c in enumerate(step):
+            if isinstance(c, schedule.ForwardPass):
+                step[i] = schedule.ForwardPass(buffer_id=c.buffer_id + 17)
+                with pytest.raises(ScheduleLintError, match="before load/recv"):
+                    _replay(streams, rings, 4, "train")
+                return
+    pytest.fail("no ForwardPass found in stage-0 stream")
+
+
+def test_lint_catches_overfull_ring():
+    """Three eager sends against a two-slot receiver ring trip the in-flight
+    bound at the third send, before any recv runs. Each send sits one merged
+    step after its forward pass (sends execute first within a step)."""
+    s0 = [[schedule.LoadMicroBatch(buffer_id=0), schedule.ForwardPass(buffer_id=0)],
+          [schedule.SendActivation(buffer_id=0), schedule.LoadMicroBatch(buffer_id=1),
+           schedule.ForwardPass(buffer_id=1)],
+          [schedule.SendActivation(buffer_id=1), schedule.LoadMicroBatch(buffer_id=2),
+           schedule.ForwardPass(buffer_id=2)],
+          [schedule.SendActivation(buffer_id=2)]]
+    s1 = [[], [], [], []]
+    with pytest.raises(ScheduleLintError, match="in flight"):
+        _replay([s0, s1], [3, 2], 3, "train")
+
+
+@pytest.mark.parametrize("micro_batches,stages", [(m, p) for m, p in GRID if p > 1])
+def test_simulator_matches_closed_form_bubble(micro_batches, stages):
+    """At uniform compute cost the lockstep replay reproduces the
+    PipeDream-flush closed form (p-1)/(m+p-1) exactly."""
+    sim = simulate_schedule(micro_batches, stages, "train")
+    expect = (stages - 1) / (micro_batches + stages - 1)
+    assert sim["bubble_fraction"] == pytest.approx(expect, abs=1e-12)
+
+
+@pytest.mark.parametrize("micro_batches,stages", GRID)
+def test_simulator_occupancy_within_ring(micro_batches, stages):
+    for kind in ("train", "inference"):
+        sim = simulate_schedule(micro_batches, stages, kind)
+        for s, (peak, ring) in enumerate(zip(sim["peak_buffer_occupancy"],
+                                             sim["num_pipe_buffers"])):
+            assert peak <= ring, (kind, s)
+
+
+def test_simulator_single_stage_has_no_bubble():
+    sim = simulate_schedule(8, 1, "train")
+    assert sim["bubble_fraction"] == 0.0
+    assert sim["per_stage_idle_slots"] == [0]
