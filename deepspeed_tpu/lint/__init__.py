@@ -1,0 +1,20 @@
+"""Static-analysis lint suite over the framework's compiled programs and source.
+
+Two analysis surfaces share one violation/report model (``model.py``):
+
+- **Program passes** (``program_passes.py``) run over AOT ``lower().compile()``
+  artifacts — the same surface the compile watchdog uses — and check donation
+  (declared ``donate_argnums`` XLA could not alias), per-program collective
+  budgets (expected op kind/count/dtype manifests diffed against the optimized
+  HLO), and dtype promotion (f32 dots / lossy convert round-trips inside a
+  declared low-precision compute region).
+- **AST passes** (``ast_passes.py``) generalize the no-sync guard: forbidden
+  host-sync primitives, tracer-hostile host casts reachable from jitted
+  functions, and recompile hazards.
+
+``deepspeed_tpu/lint/config_pass.py`` adds the config-key reachability pass;
+``registry.py`` builds the representative test-scale engines whose programs
+``ds-tpu lint`` checks; ``cli.py`` is the subcommand. See docs/lint.md.
+"""
+
+from .model import Allowlist, LintReport, Violation  # noqa: F401

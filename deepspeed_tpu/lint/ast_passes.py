@@ -1,0 +1,318 @@
+"""AST lint passes: host-sync, tracer-hostile calls, recompile hazards.
+
+Generalizes the original no-sync guard (tests/unit/test_no_sync_guard.py,
+now a thin wrapper over :class:`HostSyncPass`) into reusable repo-wide passes.
+Scoping differs by pass:
+
+- ``HostSyncPass`` scans whole modules — it is applied only to modules that
+  PROMISE never to sync (the observability stack under ``utils/``); the engine
+  legitimately fetches the loss every step and must not be in its scope.
+- ``TracerHostilePass`` / ``RecompileHazardPass`` scan only functions that are
+  lexically jitted (``@jax.jit`` / ``@partial(jax.jit, ...)`` decorators,
+  ``jax.jit(f)`` / ``shard_map(f, ...)`` call sites naming a local def) plus
+  their same-module call-graph closure, so host-side code may cast and read
+  clocks freely. Full cross-module reachability is intractable statically;
+  the lexical closure is exactly the code a trace is guaranteed to enter.
+
+Subjects are ``<repo-relative-path>::<qualname>`` so vids survive unrelated
+edits; the same primitive appearing N times in one function is one violation
+with ``details["occurrences"] = N``.
+"""
+
+import ast
+import os
+
+from .model import Violation
+
+HOST_SYNC_ATTRS = ("device_get", "block_until_ready")
+HOST_SYNC_NUMPY = ("asarray",)
+HOST_CASTS = ("float", "int", "bool")
+# attribute chains whose call inside traced code is constant-folded at trace
+# time — a different value next trace means silent staleness or a recompile
+NONDETERMINISM_CHAINS = (
+    ("time", "time"), ("time", "perf_counter"), ("time", "monotonic"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("date", "today"),
+    ("random", "random"), ("random", "randint"), ("random", "uniform"),
+)
+
+
+def _qualname(stack):
+    return ".".join(stack) or "<module>"
+
+
+def parse_module(path, root=None):
+    """(tree, repo-relative path) for one source file."""
+    with open(path) as f:
+        src = f.read()
+    rel = os.path.relpath(path, root) if root else path
+    return ast.parse(src, filename=path), rel.replace(os.sep, "/")
+
+
+class _FunctionIndex(ast.NodeVisitor):
+    """Collects every function def with its qualname, called names, and
+    whether a jit/shard_map construct roots it."""
+
+    def __init__(self):
+        self.funcs = {}        # qualname -> node
+        self.by_name = {}      # bare name -> [qualname] (lexical resolution)
+        self.calls = {}        # qualname -> set of bare names it calls
+        self.jit_roots = set() # qualnames lexically jitted
+        self._stack = []
+
+    def _mark_jit_target(self, node):
+        """``jax.jit(f)`` / ``shard_map(f, ...)``: resolve f to local defs."""
+        if isinstance(node, ast.Name):
+            for q in self.by_name.get(node.id, ()):
+                self.jit_roots.add(q)
+        elif isinstance(node, ast.Lambda):
+            # the lambda body is traced; it has no qualname of its own, so
+            # attribute it to the enclosing function's scope
+            self.jit_roots.add(_qualname(self._stack))
+
+    def visit_FunctionDef(self, node):
+        self._stack.append(node.name)
+        q = _qualname(self._stack)
+        self.funcs[q] = node
+        self.by_name.setdefault(node.name, []).append(q)
+        self.calls.setdefault(q, set())
+        for dec in node.decorator_list:
+            if _is_jit_expr(dec):
+                self.jit_roots.add(q)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_Call(self, node):
+        if self._stack:
+            q = _qualname(self._stack)
+            if isinstance(node.func, ast.Name):
+                self.calls.setdefault(q, set()).add(node.func.id)
+        if _is_jit_expr(node.func) or _attr_tail(node.func) == "shard_map" \
+                or (isinstance(node.func, ast.Name) and node.func.id == "shard_map"):
+            for arg in node.args[:1]:
+                self._mark_jit_target(arg)
+        # partial(jax.jit, ...) / functools.partial(jax.jit, ...) used as a
+        # value: the jit target is whatever the partial is later applied to —
+        # handled by the decorator check; nothing to do here.
+        self.generic_visit(node)
+
+
+def _attr_tail(node):
+    return node.attr if isinstance(node, ast.Attribute) else None
+
+
+def _is_jit_expr(node):
+    """True for ``jit`` / ``jax.jit`` / ``partial(jax.jit, ...)`` expressions."""
+    if isinstance(node, ast.Name) and node.id == "jit":
+        return True
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return True
+    if isinstance(node, ast.Call):
+        f = node.func
+        is_partial = (isinstance(f, ast.Name) and f.id == "partial") or \
+                     (isinstance(f, ast.Attribute) and f.attr == "partial")
+        if is_partial and node.args and _is_jit_expr(node.args[0]):
+            return True
+    return False
+
+
+def _jitted_closure(index):
+    """Jit roots plus every same-module function transitively called by name.
+    Two defs sharing a bare name both enter the closure — over-approximate
+    rather than miss traced code."""
+    reached = set(index.jit_roots)
+    frontier = list(reached)
+    while frontier:
+        q = frontier.pop()
+        for name in index.calls.get(q, ()):
+            for callee in index.by_name.get(name, ()):
+                if callee not in reached:
+                    reached.add(callee)
+                    frontier.append(callee)
+    return reached
+
+
+def _collect(tree, visit):
+    """Run ``visit(qualname, node)`` over every node with scope tracking."""
+    stack = []
+
+    class W(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            stack.append(node.name)
+            self.generic_visit(node)
+            stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            stack.append(node.name)
+            self.generic_visit(node)
+            stack.pop()
+
+        def generic_visit(self, node):
+            visit(_qualname(stack), node)
+            super().generic_visit(node)
+
+    W().visit(tree)
+
+
+def _dedupe(pass_id, raw):
+    """[(rule, subject, message)] -> [Violation] with occurrence counts."""
+    seen = {}
+    for rule, subject, message in raw:
+        key = (rule, subject)
+        if key in seen:
+            seen[key].details["occurrences"] += 1
+        else:
+            seen[key] = Violation(pass_id, rule, subject, message,
+                                  details={"occurrences": 1})
+    return [seen[k] for k in sorted(seen)]
+
+
+class HostSyncPass:
+    """Forbidden host-sync primitives anywhere in the module: ``device_get``,
+    ``block_until_ready``, ``np.asarray`` (which silently fetches a device
+    array). Scope this pass to modules that promise non-perturbation."""
+
+    pass_id = "ast-host-sync"
+
+    def run(self, tree, rel):
+        raw = []
+
+        def visit(qual, node):
+            if isinstance(node, ast.Attribute):
+                if node.attr in HOST_SYNC_ATTRS:
+                    raw.append((node.attr.replace("_", "-"), f"{rel}::{qual}",
+                                f"host-sync primitive {node.attr} in {qual}"))
+                elif node.attr in HOST_SYNC_NUMPY and \
+                        isinstance(node.value, ast.Name) and \
+                        node.value.id in ("np", "numpy"):
+                    raw.append(("np-asarray", f"{rel}::{qual}",
+                                f"np.{node.attr} in {qual} fetches device arrays"))
+
+        _collect(tree, visit)
+        return _dedupe(self.pass_id, raw)
+
+
+class TracerHostilePass:
+    """``float()``/``int()``/``bool()`` and ``.item()`` on values inside the
+    lexically-jitted closure: on a tracer these either raise at trace time or
+    force a concretization the author did not intend."""
+
+    pass_id = "ast-tracer-hostile"
+
+    def run(self, tree, rel):
+        index = _FunctionIndex()
+        index.visit(tree)
+        index.visit(tree)  # second sweep: by_name is complete for call-site roots
+        jitted = _jitted_closure(index)
+        raw = []
+        for q in sorted(jitted):
+            node = index.funcs.get(q)
+            if node is None:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                f = sub.func
+                if isinstance(f, ast.Name) and f.id in HOST_CASTS and \
+                        len(sub.args) == 1 and \
+                        not isinstance(sub.args[0], ast.Constant):
+                    raw.append(("host-cast", f"{rel}::{q}",
+                                f"{f.id}() inside jitted {q} concretizes a tracer"))
+                elif isinstance(f, ast.Attribute) and f.attr == "item":
+                    raw.append(("item-call", f"{rel}::{q}",
+                                f".item() inside jitted {q} blocks on the device"))
+        return _dedupe(self.pass_id, raw)
+
+
+class RecompileHazardPass:
+    """Recompile / staleness hazards around jitted code: clock- or RNG-reads
+    constant-folded into a trace, and ``static_argnums`` marking a parameter
+    whose default is an unhashable literal (every call site then raises or
+    re-traces)."""
+
+    pass_id = "ast-recompile-hazard"
+
+    def run(self, tree, rel):
+        index = _FunctionIndex()
+        index.visit(tree)
+        index.visit(tree)
+        jitted = _jitted_closure(index)
+        raw = []
+        for q in sorted(jitted):
+            node = index.funcs.get(q)
+            if node is None:
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+                    base = sub.func.value
+                    base_name = base.id if isinstance(base, ast.Name) else None
+                    if (base_name, sub.func.attr) in NONDETERMINISM_CHAINS:
+                        raw.append((
+                            "nondeterminism-in-trace", f"{rel}::{q}",
+                            f"{base_name}.{sub.func.attr}() inside jitted {q} is "
+                            "constant-folded at trace time"))
+        raw += self._unhashable_static(tree, rel, index)
+        return _dedupe(self.pass_id, raw)
+
+    def _unhashable_static(self, tree, rel, index):
+        raw = []
+        unhashable = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                      ast.SetComp)
+        for sub in ast.walk(tree):
+            if not (isinstance(sub, ast.Call) and _is_jit_expr(sub.func)):
+                continue
+            statics = {}
+            for kw in sub.keywords:
+                if kw.arg in ("static_argnums", "static_argnames"):
+                    statics[kw.arg] = kw.value
+            if not statics or not sub.args or not isinstance(sub.args[0], ast.Name):
+                continue
+            for q in index.by_name.get(sub.args[0].id, ()):
+                fn = index.funcs.get(q)
+                if fn is None:
+                    continue
+                params = fn.args.args
+                defaults = fn.args.defaults
+                offset = len(params) - len(defaults)
+                for i, p in enumerate(params):
+                    d = defaults[i - offset] if i >= offset else None
+                    if d is None or not isinstance(d, unhashable):
+                        continue
+                    hit = False
+                    nums = statics.get("static_argnums")
+                    if isinstance(nums, ast.Constant) and nums.value == i:
+                        hit = True
+                    elif isinstance(nums, (ast.Tuple, ast.List)):
+                        hit = any(isinstance(e, ast.Constant) and e.value == i
+                                  for e in nums.elts)
+                    names = statics.get("static_argnames")
+                    if isinstance(names, ast.Constant) and names.value == p.arg:
+                        hit = True
+                    elif isinstance(names, (ast.Tuple, ast.List)):
+                        hit = hit or any(isinstance(e, ast.Constant) and
+                                         e.value == p.arg for e in names.elts)
+                    if hit:
+                        raw.append((
+                            "unhashable-static", f"{rel}::{q}#{p.arg}",
+                            f"static arg {p.arg!r} of {q} defaults to an "
+                            "unhashable literal — every jit call raises or "
+                            "re-traces"))
+        return raw
+
+
+def run_ast_passes(files, passes, root=None):
+    """Run each pass over each file; returns all violations."""
+    out = []
+    for path in sorted(files):
+        tree, rel = parse_module(path, root=root)
+        for p in passes:
+            out.extend(p.run(tree, rel))
+    return out

@@ -1,0 +1,141 @@
+"""``ds-tpu lint`` — run the static-analysis suite and emit a report.
+
+Two surfaces, one report:
+
+* **AST passes** walk every ``.py`` file under the installed ``deepspeed_tpu``
+  package — host-sync primitives, tracer-hostile casts inside jitted closures,
+  recompile hazards, and config-key reachability. Pure host work, no jax
+  import needed.
+* **Program passes** build the registry of representative test-scale engines
+  on an 8-virtual-device CPU mesh, capture every program on each engine's
+  active step path via ``engine.lint_programs``, and diff donation /
+  collective-budget / dtype-promotion facts against the engines' own
+  manifests.
+
+Violations matching ``allowlist.json`` (shipped next to this module; override
+with ``--allowlist``) are reported but do not fail the run; allowlist entries
+that match nothing are flagged so the list cannot rot. Exit status is 1 iff
+any non-allowlisted violation remains. ``--json`` output is deterministic
+byte-for-byte for a given repo state: violations are sorted by id and carry
+no timestamps or absolute paths.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+from .model import Allowlist, LintReport
+
+_DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                  "allowlist.json")
+
+
+def _package_dir():
+    import deepspeed_tpu
+    return os.path.dirname(os.path.abspath(deepspeed_tpu.__file__))
+
+
+def _package_files(package_dir):
+    out = []
+    for dirpath, _dirnames, filenames in os.walk(package_dir):
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+def run_ast_surface(report, allowlist, package_dir=None):
+    from .ast_passes import (HostSyncPass, RecompileHazardPass,
+                             TracerHostilePass, run_ast_passes)
+    from .config_pass import ConfigKeysPass
+    pkg = package_dir or _package_dir()
+    root = os.path.dirname(pkg)
+    # the host-sync (no-perturbation) contract covers the observability tier
+    # in utils/ — the data path syncs on purpose (loss fetch, batch placement).
+    # Tracer-hostility and recompile hazards are properties of any jitted code,
+    # so those passes sweep the whole package.
+    utils_files = [f for f in _package_files(pkg)
+                   if f.startswith(os.path.join(pkg, "utils") + os.sep)]
+    host_sync = HostSyncPass()
+    report.passes.append(host_sync.pass_id)
+    report.extend(run_ast_passes(utils_files, (host_sync,), root=root),
+                  allowlist)
+    passes = (TracerHostilePass(), RecompileHazardPass())
+    report.passes += [p.pass_id for p in passes]
+    report.extend(run_ast_passes(_package_files(pkg), passes, root=root),
+                  allowlist)
+    config_pass = ConfigKeysPass(pkg)
+    report.passes.append(config_pass.pass_id)
+    report.extend(config_pass.run(), allowlist)
+
+
+def run_program_surface(report, allowlist, entries=None):
+    from . import registry
+    from .program_passes import PROGRAM_PASSES, run_program_passes
+    report.passes += [p.pass_id for p in PROGRAM_PASSES]
+    for entry in (sorted(registry.BUILDERS) if not entries else list(entries)):
+        artifacts = registry.capture_entry(entry)
+        report.programs += [a.name for a in artifacts]
+        report.extend(run_program_passes(artifacts), allowlist)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="ds-tpu lint",
+        description="donation / collective / dtype / host-sync static "
+                    "analysis over the package and its AOT-lowered programs")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the full report as JSON on stdout")
+    parser.add_argument("--out", metavar="PATH",
+                        help="also write the JSON report to PATH")
+    parser.add_argument("--allowlist", metavar="PATH",
+                        default=_DEFAULT_ALLOWLIST,
+                        help="violation allowlist (default: the shipped one)")
+    parser.add_argument("--ast-only", action="store_true",
+                        help="skip the program surface (no engine builds)")
+    parser.add_argument("--programs-only", action="store_true",
+                        help="skip the AST surface")
+    parser.add_argument("--entry", action="append", metavar="NAME",
+                        help="limit the program surface to a registry entry "
+                             "(repeatable)")
+    args = parser.parse_args(argv)
+
+    # stdout belongs to the report: the framework logger defaults to stdout,
+    # which would interleave engine-build INFO lines into `--json > out.json`
+    import logging
+    for h in logging.getLogger("DeepSpeedTPU").handlers:
+        if isinstance(h, logging.StreamHandler) and h.stream is sys.stdout:
+            h.stream = sys.stderr
+
+    allowlist = Allowlist.load(args.allowlist)
+    report = LintReport()
+    if not args.programs_only:
+        run_ast_surface(report, allowlist)
+    if not args.ast_only:
+        run_program_surface(report, allowlist, entries=args.entry)
+    report.finish(allowlist)
+
+    text = report.to_json()
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    if args.json:
+        sys.stdout.write(text)
+    else:
+        for v in sorted(report.violations, key=lambda v: (v.vid, v.message)):
+            print(f"FAIL {v.vid}\n     {v.message}")
+        for v, reason in sorted(report.allowlisted,
+                                key=lambda p: (p[0].vid, p[0].message)):
+            print(f"allow {v.vid} ({reason})")
+        for vid in report.unused_allow:
+            print(f"stale-allowlist {vid}")
+        n = len(report.violations)
+        print(f"{n} violation(s), {len(report.allowlisted)} allowlisted, "
+              f"{len(report.programs)} program(s), "
+              f"{len(report.passes)} pass(es)")
+    return 1 if report.failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

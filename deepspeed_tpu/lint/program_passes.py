@@ -1,0 +1,338 @@
+"""Program lint passes over AOT ``lower().compile()`` artifacts.
+
+A :class:`ProgramArtifact` captures everything one jitted program exposes
+before it ever executes — flattened ``args_info`` donation flags, compile-time
+warnings (XLA raises "Some donated buffers were not usable" here), the
+optimized HLO text, and ``memory_analysis`` when the backend provides one.
+The passes then check the artifact against the program's declared **manifest**:
+
+``donation``
+    ``{"check_unusable": bool, "min_undonated_bytes": int|None}`` —
+    ``unusable-donation`` flags declared ``donate_argnums`` XLA did not alias
+    (cross-checked against the module header's ``input_output_alias``);
+    ``undonated-aliasable`` flags inputs >= ``min_undonated_bytes`` whose
+    (shape, dtype) matches an entry result but which were not donated — each
+    one is a buffer of avoidable peak HBM, reported as a waste estimate.
+
+``collectives`` / ``any_reduction`` / ``strict``
+    The expected-collective budget: ``{op: {"min", "max", "dtypes"}}`` diffed
+    against the optimized HLO. Only instructions whose largest result exceeds
+    ``small_element_threshold`` elements count — scalar loss pmeans and norm
+    all-reduces ride free; "full-parameter-scale" traffic is what manifests
+    constrain. ``any_reduction`` budgets all-reduce + reduce-scatter together
+    because XLA's CPU pipeline does not run the reduce-scatter rewrite the TPU
+    pipeline applies (tests/unit/test_collectives_hlo.py). With ``strict``,
+    any large collective not covered by a budget is ``undeclared-collective``
+    — a full-param all-gather appearing in a ZeRO-2 backward fails here.
+
+``compute_dtype``
+    When "bf16"/"f16", the dtype-promotion pass flags f32 dots fed by converts
+    from the low-precision dtype and lossy d1→d2→d1 convert round-trips.
+    Subjects use per-program ordinals (``prog#dot0``) so vids are stable
+    across XLA instruction renamings.
+
+Two analyses deliberately read different HLO stages. Collectives only exist
+**after** SPMD partitioning, so the budget pass reads the optimized module.
+But the CPU backend's float-normalization pass emulates bf16 arithmetic as
+``convert→f32 op→convert`` in that same module, which would make every bf16
+dot look like an author-written f32 promotion — so the dtype pass reads the
+**unoptimized** (pre-backend) HLO, where the author's dtypes survive intact.
+Float normalization also rewrites bf16 all-reduces to f32 on the wire, so on
+the CPU platform a declared low-precision comm dtype implicitly admits f32.
+"""
+
+import warnings
+
+import jax
+
+from ..utils import hlo
+from .model import Violation
+
+SMALL_ELEMENT_THRESHOLD = 256
+REDUCTION_OPS = ("all-reduce", "reduce-scatter")
+
+
+class ProgramArtifact:
+    """Static capture of one jitted program: HLO + arg metadata + warnings."""
+
+    def __init__(self, name, hlo_text, args_info, compile_warnings, memory_stats,
+                 manifest, lowered_text=None, platform=None):
+        self.name = name
+        self.hlo_text = hlo_text            # optimized (post-backend) HLO
+        self.lowered_text = lowered_text or hlo_text  # pre-backend HLO
+        self.platform = platform or ""
+        self.args_info = args_info          # [(donated, shape, dtype_str)] flat
+        self.compile_warnings = compile_warnings
+        self.memory_stats = memory_stats    # dict or {}
+        self.manifest = dict(manifest or {})
+
+    @classmethod
+    def capture(cls, name, jitted, args, manifest=None, kwargs=None):
+        lowered = jitted.lower(*args, **(kwargs or {}))
+        try:
+            lowered_text = lowered.as_text(dialect="hlo")
+        except Exception:
+            lowered_text = None
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            compiled = lowered.compile()
+        info = []
+        for ai in jax.tree_util.tree_leaves(lowered.args_info):
+            aval = getattr(ai, "_aval", None) or getattr(ai, "aval", None)
+            shape = tuple(getattr(aval, "shape", ()) or ())
+            dtype = str(getattr(aval, "dtype", "")) or ""
+            info.append((bool(getattr(ai, "donated", False)), shape, dtype))
+        mem = {}
+        try:
+            ma = compiled.memory_analysis()
+            for field in ("argument_size_in_bytes", "output_size_in_bytes",
+                          "temp_size_in_bytes", "alias_size_in_bytes",
+                          "generated_code_size_in_bytes"):
+                val = getattr(ma, field, None)
+                if val is not None:
+                    mem[field] = int(val)
+        except Exception:
+            pass
+        return cls(name, compiled.as_text(),
+                   info, [str(w.message) for w in caught], mem, manifest,
+                   lowered_text=lowered_text, platform=jax.default_backend())
+
+
+# jnp dtype name -> HLO element type string
+_HLO_DTYPE = {"float32": "f32", "float16": "f16", "bfloat16": "bf16",
+              "float64": "f64", "int32": "s32", "int64": "s64", "int16": "s16",
+              "int8": "s8", "uint32": "u32", "uint64": "u64", "uint16": "u16",
+              "uint8": "u8", "bool": "pred"}
+
+
+def _hlo_dtype(np_name):
+    return _HLO_DTYPE.get(np_name, np_name)
+
+
+def _elem_bytes(dt):
+    return hlo.dtype_bytes(dt) or 0
+
+
+def _nbytes(shape, dt):
+    n = 1
+    for d in shape:
+        n *= d
+    return n * _elem_bytes(dt)
+
+
+class DonationPass:
+    pass_id = "program-donation"
+
+    def run(self, artifact):
+        man = artifact.manifest.get("donation", {})
+        out = []
+        if man.get("check_unusable", True):
+            out += self._unusable(artifact)
+        min_bytes = man.get("min_undonated_bytes")
+        if min_bytes is not None:
+            out += self._undonated(artifact, int(min_bytes))
+        return out
+
+    def _unusable(self, artifact):
+        aliases = hlo.input_output_aliases(artifact.hlo_text)
+        params = hlo.entry_parameter_types(artifact.hlo_text)
+        # flat jit-arg index == entry param number only when nothing was
+        # hoisted; on a mismatch fall back to the compile warning alone.
+        indexable = len(params) == len(artifact.args_info)
+        warned = any("donated buffers were not usable" in w.lower()
+                     for w in artifact.compile_warnings)
+        out = []
+        for i, (donated, shape, dtype) in enumerate(artifact.args_info):
+            if not donated:
+                continue
+            if indexable and i in aliases:
+                continue
+            if not indexable and not warned:
+                continue
+            out.append(Violation(
+                self.pass_id, "unusable-donation", f"{artifact.name}#arg{i}",
+                f"{artifact.name}: donated arg {i} "
+                f"({_hlo_dtype(dtype)}{list(shape)}) was not aliased by XLA — "
+                "the buffer is held live anyway and the donation is a no-op",
+                details={"shape": list(shape), "dtype": _hlo_dtype(dtype),
+                         "bytes": _nbytes(shape, _hlo_dtype(dtype)),
+                         "compile_warned": warned}))
+        return out
+
+    def _undonated(self, artifact, min_bytes):
+        aliases = hlo.input_output_aliases(artifact.hlo_text)
+        results = hlo.entry_result_types(artifact.hlo_text)
+        result_shapes = {(dt, dims) for dt, dims in results}
+        out = []
+        for i, (donated, shape, dtype) in enumerate(artifact.args_info):
+            if donated or i in aliases:
+                continue
+            dt = _hlo_dtype(dtype)
+            nbytes = _nbytes(shape, dt)
+            if nbytes < min_bytes:
+                continue
+            if (dt, tuple(shape)) not in result_shapes:
+                continue
+            out.append(Violation(
+                self.pass_id, "undonated-aliasable", f"{artifact.name}#arg{i}",
+                f"{artifact.name}: arg {i} ({dt}{list(shape)}, {nbytes} bytes) "
+                "matches an output shape/dtype but is not donated — "
+                f"~{nbytes} bytes of avoidable peak HBM",
+                details={"shape": list(shape), "dtype": dt,
+                         "hbm_waste_bytes": nbytes}))
+        return out
+
+
+def _large_collectives(artifact):
+    """[(op, [dtypes of large results], max_elements)] per collective
+    instruction whose largest result crosses the size threshold."""
+    threshold = artifact.manifest.get("small_element_threshold",
+                                      SMALL_ELEMENT_THRESHOLD)
+    out = []
+    for result_ty, op, is_start in hlo._collective_matches(artifact.hlo_text):
+        shaped = hlo._result_shapes(result_ty, op, is_start)
+        big = [(dt, dims) for dt, dims in shaped
+               if hlo._elements(dims) > threshold]
+        if big:
+            out.append((op, sorted({dt for dt, _ in big}),
+                        max(hlo._elements(dims) for _, dims in big)))
+    return out
+
+
+def _admitted_dtypes(allowed, platform):
+    """Declared comm dtypes, widened with f32 on CPU where float
+    normalization rewrites low-precision reductions to f32 on the wire."""
+    admitted = set(allowed)
+    if platform == "cpu" and admitted & {"bf16", "f16"}:
+        admitted.add("f32")
+    return admitted
+
+
+class CollectiveBudgetPass:
+    pass_id = "program-collectives"
+
+    def run(self, artifact):
+        man = artifact.manifest
+        budgets = dict(man.get("collectives", {}))
+        any_red = man.get("any_reduction")
+        strict = bool(man.get("strict", True))
+        large = _large_collectives(artifact)
+        out = []
+
+        counts = {}
+        dtypes_seen = {}
+        for op, dts, _n in large:
+            counts[op] = counts.get(op, 0) + 1
+            dtypes_seen.setdefault(op, set()).update(dts)
+
+        red_count = sum(counts.get(op, 0) for op in REDUCTION_OPS)
+        for op in sorted(set(counts) | set(budgets)):
+            budget = budgets.get(op)
+            n = counts.get(op, 0)
+            covered_by_red = any_red is not None and op in REDUCTION_OPS
+            if budget is None and covered_by_red:
+                continue
+            if budget is None:
+                if strict and n > 0:
+                    out.append(Violation(
+                        self.pass_id, "undeclared-collective",
+                        f"{artifact.name}#{op}",
+                        f"{artifact.name}: {n} large {op} instruction(s) "
+                        "appear but the manifest declares no budget for the op",
+                        details={"count": n,
+                                 "dtypes": sorted(dtypes_seen.get(op, ()))}))
+                continue
+            lo = budget.get("min", 0)
+            hi = budget.get("max")
+            if n < lo:
+                out.append(Violation(
+                    self.pass_id, "count-missing", f"{artifact.name}#{op}",
+                    f"{artifact.name}: expected >= {lo} large {op}, found {n}",
+                    details={"count": n, "min": lo}))
+            if hi is not None and n > hi:
+                out.append(Violation(
+                    self.pass_id, "count-exceeded", f"{artifact.name}#{op}",
+                    f"{artifact.name}: expected <= {hi} large {op}, found {n}",
+                    details={"count": n, "max": hi}))
+            allowed = budget.get("dtypes")
+            if allowed:
+                bad = sorted(dtypes_seen.get(op, set())
+                             - _admitted_dtypes(allowed, artifact.platform))
+                if bad:
+                    out.append(Violation(
+                        self.pass_id, "comm-dtype", f"{artifact.name}#{op}",
+                        f"{artifact.name}: {op} carries {bad} on the wire but "
+                        f"the manifest allows only {sorted(allowed)}",
+                        details={"found": bad, "allowed": sorted(allowed)}))
+        if any_red is not None:
+            lo = any_red.get("min", 0)
+            hi = any_red.get("max")
+            subj = f"{artifact.name}#any-reduction"
+            if red_count < lo:
+                out.append(Violation(
+                    self.pass_id, "count-missing", subj,
+                    f"{artifact.name}: expected >= {lo} large reduction "
+                    f"collective(s) (all-reduce/reduce-scatter), found {red_count}",
+                    details={"count": red_count, "min": lo}))
+            if hi is not None and red_count > hi:
+                out.append(Violation(
+                    self.pass_id, "count-exceeded", subj,
+                    f"{artifact.name}: expected <= {hi} large reduction "
+                    f"collective(s), found {red_count}",
+                    details={"count": red_count, "max": hi}))
+            allowed = any_red.get("dtypes")
+            if allowed:
+                seen = set()
+                for op in REDUCTION_OPS:
+                    seen |= dtypes_seen.get(op, set())
+                bad = sorted(seen - _admitted_dtypes(allowed, artifact.platform))
+                if bad:
+                    out.append(Violation(
+                        self.pass_id, "comm-dtype", subj,
+                        f"{artifact.name}: reduction collectives carry {bad} "
+                        f"but the manifest allows only {sorted(allowed)}",
+                        details={"found": bad, "allowed": sorted(allowed)}))
+        return out
+
+
+class DtypePromotionPass:
+    pass_id = "program-dtype"
+
+    def run(self, artifact):
+        compute = artifact.manifest.get("compute_dtype")
+        if compute not in ("bf16", "f16"):
+            return []
+        # pre-backend HLO: CPU float-normalization has not yet rewritten the
+        # author's bf16 arithmetic into convert-wrapped f32 ops
+        text = artifact.lowered_text
+        out = []
+        dots = hlo.f32_dots_with_lowp_operands(text, lowp=(compute,))
+        for i, (dot_name, operands) in enumerate(dots):
+            out.append(Violation(
+                self.pass_id, "f32-dot-in-lowp-region",
+                f"{artifact.name}#dot{i}",
+                f"{artifact.name}: f32 dot fed by convert(s) from {compute} — "
+                "a matmul the author believed ran on the low-precision MXU "
+                "path was silently promoted",
+                details={"hlo_name": dot_name, "operands": operands}))
+        trips = hlo.lossy_convert_roundtrips(text)
+        for i, (name, chain) in enumerate(trips):
+            out.append(Violation(
+                self.pass_id, "lossy-convert-roundtrip",
+                f"{artifact.name}#convert{i}",
+                f"{artifact.name}: value round-trips {'->'.join(chain)} — the "
+                "narrowing leg truncates mantissa and usually marks a dtype "
+                "boundary drawn in the wrong place",
+                details={"hlo_name": name, "chain": list(chain)}))
+        return out
+
+
+PROGRAM_PASSES = (DonationPass(), CollectiveBudgetPass(), DtypePromotionPass())
+
+
+def run_program_passes(artifacts, passes=PROGRAM_PASSES):
+    out = []
+    for artifact in artifacts:
+        for p in passes:
+            out.extend(p.run(artifact))
+    return out

@@ -1,0 +1,196 @@
+"""Registry of representative test-scale engine programs for ``ds-tpu lint``.
+
+Each entry builds a real engine on the 8-virtual-device CPU mesh (the same
+mesh the tier-1 HLO tests pin collectives on) and captures every program on
+its active step path via ``engine.lint_programs`` — the engines themselves
+declare the expected-collective manifests. Entries cover the step-path matrix
+the bespoke tests grew one file at a time: standard two-jit ZeRO-2, the
+external-master fused single-jit (the pinned 1.5B bench structure), the
+unfused external-master accumulation window, ZeRO-Offload's host-tier split,
+and the instruction-executor pipeline's per-stage programs.
+
+The lint model computes in the engine's compute dtype (params enter already
+cast; inputs are cast once at the boundary) — unlike the test-suite
+SimpleModel, which casts params to ``x.dtype`` and therefore runs f32 dots
+that would (correctly!) trip the dtype-promotion pass. The seeded-violation
+fixtures use exactly that trick.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .program_passes import ProgramArtifact
+
+HIDDEN = 32
+BATCH = 8
+
+
+class LintModel:
+    """Two-layer MLP that computes in the dtype the engine handed it params
+    in, with only the loss in f32 — the clean low-precision reference shape."""
+
+    def __init__(self, hidden_dim=HIDDEN):
+        self.hidden_dim = hidden_dim
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        h = self.hidden_dim
+        return {"w1": jax.random.normal(k1, (h, h), jnp.float32) * 0.1,
+                "b1": jnp.zeros((h,), jnp.float32),
+                "w2": jax.random.normal(k2, (h, h), jnp.float32) * 0.1,
+                "b2": jnp.zeros((h,), jnp.float32)}
+
+    def apply(self, params, x, y):
+        dt = params["w1"].dtype
+        h = jnp.tanh(x.astype(dt) @ params["w1"] + params["b1"])
+        out = h @ params["w2"] + params["b2"]
+        return jnp.mean(jnp.square(out.astype(jnp.float32) - y))
+
+
+def _external_master_pair(n):
+    """Flat-shard external-master (init, apply) client pair — the 1.5B bench's
+    optimizer structure (bench.py) at test scale."""
+    def init(params):
+        flat = jnp.concatenate([p.reshape(-1).astype(jnp.float32)
+                                for p in jax.tree_util.tree_leaves(params)])
+        shard = flat[: flat.shape[0] // n]
+        return {"master": shard, "m1": jnp.zeros_like(shard),
+                "m2": jnp.zeros_like(shard)}
+
+    def apply(grads, opt_state, master, step, hyper):
+        g = jnp.concatenate([x.reshape(-1).astype(jnp.float32)
+                             for x in jax.tree_util.tree_leaves(grads)])
+        gs = g[: opt_state["master"].shape[0]]
+        m1 = 0.9 * opt_state["m1"] + 0.1 * gs
+        m2 = 0.999 * opt_state["m2"] + 0.001 * gs * gs
+        new_master = opt_state["master"] - hyper["lr"] * m1 / (jnp.sqrt(m2) + 1e-8)
+        return None, {"master": new_master, "m1": m1, "m2": m2}
+
+    apply.external_master = True
+    return init, apply
+
+
+def _config(batch=BATCH, **overrides):
+    cfg = {"train_batch_size": batch, "steps_per_print": 1000,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}}
+    cfg.update(overrides)
+    return cfg
+
+
+def _sample_batch(rng_seed=0, batch=BATCH, hidden=HIDDEN):
+    rng = np.random.default_rng(rng_seed)
+    x = rng.normal(size=(batch, hidden)).astype(np.float32)
+    return x, np.tanh(x)
+
+
+def _build_standard():
+    import deepspeed_tpu
+    model = LintModel()
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+        config_params=_config(zero_optimization={"stage": 2}))
+    return eng, _sample_batch()
+
+
+def _build_external_master_fused():
+    import deepspeed_tpu
+    model = LintModel()
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+        optimizer=_external_master_pair(4),
+        config_params=_config(zero_optimization={"stage": 2},
+                              zero_allow_untested_optimizer=True))
+    return eng, _sample_batch()
+
+
+def _build_external_master_accum():
+    import deepspeed_tpu
+    model = LintModel()
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+        optimizer=_external_master_pair(4),
+        config_params=_config(batch=BATCH * 2, gradient_accumulation_steps=2,
+                              zero_optimization={"stage": 2},
+                              zero_allow_untested_optimizer=True))
+    return eng, _sample_batch()
+
+
+def _build_zero_offload():
+    import deepspeed_tpu
+    model = LintModel()
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+        config_params=_config(zero_optimization={"stage": 2,
+                                                 "cpu_offload": True}))
+    return eng, _sample_batch()
+
+
+def _build_pipeline():
+    # instruction executor, not SPMD: differentiating through the SPMD
+    # executor's shard_map needs jax >= 0.5 (tests/unit/oldjax.py), and the
+    # registry must capture the same programs on every supported jax. The
+    # per-stage local jits are the instruction path's real step programs.
+    import deepspeed_tpu
+    from ..parallel.pipe import LayerSpec, PipelineModule
+
+    class Dense:
+        def __init__(self, dim):
+            self.dim = dim
+
+        def init(self, rng, x):
+            return {"w": jax.random.normal(rng, (x.shape[-1], self.dim),
+                                           jnp.float32) * 0.3}
+
+        def apply(self, p, x):
+            return jnp.tanh(x.astype(p["w"].dtype) @ p["w"])
+
+    def mse(out, tgt):
+        return jnp.mean(jnp.square(out.astype(jnp.float32)
+                                   - tgt.astype(jnp.float32)))
+
+    module = PipelineModule(layers=[LayerSpec(Dense, HIDDEN) for _ in range(4)],
+                            num_stages=4, loss_fn=mse)
+    params = module.init_params(jax.random.PRNGKey(0),
+                                jnp.zeros((4, HIDDEN), jnp.float32))
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=module, model_parameters=params,
+        config_params={"train_batch_size": 64, "gradient_accumulation_steps": 2,
+                       "steps_per_print": 1000,
+                       "pipeline": {"spmd": False},
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+    if eng._spmd:
+        raise RuntimeError("lint registry: pipeline entry must stay on the "
+                           "instruction executor")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, HIDDEN)).astype(np.float32)  # one micro-batch
+    return eng, (x, np.tanh(x))
+
+
+BUILDERS = {
+    "standard": _build_standard,
+    "external_master_fused": _build_external_master_fused,
+    "external_master_accum": _build_external_master_accum,
+    "zero_offload": _build_zero_offload,
+    "pipeline": _build_pipeline,
+}
+
+
+def capture_entry(entry):
+    """[ProgramArtifact] for one registry entry, program names prefixed
+    ``entry:program``."""
+    engine, batch = BUILDERS[entry]()
+    artifacts = []
+    for name, jitted, args, manifest in engine.lint_programs(batch):
+        artifacts.append(ProgramArtifact.capture(f"{entry}:{name}", jitted,
+                                                 args, manifest))
+    return artifacts
+
+
+def capture_registry(entries=None):
+    """Artifacts for the requested entries (default: all, in name order)."""
+    names = sorted(BUILDERS) if not entries else list(entries)
+    out = []
+    for entry in names:
+        out.extend(capture_entry(entry))
+    return out

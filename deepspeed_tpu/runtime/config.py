@@ -118,6 +118,17 @@ class DeepSpeedConfig:
         self._configure_train_batch_size()
         self._do_sanity_check()
 
+    @staticmethod
+    def _warn_unknown_nested(block, block_dict, known_keys):
+        """Same unknown-key diagnostic as the top-level sweep, for a nested
+        block — a typo'd "enable" must not silently leave a subsystem off."""
+        if not isinstance(block_dict, dict):
+            return
+        unknown = sorted(k for k in block_dict if k not in known_keys)
+        if unknown:
+            logger.warning(f"DeepSpeedConfig: unknown {block} config key(s) "
+                           f"{unknown} — ignored. Known keys: {sorted(known_keys)}.")
+
     def _initialize_params(self, param_dict):
         self.train_batch_size = get_scalar_param(param_dict, TRAIN_BATCH_SIZE, TRAIN_BATCH_SIZE_DEFAULT)
         micro = get_scalar_param(param_dict, TRAIN_MICRO_BATCH_SIZE_PER_GPU, TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
@@ -222,6 +233,7 @@ class DeepSpeedConfig:
         self.tensorboard_job_name = get_scalar_param(tb_dict, TENSORBOARD_JOB_NAME, TENSORBOARD_JOB_NAME_DEFAULT)
 
         tel_dict = param_dict.get(TELEMETRY, {})
+        self._warn_unknown_nested(TELEMETRY, tel_dict, TELEMETRY_CONFIG_KEYS)
         self.telemetry_enabled = get_scalar_param(tel_dict, TELEMETRY_ENABLED, TELEMETRY_ENABLED_DEFAULT)
         self.telemetry_trace_dir = get_scalar_param(tel_dict, TELEMETRY_TRACE_DIR, TELEMETRY_TRACE_DIR_DEFAULT)
         self.telemetry_trace_steps = get_scalar_param(tel_dict, TELEMETRY_TRACE_STEPS,
@@ -247,6 +259,8 @@ class DeepSpeedConfig:
                                                       TELEMETRY_OUTPUT_PATH_DEFAULT)
         self.telemetry_job_name = get_scalar_param(tel_dict, TELEMETRY_JOB_NAME, TELEMETRY_JOB_NAME_DEFAULT)
         pt_dict = tel_dict.get(TELEMETRY_PIPELINE_TRACE, {}) or {}
+        self._warn_unknown_nested(f"{TELEMETRY}.{TELEMETRY_PIPELINE_TRACE}",
+                                  pt_dict, PIPELINE_TRACE_CONFIG_KEYS)
         self.pipeline_trace_enabled = get_scalar_param(pt_dict, PIPELINE_TRACE_ENABLED,
                                                        PIPELINE_TRACE_ENABLED_DEFAULT)
         self.pipeline_trace_capacity = get_scalar_param(pt_dict, PIPELINE_TRACE_CAPACITY,
@@ -260,6 +274,7 @@ class DeepSpeedConfig:
                                                         PIPELINE_TRACE_DUMP_DIR_DEFAULT)
 
         num_dict = param_dict.get(NUMERICS, {})
+        self._warn_unknown_nested(NUMERICS, num_dict, NUMERICS_CONFIG_KEYS)
         self.numerics_enabled = get_scalar_param(num_dict, NUMERICS_ENABLED, NUMERICS_ENABLED_DEFAULT)
         self.numerics_subtree_depth = get_scalar_param(num_dict, NUMERICS_SUBTREE_DEPTH,
                                                        NUMERICS_SUBTREE_DEPTH_DEFAULT)

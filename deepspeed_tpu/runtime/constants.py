@@ -342,3 +342,36 @@ TOP_LEVEL_CONFIG_KEYS = frozenset({
     # deprecated boolean-zero companion (zero/config.py read_zero_config_deprecated)
     ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEPRECATED,
 })
+
+# Recognized keys of the nested observability blocks. DeepSpeedConfig warns on
+# any unknown key inside these dicts just like the top-level sweep — a typo'd
+# "enable" must not silently leave a subsystem off.
+TELEMETRY_CONFIG_KEYS = frozenset({
+    TELEMETRY_ENABLED,
+    TELEMETRY_TRACE_DIR,
+    TELEMETRY_TRACE_STEPS,
+    TELEMETRY_PERTURBING_BREAKDOWN,
+    TELEMETRY_PEAK_TFLOPS,
+    TELEMETRY_MFU_WINDOW,
+    TELEMETRY_RECOMPILE_WARN,
+    TELEMETRY_OUTPUT_PATH,
+    TELEMETRY_JOB_NAME,
+    TELEMETRY_PIPELINE_TRACE,
+})
+
+PIPELINE_TRACE_CONFIG_KEYS = frozenset({
+    PIPELINE_TRACE_ENABLED,
+    PIPELINE_TRACE_CAPACITY,
+    PIPELINE_TRACE_DUMP_DIR,
+})
+
+NUMERICS_CONFIG_KEYS = frozenset({
+    NUMERICS_ENABLED,
+    NUMERICS_SUBTREE_DEPTH,
+    NUMERICS_AUDIT_INTERVAL,
+    NUMERICS_DUMP_DIR,
+    NUMERICS_RING_SIZE,
+    NUMERICS_CONSECUTIVE_SKIP_TRIGGER,
+    NUMERICS_TRIGGER_ON_NONFINITE_LOSS,
+    NUMERICS_INSTALL_SIGNAL_HANDLERS,
+})

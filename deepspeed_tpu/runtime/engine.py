@@ -1186,6 +1186,117 @@ class DeepSpeedEngine:
 
             self._run_fused_step = run_fused_std
 
+    # ------------------------------------------------------------------ lint hooks
+    @staticmethod
+    def _lint_dtype_name(dt):
+        name = jnp.dtype(dt).name
+        return {"float16": "f16", "bfloat16": "bf16", "float32": "f32"}.get(name, name)
+
+    def lint_programs(self, sample_batch):
+        """[(name, jitted, args, manifest)] for every jitted program on this
+        engine's ACTIVE step path, with the expected-collective manifest the
+        program lint passes diff against the optimized HLO (docs/lint.md).
+
+        The manifests encode the claims the bespoke HLO tests pin one path at
+        a time: ZeRO>=2 backward crosses the data axis with a reduction (and
+        with NOTHING param-scale besides it — a full-parameter all-gather here
+        is the regression the suite exists to catch), the update re-gathers
+        params only when the engine master is actually scattered, and the
+        collective dtype is exactly the resolved grad/comm dtype. Budgets
+        count only results above the small-element threshold, so scalar loss
+        pmeans and norm reductions ride free.
+        """
+        batch = tuple(x if isinstance(x, jax.Array) else self.shard_batch(x)
+                      for x in sample_batch)
+        scale = self.scaler_state.cur_scale
+        step = jnp.asarray(1, jnp.int32)
+        hyper = self.optimizer.current_hyper()
+        compute = self._lint_dtype_name(self.compute_dtype)
+        grad_dt = self._lint_dtype_name(self._grad_dtype)
+        dp = self.dp_size
+        zstage = self.zero_optimization_stage()
+        gas = self.gradient_accumulation_steps()
+
+        def grads_like(dt, shardings):
+            return jax.tree_util.tree_map(
+                lambda p, s: jax.ShapeDtypeStruct(p.shape, dt, sharding=s),
+                self.params, shardings)
+
+        # the backward's cross-data reduction rides in exactly grad_dtype
+        red = ({"min": 1, "dtypes": [grad_dt]} if dp > 1 else {"max": 0})
+        gather_gate = {"all-gather": {"min": 1, "dtypes": [compute, "f32"]}}
+        lg_man = {
+            "compute_dtype": compute,
+            "any_reduction": red,
+            # ZeRO-3 re-gathers params in forward; below stage 3 any large
+            # all-gather in the backward is an undeclared-collective violation
+            "collectives": dict(gather_gate) if zstage >= 3 else {},
+            "donation": {"check_unusable": True},
+            "strict": True,
+        }
+        local_man = {"compute_dtype": compute, "strict": True,
+                     "donation": {"check_unusable": True}}
+        progs = []
+
+        if self._offload is not None:
+            g_in = grads_like(self._grad_dtype, self._grad_shardings)
+            progs.append(("loss_and_grad", self._jit_loss_and_grad,
+                          (self.params, scale) + batch, lg_man))
+            progs.append(("grad_stats", self._jit_grad_stats, (g_in,),
+                          dict(local_man)))
+            if self._jit_offload_push is not None:
+                push_in = grads_like(self.compute_dtype, self._master_shardings)
+                progs.append(("offload_push", self._jit_offload_push, (push_in,),
+                              dict(local_man,
+                                   collectives={"all-gather": {"min": 1,
+                                                               "dtypes": [compute]}})))
+            return progs
+
+        scattered_master = (not self._external_master) and any(
+            not s.is_fully_replicated
+            for s in jax.tree_util.tree_leaves(self._master_shardings))
+
+        if self._run_fused_step is not None:
+            f_man = {"compute_dtype": compute, "any_reduction": red,
+                     "collectives": dict(gather_gate) if scattered_master else {},
+                     "donation": {"check_unusable": True}, "strict": True}
+            if self._external_master:
+                args = (self.opt_state, self.scaler_state, self.params, step,
+                        hyper) + batch
+            else:
+                args = (self.master_params, self.opt_state, self.scaler_state,
+                        self.params, step, hyper) + batch
+            progs.append(("fused_step", self._jit_fused, args, f_man))
+            return progs
+
+        progs.append(("loss_and_grad", self._jit_loss_and_grad,
+                      (self.params, scale) + batch, lg_man))
+        acc_in = grads_like(self._acc_dtype, self._grad_shardings)
+        if gas > 1:
+            g_in = grads_like(self._grad_dtype, self._grad_shardings)
+            progs.append(("accumulate", self._jit_accumulate, (acc_in, g_in),
+                          dict(local_man)))
+        au_man = {
+            "compute_dtype": compute,
+            "collectives": dict(gather_gate) if scattered_master else {},
+            "donation": {"check_unusable": True},
+            "strict": True,
+        }
+        if self._external_master:
+            # the client update is opaque: it receives ZeRO-sharded grads and
+            # may legitimately gather them onto its own master layout (the
+            # SPMD partitioner emits that as all-gathers and/or scatter+
+            # all-reduce). Constrain the wire dtype, not the op counts.
+            client_dts = sorted({grad_dt, compute, "f32"})
+            au_man["collectives"] = {"all-gather": {"dtypes": client_dts}}
+            au_man["any_reduction"] = {"dtypes": client_dts}
+            args = (self.opt_state, self.scaler_state, acc_in, step, hyper)
+        else:
+            args = (self.master_params, self.opt_state, self.scaler_state,
+                    acc_in, self.params, step, hyper)
+        progs.append(("apply_update", self._jit_apply_update, args, au_man))
+        return progs
+
     # ------------------------------------------------------------------ train API
     def shard_batch(self, batch):
         """Place a host batch on the mesh, sharded over the data axis (leading dim)."""

@@ -540,6 +540,56 @@ class PipelineEngine(DeepSpeedEngine):
 
                 self._stage_last_eval = jax.jit(last_eval)
 
+    # ------------------------------------------------------------------ lint hooks
+    def lint_programs(self, sample_batch):
+        """Pipeline manifests for the lint suite (docs/lint.md).
+
+        SPMD path: the base-engine programs, with the forward/backward budget
+        extended by the collective-permute traffic that moves activations over
+        the pipe axis (the reference's p2p.send/recv). Instruction-executor
+        path: the per-stage jits are LOCAL programs — zero large collectives
+        is the invariant — chained through ``jax.eval_shape`` so each stage's
+        input aval is the previous stage's output.
+        """
+        if self._spmd:
+            progs = []
+            for name, jitted, args, man in super().lint_programs(sample_batch):
+                if name in ("loss_and_grad", "fused_step"):
+                    man = dict(man)
+                    coll = dict(man.get("collectives", {}))
+                    coll["collective-permute"] = {"min": 1}
+                    man["collectives"] = coll
+                progs.append((name, jitted, args, man))
+            return progs
+
+        compute = self._lint_dtype_name(self.compute_dtype)
+        local_man = {"compute_dtype": compute, "strict": True,
+                     "donation": {"check_unusable": True}}
+        x = sample_batch[0]
+        labels = sample_batch[1] if len(sample_batch) > 1 else None
+
+        def sds(a):
+            a = np.asarray(a)
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        scale = self.scaler_state.cur_scale
+        progs = []
+        x_in = sds(x)
+        for s in range(self.num_stages):
+            p_s = self._select_params(s)
+            last = s == self.num_stages - 1
+            progs.append((f"stage{s}_fwd", self._stage_fwd[s], (p_s, x_in),
+                          dict(local_man)))
+            x_out = jax.eval_shape(self._stage_fwd[s], p_s, x_in)
+            if last and self._stage_last_bwd is not None and labels is not None:
+                progs.append((f"stage{s}_last_bwd", self._stage_last_bwd,
+                              (p_s, x_in, sds(labels), scale), dict(local_man)))
+            else:
+                progs.append((f"stage{s}_bwd", self._stage_bwd[s],
+                              (p_s, x_in, x_out), dict(local_man)))
+            x_in = x_out
+        return progs
+
     # ------------------------------------------------------------- blocked base API
     def forward(self, *args, **kwargs):
         raise PipelineError("Only train_batch() is accessible in pipeline mode.")

@@ -1,11 +1,15 @@
-"""Optimized-HLO collective inspection.
+"""Optimized-HLO inspection: collectives, aliasing, entry layout.
 
 The framework's multi-chip claims are of the form "XLA emits the collective the
 reference called NCCL/MPI for" (zero/sharding.py, pipeline_spmd.py, ring_attention.py,
 custom_collectives.py). This module is the shared audit surface for that claim: it
 parses a compiled program's text for collective instructions so tests
-(tests/unit/test_collectives_hlo.py), the driver dry-run (__graft_entry__.py), and
-users debugging shardings can count them and account wire bytes from ONE parser.
+(tests/unit/test_collectives_hlo.py), the driver dry-run (__graft_entry__.py), the
+program lint passes (deepspeed_tpu/lint/program_passes.py) and users debugging
+shardings can count them and account wire bytes from ONE parser. The lint suite
+additionally needs the module-header facts — ``input_output_alias`` (which donations
+XLA actually honored) and ``entry_computation_layout`` (parameter/result types) —
+parsed here for the same single-parser reason.
 """
 
 import re
@@ -15,12 +19,42 @@ COLLECTIVE_OPS = ("all-to-all", "all-gather", "all-reduce", "reduce-scatter",
                   "collective-permute")
 
 # `%name = TYPE op(...)` where TYPE is a shaped type or a tuple of them
-# (all-to-all returns a tuple). Matches the -start variants' base names too.
-_OP_RE = re.compile(r"= (\([^)]*\)|\S+) (" + "|".join(COLLECTIVE_OPS) + r")\(")
+# (all-to-all returns a tuple). The optional ``-start`` suffix folds the async
+# variants into their base op: ``all-gather-start`` IS the program's all-gather
+# (the paired ``-done`` carries no transfer of its own and is never matched —
+# counting both would double-book the wire).
+_OP_RE = re.compile(r"= (\([^)]*\)|\S+) (" + "|".join(COLLECTIVE_OPS) +
+                    r")(-start)?\(")
 
-_DTYPE_BYTES = {"s8": 1, "u8": 1, "pred": 1, "s16": 2, "u16": 2, "bf16": 2,
-                "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
-                "f64": 8}
+_DTYPE_BYTES = {"s4": 1, "u4": 1, "s8": 1, "u8": 1, "pred": 1,
+                "f8e4m3": 1, "f8e4m3fn": 1, "f8e4m3b11fnz": 1, "f8e4m3fnuz": 1,
+                "f8e5m2": 1, "f8e5m2fnuz": 1, "f8e3m4": 1,
+                "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
+                "s32": 4, "u32": 4, "f32": 4,
+                "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16}
+
+_SHAPED_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+
+
+def dtype_bytes(dt):
+    """Bytes per element of an HLO element-type string, or None if unknown."""
+    return _DTYPE_BYTES.get(dt)
+
+
+def _shaped_types(type_str):
+    """[(dtype, (dims...))] for every shaped type inside ``type_str`` (tuples
+    flattened; scalars yield empty dims)."""
+    out = []
+    for dt, dims in _SHAPED_RE.findall(type_str):
+        out.append((dt, tuple(int(d) for d in dims.split(",") if d)))
+    return out
+
+
+def _elements(dims):
+    n = 1
+    for d in dims:
+        n *= d
+    return n
 
 
 # one HLO instruction per `name = type op(...)` line (ROOT-prefixed or not);
@@ -40,21 +74,52 @@ def optimized_hlo(jitted, *args):
     return jitted.lower(*args).compile().as_text()
 
 
+def _collective_matches(hlo_text):
+    """(result_type, base_op, is_start) per collective instruction."""
+    return [(ty, op, bool(start)) for ty, op, start in _OP_RE.findall(hlo_text)]
+
+
 def collective_counts(hlo_text):
-    """{collective op name -> instruction count} over the optimized HLO."""
+    """{collective op name -> instruction count} over the optimized HLO.
+    Async ``-start`` variants count under their base op name."""
     counts = Counter()
-    for _result_ty, op in _OP_RE.findall(hlo_text):
+    for _result_ty, op, _start in _collective_matches(hlo_text):
         counts[op] += 1
     return dict(counts)
 
 
-def collective_result_types(hlo_text, op):
-    """Element-type strings of every ``op`` instruction's results (tuples flattened)."""
+def _result_shapes(result_ty, op, is_start):
+    """Shaped result types of one collective, skipping the bookkeeping an async
+    ``-start`` carries. ``all-gather-start`` / ``collective-permute-start``
+    return ``(operands..., results...[, u32 context scalars])`` — only the
+    produced half is the transfer; ``all-reduce-start`` (and any untupled
+    start) returns its results directly."""
+    shaped = _shaped_types(result_ty)
+    if (is_start and result_ty.startswith("(") and len(shaped) > 1
+            and op in ("all-gather", "collective-permute")):
+        shaped = [s for s in shaped
+                  if not (s[1] == () and s[0] in ("u32", "s32"))]
+        return shaped[len(shaped) // 2:]
+    return shaped
+
+
+def collective_results(hlo_text, op=None):
+    """[(op, dtype, dims tuple)] of every collective instruction's produced
+    results (tuples flattened, async operand echoes skipped). ``op`` filters to
+    one base op name."""
     out = []
-    for result_ty, found in _OP_RE.findall(hlo_text):
-        if found == op:
-            out.extend(re.findall(r"([a-z0-9]+)\[", result_ty))
+    for result_ty, found, is_start in _collective_matches(hlo_text):
+        if op is not None and found != op:
+            continue
+        for dt, dims in _result_shapes(result_ty, found, is_start):
+            out.append((found, dt, dims))
     return out
+
+
+def collective_result_types(hlo_text, op):
+    """Element-type strings of every ``op`` instruction's results (tuples
+    flattened; async ``-start`` variants report their produced buffers only)."""
+    return [dt for _op, dt, _dims in collective_results(hlo_text, op)]
 
 
 def collective_bytes(hlo_text):
@@ -62,13 +127,160 @@ def collective_bytes(hlo_text):
     instruction, bytes = result size (what each participant receives). The basis
     for the 1-bit Adam comm-volume accounting in PERF.md."""
     total = 0
-    for result_ty, _op in _OP_RE.findall(hlo_text):
-        for dt, dims in re.findall(r"([a-z0-9]+)\[([0-9,]*)\]", result_ty):
-            if dt not in _DTYPE_BYTES:
-                continue
-            n = 1
-            for d in dims.split(","):
-                if d:
-                    n *= int(d)
-            total += n * _DTYPE_BYTES[dt]
+    for _op, dt, dims in collective_results(hlo_text):
+        if dt not in _DTYPE_BYTES:
+            continue
+        total += _elements(dims) * _DTYPE_BYTES[dt]
     return total
+
+
+# --------------------------------------------------------------------- lint surface
+# The module header of an optimized program names which donations XLA actually
+# honored: `input_output_alias={ {out_idx}: (param_number, {param_idx}, kind) }`.
+_ALIAS_HEADER_RE = re.compile(r"input_output_alias=\{((?:[^{}]|\{[^}]*\})*)\}")
+_ALIAS_ENTRY_RE = re.compile(r"\{([0-9, ]*)\}:\s*\((\d+),\s*\{([0-9, ]*)\},\s*([\w-]+)\)")
+
+
+def input_output_aliases(hlo_text):
+    """{param_number -> [(output_index, param_index, kind)]} from the module
+    header; empty when the program aliases nothing (the header is then absent)."""
+    m = _ALIAS_HEADER_RE.search(hlo_text)
+    if not m:
+        return {}
+    out = {}
+
+    def idx(s):
+        return tuple(int(x) for x in s.replace(" ", "").split(",") if x)
+
+    for out_idx, param, param_idx, kind in _ALIAS_ENTRY_RE.findall(m.group(1)):
+        out.setdefault(int(param), []).append((idx(out_idx), idx(param_idx), kind))
+    return out
+
+
+def _entry_layout_body(hlo_text):
+    """'(params...)->result' body of the entry_computation_layout header, via a
+    balanced-brace scan (layout annotations like ``{1,0}`` nest braces)."""
+    marker = "entry_computation_layout={"
+    start = hlo_text.find(marker)
+    if start < 0:
+        return None
+    i, depth = start + len(marker), 1
+    while i < len(hlo_text) and depth:
+        if hlo_text[i] == "{":
+            depth += 1
+        elif hlo_text[i] == "}":
+            depth -= 1
+        i += 1
+    return hlo_text[start + len(marker):i - 1]
+
+
+def _split_top_level(s):
+    """Split a type-tuple body on top-level commas (layout braces `{1,0}` and
+    nested tuples carry commas of their own)."""
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch in "({[":
+            depth += 1
+        elif ch in ")}]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append("".join(cur))
+    return [p.strip() for p in parts if p.strip()]
+
+
+def entry_parameter_types(hlo_text):
+    """[(dtype, dims)] per entry parameter (one entry per parameter, in param-
+    number order; a tuple-typed parameter reports its first shaped leaf)."""
+    body = _entry_layout_body(hlo_text)
+    if body is None or "->" not in body:
+        return []
+    params = body.split("->", 1)[0].strip()
+    if params.startswith("(") and params.endswith(")"):
+        params = params[1:-1]
+    out = []
+    for part in _split_top_level(params):
+        shaped = _shaped_types(part)
+        out.append(shaped[0] if shaped else (part, ()))
+    return out
+
+
+def entry_result_types(hlo_text):
+    """[(dtype, dims)] of the entry computation's results (tuple flattened)."""
+    body = _entry_layout_body(hlo_text)
+    if body is None or "->" not in body:
+        return []
+    return _shaped_types(body.split("->", 1)[1])
+
+
+_F32_DOT_RE = re.compile(r"%?([\w.-]+) = f32\[[^\]]*\][^ ]* dot\(([^)]*)\)")
+# optimized HLO annotates operands inline (`convert(bf16[8]{0} %x)`); the
+# pre-backend module the dtype lint reads writes bare names (`convert(x.4)`),
+# so the operand's source dtype comes from the inline annotation when present
+# and the defining instruction otherwise.
+_CONVERT_RE = re.compile(
+    r"%?([\w.-]+) = ([a-z0-9]+)\[[^\]]*\][^ ]* convert\("
+    r"(?:([a-z0-9]+)\[[^\]]*\][^ ]* )?%?([\w.-]+)\)")
+_DEF_RE = re.compile(r"^\s*(?:ROOT )?%?([\w.-]+) = ([a-z0-9]+)\[", re.M)
+
+
+def _definition_dtypes(hlo_text):
+    """{instruction name: result element type} over every definition line."""
+    return dict(_DEF_RE.findall(hlo_text))
+
+
+def _convert_table(hlo_text):
+    """{result name: (src dtype, dst dtype, operand name)} for every convert."""
+    defs = None
+    out = {}
+    for name, dst, src, operand in _CONVERT_RE.findall(hlo_text):
+        if not src:
+            if defs is None:
+                defs = _definition_dtypes(hlo_text)
+            src = defs.get(operand, "")
+        if src:
+            out[name] = (src, dst, operand)
+    return out
+
+
+def f32_dots_with_lowp_operands(hlo_text, lowp=("bf16", "f16")):
+    """[(dot name, [operand names converted from a low-precision dtype])] for
+    every f32 dot at least one of whose operands is the direct result of a
+    convert from ``lowp``. The dtype-promotion lint's primary probe: inside a
+    declared low-precision compute region, such a dot means XLA (or the traced
+    program) silently promoted a matmul the author believed ran on the
+    low-precision MXU path."""
+    lowp_converts = {name for name, (src, _dst, _op) in
+                     _convert_table(hlo_text).items() if src in lowp}
+    hits = []
+    for dot_name, operands in _F32_DOT_RE.findall(hlo_text):
+        names = [tok.split()[-1].lstrip("%")
+                 for tok in operands.split(",") if tok.strip()]
+        promoted = [n for n in names if n in lowp_converts]
+        if promoted:
+            hits.append((dot_name, promoted))
+    return hits
+
+
+def lossy_convert_roundtrips(hlo_text):
+    """[(first convert name, dtype chain)] for convert pairs d1 -> d2 -> d1
+    where the intermediate d2 is NARROWER than d1: a value made a lossy round
+    trip (each such pair silently truncates mantissa and usually marks a dtype
+    boundary drawn in the wrong place)."""
+    converts = _convert_table(hlo_text)
+    hits = []
+    for name, (src, dst, operand) in sorted(converts.items()):
+        up = converts.get(operand)
+        if up is None:
+            continue
+        src0, dst0, _ = up
+        if src0 == dst and dst0 == src:  # d1 -> d2 (=src) -> d1 (=dst)
+            b_mid = _DTYPE_BYTES.get(src, 0) or 0
+            b_end = _DTYPE_BYTES.get(dst, 0) or 0
+            if b_mid and b_end and b_mid < b_end:
+                hits.append((operand, (dst, src, dst)))
+    return hits

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Static-analysis gate: `ds-tpu lint --json` over the whole package (AST
+# passes) and the representative engine registry (program passes on
+# AOT-lowered HLO). Exits nonzero on any non-allowlisted violation OR any
+# stale allowlist entry, so CI fails closed in both directions.
+#
+# The JSON report lands in /tmp/_lint.json (deterministic bytes — diff two
+# runs to prove a change is lint-neutral). Environment is pinned to the same
+# 8-virtual-device CPU mesh the tier-1 tests use; `bin/ds-tpu lint` re-pins
+# it too, so running this on a TPU host is safe.
+#
+# tests/unit/test_lint_programs.py::test_shipped_registry_lints_clean and
+# tests/unit/test_lint_ast.py::test_package_ast_baseline_is_clean_modulo_shipped_allowlist
+# run the same two surfaces inside tier-1; this script is the standalone CLI
+# entry for CI pipelines that want the JSON artifact.
+set -o pipefail
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+# deterministic JSON report on stdout (CI log) and in the --out artifact;
+# engine-build INFO lines go to stderr so stdout stays parseable
+exec timeout -k 10 300 "$REPO/bin/ds-tpu" lint --json --out /tmp/_lint.json

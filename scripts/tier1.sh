@@ -4,4 +4,9 @@
 # key off this line. `-m 'not slow'` plus pytest's default test-file pattern
 # (test_*.py / *_test.py) means nothing under tests/perf/ is ever collected
 # here — tests/unit/test_tier1_collection.py guards that invariant.
+# The static-analysis gate rides along inside this run: tests/unit/
+# test_lint_programs.py::test_shipped_registry_lints_clean and the AST
+# baseline test in test_lint_ast.py execute the same passes `ds-tpu lint`
+# runs. scripts/lint.sh is the standalone CLI variant (emits the JSON
+# report for CI artifact upload); it needs no separate tier-1 slot.
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

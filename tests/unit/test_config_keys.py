@@ -160,6 +160,33 @@ def test_unknown_top_level_key_warns(capture):
     assert "definitely_not_a_key" in capture.text
 
 
+def test_unknown_telemetry_key_warns(capture):
+    _cfg(telemetry={"enabled": True, "trace_stepz": [2, 5]})
+    assert "unknown telemetry config key" in capture.text
+    assert "trace_stepz" in capture.text
+    # the known-keys hint points at the fix
+    assert "trace_steps" in capture.text
+
+
+def test_unknown_pipeline_trace_key_warns(capture):
+    _cfg(telemetry={"pipeline_trace": {"enabled": True, "capactiy": 7}})
+    assert "unknown telemetry.pipeline_trace config key" in capture.text
+    assert "capactiy" in capture.text
+
+
+def test_unknown_numerics_key_warns(capture):
+    _cfg(numerics={"enabled": True, "ring_sz": 4})
+    assert "unknown numerics config key" in capture.text
+    assert "ring_sz" in capture.text
+
+
+def test_known_nested_keys_do_not_warn(capture):
+    _cfg(telemetry={"enabled": True, "trace_steps": [2, 5],
+                    "pipeline_trace": {"enabled": True, "capacity": 7}},
+         numerics={"enabled": True, "audit_interval": 3})
+    assert "unknown" not in capture.text
+
+
 def test_deprecated_boolean_zero_reads_allgather_size(capture):
     cfg = _cfg(zero_optimization=True, allgather_size=123456)
     assert cfg.zero_optimization_stage == 1

@@ -1,0 +1,194 @@
+"""AST lint passes: host-sync, tracer-hostile, recompile-hazard, config-keys,
+plus the violation/allowlist/report model they all share.
+
+Synthetic-module tests write small files to tmp_path and assert each pass
+fires exactly where it should (and nowhere else — scoping to the jitted
+closure is the part that rots). Repo-level tests pin the live baseline:
+the whole package must stay clean modulo the shipped allowlist.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.lint.ast_passes import (HostSyncPass, RecompileHazardPass,
+                                           TracerHostilePass, run_ast_passes)
+from deepspeed_tpu.lint.config_pass import ConfigKeysPass, declared_key_constants
+from deepspeed_tpu.lint.model import Allowlist, LintReport, Violation
+
+PKG = os.path.dirname(os.path.abspath(deepspeed_tpu.__file__))
+
+
+def _write(tmp_path, name, source):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return str(p)
+
+
+# ------------------------------------------------------------------ host-sync
+def test_host_sync_pass_flags_all_three_primitives(tmp_path):
+    f = _write(tmp_path, "mod.py", """
+        import jax
+        import numpy as np
+
+        def fetch(x):
+            host = jax.device_get(x)
+            x.block_until_ready()
+            return np.asarray(x)
+    """)
+    rules = sorted(v.rule for v in run_ast_passes([f], (HostSyncPass(),),
+                                                  root=str(tmp_path)))
+    assert rules == ["block-until-ready", "device-get", "np-asarray"]
+
+
+def test_host_sync_subjects_are_repo_relative_qualnames(tmp_path):
+    f = _write(tmp_path, "mod.py", """
+        import jax
+
+        class Session:
+            def end(self, x):
+                return jax.device_get(x)
+    """)
+    (v,) = run_ast_passes([f], (HostSyncPass(),), root=str(tmp_path))
+    assert v.vid == "ast-host-sync:device-get:mod.py::Session.end"
+
+
+# ------------------------------------------------------------- tracer-hostile
+def test_tracer_hostile_only_fires_inside_jitted_closure(tmp_path):
+    f = _write(tmp_path, "mod.py", """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return helper(x)
+
+        def helper(x):
+            return float(x)        # reached from a jit root -> flagged
+
+        def host_only(x):
+            return float(x)        # never jitted -> fine
+    """)
+    vs = run_ast_passes([f], (TracerHostilePass(),), root=str(tmp_path))
+    assert [v.vid for v in vs] == ["ast-tracer-hostile:host-cast:mod.py::helper"]
+
+
+def test_tracer_hostile_sees_jit_call_sites_and_item(tmp_path):
+    f = _write(tmp_path, "mod.py", """
+        import jax
+
+        def compiled(x):
+            return x.item()
+
+        run = jax.jit(compiled)
+    """)
+    vs = run_ast_passes([f], (TracerHostilePass(),), root=str(tmp_path))
+    assert [v.vid for v in vs] == ["ast-tracer-hostile:item-call:mod.py::compiled"]
+
+
+def test_tracer_hostile_ignores_literal_casts(tmp_path):
+    f = _write(tmp_path, "mod.py", """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * int("42")   # constant-arg cast: concrete at trace time
+    """)
+    assert run_ast_passes([f], (TracerHostilePass(),), root=str(tmp_path)) == []
+
+
+# ------------------------------------------------------------ recompile-hazard
+def test_recompile_hazard_flags_time_in_traced_code(tmp_path):
+    f = _write(tmp_path, "mod.py", """
+        import jax
+        import time
+
+        @jax.jit
+        def step(x):
+            return x + time.time()
+    """)
+    vs = run_ast_passes([f], (RecompileHazardPass(),), root=str(tmp_path))
+    assert [v.rule for v in vs] == ["nondeterminism-in-trace"]
+
+
+def test_recompile_hazard_flags_unhashable_static_default(tmp_path):
+    f = _write(tmp_path, "mod.py", """
+        import jax
+        from functools import partial
+
+        def step(x, cfg=[1, 2]):
+            return x
+
+        run = jax.jit(step, static_argnums=(1,))
+    """)
+    vs = run_ast_passes([f], (RecompileHazardPass(),), root=str(tmp_path))
+    assert [v.rule for v in vs] == ["unhashable-static"]
+    assert vs[0].subject.endswith("::step#cfg")
+
+
+# ------------------------------------------------------------------ config keys
+def test_every_declared_config_key_is_reachable():
+    """Satellite check: every NAME/NAME_DEFAULT pair in runtime/constants.py
+    must be referenced from a config-consuming module — a key users can set
+    that nothing reads is the silent no-op the sweep exists to prevent."""
+    vs = ConfigKeysPass(PKG).run()
+    assert vs == [], "\n".join(v.message for v in vs)
+
+
+def test_declared_key_constants_sees_the_real_registry():
+    keys = declared_key_constants(os.path.join(PKG, "runtime", "constants.py"))
+    assert "TRAIN_BATCH_SIZE" in keys and keys["TRAIN_BATCH_SIZE"] == "train_batch_size"
+    assert "NUMERICS_RING_SIZE" in keys
+    # paired _DEFAULT is what marks a config key; bare strings don't count
+    assert "TELEMETRY" not in keys  # block name, no TELEMETRY_DEFAULT
+
+
+# ------------------------------------------------------------- repo baseline
+def test_package_ast_baseline_is_clean_modulo_shipped_allowlist():
+    """The live repo, exactly as `ds-tpu lint` sees it: zero non-allowlisted
+    AST violations, zero stale allowlist entries."""
+    from deepspeed_tpu.lint.cli import _DEFAULT_ALLOWLIST, run_ast_surface
+    allowlist = Allowlist.load(_DEFAULT_ALLOWLIST)
+    report = LintReport()
+    run_ast_surface(report, allowlist, package_dir=PKG)
+    report.finish(allowlist)
+    assert report.violations == [], "\n".join(v.vid for v in report.violations)
+    assert report.unused_allow == []
+
+
+# ------------------------------------------------------------ model semantics
+def test_violation_id_and_dict_shape():
+    v = Violation("p", "r", "s", "msg", details={"n": 1})
+    assert v.vid == "p:r:s"
+    d = v.to_dict()
+    assert d["id"] == "p:r:s" and d["details"] == {"n": 1}
+
+
+def test_allowlist_requires_reason_and_tracks_unused():
+    with pytest.raises(ValueError):
+        Allowlist([{"id": "a:*"}])
+    al = Allowlist([{"id": "a:*", "reason": "x"}, {"id": "b:*", "reason": "y"}])
+    assert al.match("a:r:s") is not None
+    assert al.match("c:r:s") is None
+    assert al.unused() == ["b:*"]
+
+
+def test_report_json_is_sorted_and_stable():
+    def build(order):
+        r = LintReport()
+        for s in order:
+            r.add(Violation("p", "r", s, f"msg {s}"))
+        r.passes = ["z", "a"]
+        r.finish()
+        return r.to_json()
+
+    a = build(["s2", "s1", "s3"])
+    b = build(["s1", "s3", "s2"])
+    assert a == b
+    parsed = json.loads(a)
+    subjects = [v["subject"] for v in parsed["violations"]]
+    assert subjects == sorted(subjects)
+    assert parsed["passes"] == ["a", "z"]
+    assert parsed["summary"]["failed"] is True

@@ -1,0 +1,171 @@
+"""Program-pass lint: seeded violations, golden report, registry clean gate.
+
+Three fixtures each break exactly one invariant the program passes exist to
+catch, and the full report over all three is pinned byte-for-byte against
+``tests/unit/golden/lint_seeded_violations.json`` — the report format is a
+contract (CI parses it), so a formatting or ordering change must show up as
+a golden diff, not silently.
+
+The clean gate at the bottom is the tier-1 CI hook for `ds-tpu lint`: the
+shipped registry must produce zero non-allowlisted violations.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.lint.model import Allowlist, LintReport
+from deepspeed_tpu.lint.program_passes import (ProgramArtifact,
+                                               run_program_passes)
+from deepspeed_tpu.parallel.mesh import DATA_AXIS, build_mesh
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden",
+                      "lint_seeded_violations.json")
+
+
+# ----------------------------------------------------------------- fixtures
+def seeded_broken_donation():
+    """Donates a buffer XLA cannot alias: the donated f32 input only flows
+    into a bf16 output (half the bytes), so the donation is a no-op and the
+    donation pass must call it out."""
+    f = jax.jit(lambda x: (x * 2).astype(jnp.bfloat16), donate_argnums=(0,))
+    x = jnp.ones((64, 64), jnp.float32)
+    manifest = {"donation": {"check_unusable": True}, "strict": True}
+    return ProgramArtifact.capture("seeded_broken_donation", f, (x,), manifest)
+
+
+def seeded_full_gather():
+    """A ZeRO-style program whose output sharding silently re-replicates a
+    data-sharded input: the partitioner must emit a full-param all-gather,
+    and the strict manifest (which budgets only the reduction) flags it as
+    undeclared."""
+    mesh = build_mesh(data=8)
+    sharded = NamedSharding(mesh, P(DATA_AXIS))
+    replicated = NamedSharding(mesh, P())
+
+    @lambda fn: jax.jit(fn, out_shardings=(replicated, replicated))
+    def step(w, g):
+        # the reduction every manifest expects... plus the injected gather:
+        # the replicated out_sharding on `w_new` forces gathering the sharded w
+        gsum = jax.lax.with_sharding_constraint(g, replicated)
+        return w - 0.1 * gsum, gsum
+
+    w = jax.device_put(np.ones((4096,), np.float32), sharded)
+    g = jax.device_put(np.ones((4096,), np.float32), replicated)
+    manifest = {"collectives": {}, "strict": True,
+                "donation": {"check_unusable": False}}
+    return ProgramArtifact.capture("seeded_full_gather", step, (w, g), manifest)
+
+
+def seeded_fp32_leak():
+    """A bf16 MLP with one mid-chain .astype(f32) matmul — the silent
+    promotion the dtype pass exists to catch (the dot runs off the
+    low-precision MXU path and doubles its flops and activation bytes)."""
+    @jax.jit
+    def f(w1, w2, x):
+        h = jnp.tanh(x @ w1)
+        h32 = h.astype(jnp.float32)          # the leak
+        out = h32 @ w2.astype(jnp.float32)
+        return out.astype(jnp.bfloat16)
+
+    w = jnp.ones((32, 32), jnp.bfloat16)
+    x = jnp.ones((8, 32), jnp.bfloat16)
+    manifest = {"compute_dtype": "bf16", "strict": True,
+                "donation": {"check_unusable": False}}
+    return ProgramArtifact.capture("seeded_fp32_leak", f, (w, w, x), manifest)
+
+
+def _seeded_report():
+    artifacts = [seeded_broken_donation(), seeded_full_gather(),
+                 seeded_fp32_leak()]
+    report = LintReport()
+    report.programs += [a.name for a in artifacts]
+    report.extend(run_program_passes(artifacts))
+    report.finish()
+    return report
+
+
+# ------------------------------------------------------- per-fixture checks
+def test_broken_donation_is_caught_by_the_donation_pass():
+    vs = run_program_passes([seeded_broken_donation()])
+    vids = {v.vid for v in vs}
+    assert "program-donation:unusable-donation:seeded_broken_donation#arg0" in vids
+    assert all(v.pass_id == "program-donation" for v in vs), vids
+
+
+def test_injected_all_gather_is_caught_as_undeclared_collective():
+    vs = run_program_passes([seeded_full_gather()])
+    vids = {v.vid for v in vs}
+    assert ("program-collectives:undeclared-collective:"
+            "seeded_full_gather#all-gather") in vids
+
+
+def test_fp32_leak_is_caught_by_the_dtype_pass():
+    vs = run_program_passes([seeded_fp32_leak()])
+    by_rule = {}
+    for v in vs:
+        by_rule.setdefault(v.rule, []).append(v)
+    assert "f32-dot-in-lowp-region" in by_rule, {v.vid for v in vs}
+    assert by_rule["f32-dot-in-lowp-region"][0].subject == "seeded_fp32_leak#dot0"
+
+
+def test_each_fixture_trips_only_its_own_pass():
+    """Seeds must be surgical: fixture A's violation set never bleeds into
+    pass B (that would mean the passes overlap and vids are ambiguous)."""
+    expected_pass = {"seeded_broken_donation": "program-donation",
+                     "seeded_full_gather": "program-collectives",
+                     "seeded_fp32_leak": "program-dtype"}
+    for fixture, pass_id in expected_pass.items():
+        art = {"seeded_broken_donation": seeded_broken_donation,
+               "seeded_full_gather": seeded_full_gather,
+               "seeded_fp32_leak": seeded_fp32_leak}[fixture]()
+        for v in run_program_passes([art]):
+            assert v.pass_id == pass_id, f"{fixture} leaked into {v.vid}"
+
+
+# ------------------------------------------------------------------- golden
+def test_seeded_report_matches_golden_bytes():
+    """The full JSON report over all three seeds, byte-for-byte. Regenerate
+    with: python tests/unit/test_lint_programs.py --regen"""
+    text = _seeded_report().to_json()
+    with open(GOLDEN) as f:
+        golden = f.read()
+    assert text == golden, "lint report drifted from golden (see --regen)"
+
+
+def test_seeded_report_is_deterministic_across_runs():
+    assert _seeded_report().to_json() == _seeded_report().to_json()
+
+
+# -------------------------------------------------------- registry clean gate
+def test_shipped_registry_lints_clean():
+    """THE CI gate: every program on every registry engine's active step path
+    passes donation/collective/dtype lint with zero non-allowlisted
+    violations, and no shipped allowlist entry is stale on the program side."""
+    from deepspeed_tpu.lint import registry
+    from deepspeed_tpu.lint.cli import _DEFAULT_ALLOWLIST
+
+    allowlist = Allowlist.load(_DEFAULT_ALLOWLIST)
+    report = LintReport()
+    for entry in sorted(registry.BUILDERS):
+        artifacts = registry.capture_entry(entry)
+        assert artifacts, f"registry entry {entry} produced no programs"
+        report.programs += [a.name for a in artifacts]
+        report.extend(run_program_passes(artifacts), allowlist)
+    report.finish(allowlist)
+    assert not report.failed, "\n".join(
+        f"{v.vid}: {v.message}" for v in report.violations)
+
+
+if __name__ == "__main__":
+    import sys
+    if "--regen" in sys.argv:
+        os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
+        with open(GOLDEN, "w") as f:
+            f.write(_seeded_report().to_json())
+        print(f"wrote {GOLDEN}")

@@ -1,90 +1,75 @@
-"""Static no-host-sync guard for the observability modules.
+"""Static no-host-sync guard for the observability tier (utils/).
 
-The telemetry and numerics subsystems promise to add NO host synchronization
-to the training step beyond the loss fetch the engine already performs. That
-promise is easy to erode one innocent-looking ``device_get`` at a time, so
-this test enforces it STATICALLY: it AST-scans utils/telemetry.py and
-utils/numerics.py for the blocking primitives (``device_get``,
-``block_until_ready``, ``np.asarray`` on device arrays) and pins the complete
-allowlist of occurrences. A new fetch anywhere else is a test failure, not a
-code review hope.
+The telemetry, numerics and pipeline-trace subsystems promise to add NO host
+synchronization to the training step beyond the loss fetch the engine already
+performs. That promise is easy to erode one innocent-looking ``device_get``
+at a time, so this test enforces it STATICALLY — and since PR 6 it is a thin
+wrapper over the lint framework's :class:`HostSyncPass` (the same pass
+``ds-tpu lint`` runs), pinned to the same shipped allowlist, so the guard and
+the linter cannot drift. Coverage is ALL of ``deepspeed_tpu/utils/``, not the
+original three modules.
 """
 
-import ast
 import os
 
-import deepspeed_tpu.utils.numerics as numerics_mod
-import deepspeed_tpu.utils.pipeline_trace as pipeline_trace_mod
-import deepspeed_tpu.utils.telemetry as telemetry_mod
+import deepspeed_tpu
+from deepspeed_tpu.lint.ast_passes import HostSyncPass, run_ast_passes
+from deepspeed_tpu.lint.model import Allowlist
 
-FORBIDDEN_ATTRS = ("device_get", "block_until_ready")
-FORBIDDEN_NUMPY = ("asarray",)
+PKG = os.path.dirname(os.path.abspath(deepspeed_tpu.__file__))
+ROOT = os.path.dirname(PKG)
+UTILS = os.path.join(PKG, "utils")
 
-
-def _scan(module):
-    """Return [(qualname, primitive)] for every forbidden call-ish reference."""
-    src = open(module.__file__).read()
-    tree = ast.parse(src, filename=module.__file__)
-    hits = []
-
-    class Scanner(ast.NodeVisitor):
-        def __init__(self):
-            self.stack = []
-
-        def _qual(self):
-            return ".".join(self.stack) or "<module>"
-
-        def visit_FunctionDef(self, node):
-            self.stack.append(node.name)
-            self.generic_visit(node)
-            self.stack.pop()
-
-        visit_AsyncFunctionDef = visit_FunctionDef
-
-        def visit_ClassDef(self, node):
-            self.stack.append(node.name)
-            self.generic_visit(node)
-            self.stack.pop()
-
-        def visit_Attribute(self, node):
-            if node.attr in FORBIDDEN_ATTRS:
-                hits.append((self._qual(), node.attr))
-            elif node.attr in FORBIDDEN_NUMPY and isinstance(node.value, ast.Name) \
-                    and node.value.id in ("np", "numpy"):
-                hits.append((self._qual(), f"{node.value.id}.{node.attr}"))
-            self.generic_visit(node)
-
-    Scanner().visit(tree)
-    return hits
+# the complete sanctioned set — identical to deepspeed_tpu/lint/allowlist.json
+PINNED = {
+    "ast-host-sync:device-get:deepspeed_tpu/utils/telemetry.py::TelemetrySession.end_step",
+    "ast-host-sync:np-asarray:deepspeed_tpu/utils/telemetry.py::_abstract_signature",
+}
 
 
-def test_numerics_module_never_syncs():
-    """utils/numerics.py is pure in-graph builders + host-side bookkeeping on
-    ALREADY-FETCHED values: zero blocking primitives allowed."""
-    assert _scan(numerics_mod) == []
+def _utils_files():
+    out = []
+    for dirpath, _dirs, files in os.walk(UTILS):
+        out += [os.path.join(dirpath, f) for f in files if f.endswith(".py")]
+    assert len(out) >= 8, "utils/ sweep looks truncated"
+    return sorted(out)
 
 
-def test_pipeline_trace_module_never_syncs():
-    """utils/pipeline_trace.py records host timestamps at boundaries the
-    executor already crosses: zero blocking primitives, zero exceptions."""
-    assert _scan(pipeline_trace_mod) == []
+def _scan():
+    return run_ast_passes(_utils_files(), (HostSyncPass(),), root=ROOT)
 
 
-def test_telemetry_module_sync_allowlist_is_exact():
-    """utils/telemetry.py gets exactly two occurrences: the end_step loss-ride
-    fetch (the one sanctioned block per step) and the np.asarray inside the
-    abstract-signature helper (operates on shapes, not device buffers)."""
-    hits = _scan(telemetry_mod)
-    allowed = {
-        ("TelemetrySession.end_step", "device_get"),
-        ("_abstract_signature", "np.asarray"),
-    }
-    assert set(hits) <= allowed, f"new host-sync primitive introduced: {set(hits) - allowed}"
+def test_utils_sync_allowlist_is_exact():
+    """Every host-sync primitive in utils/ must be one of the two sanctioned
+    occurrences; anything new is a failure, not a code-review hope."""
+    vids = {v.vid for v in _scan()}
+    assert vids <= PINNED, f"new host-sync primitive introduced: {vids - PINNED}"
     # the sanctioned fetch must still exist (the scan itself stays honest)
-    assert ("TelemetrySession.end_step", "device_get") in hits
+    assert ("ast-host-sync:device-get:deepspeed_tpu/utils/telemetry.py"
+            "::TelemetrySession.end_step") in vids
+
+
+def test_guard_agrees_with_shipped_allowlist():
+    """The CLI's allowlist.json and this guard pin the SAME facts: every
+    host-sync vid found in utils/ must be covered by the shipped allowlist,
+    and the shipped host-sync entries must all still match something."""
+    allow = Allowlist.load(os.path.join(PKG, "lint", "allowlist.json"))
+    for v in _scan():
+        assert allow.match(v.vid) is not None, f"not in shipped allowlist: {v.vid}"
+    stale = [g for g in allow.unused() if g.startswith("ast-host-sync:")]
+    assert stale == [], f"stale host-sync allowlist entries: {stale}"
+
+
+def test_pass_reports_occurrence_counts():
+    """end_step holds two sanctioned fetch sites; the pass dedupes to one
+    violation per (rule, subject) and carries the count in details."""
+    by_vid = {v.vid: v for v in _scan()}
+    v = by_vid["ast-host-sync:device-get:deepspeed_tpu/utils/telemetry.py"
+               "::TelemetrySession.end_step"]
+    assert v.details["occurrences"] >= 1
 
 
 def test_guard_scans_the_real_files():
-    for mod in (numerics_mod, telemetry_mod, pipeline_trace_mod):
-        assert os.path.exists(mod.__file__)
-        assert mod.__file__.endswith(".py")
+    files = _utils_files()
+    for name in ("telemetry.py", "numerics.py", "pipeline_trace.py", "hlo.py"):
+        assert any(f.endswith(name) for f in files), f"{name} missing from sweep"
