@@ -500,6 +500,82 @@ def bench_decode_420m():
     return out
 
 
+def bench_serving_summary(cfg_kwargs, *, n_requests, num_slots, block_size,
+                          num_blocks, max_model_len, prefill_chunk,
+                          param_dtype=None, seed=11):
+    """Continuous-batching serving summary (docs/serving.md): replay a seeded
+    mixed greedy/beam trace through the InferenceEngine and report tok/s,
+    TTFT, mean slot occupancy, and goodput — plus the compile-watchdog
+    recompile count, which must be 0 after warmup (the fixed-shape contract
+    ds-tpu serve-sim gates on)."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.serve.sim import synth_trace
+    from deepspeed_tpu.utils.monitor import SummaryMonitor
+    from deepspeed_tpu.utils.telemetry import TelemetrySession
+
+    cfg = GPT2Config(**cfg_kwargs)
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    if param_dtype is not None:
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(param_dtype) if p.ndim >= 2 else p, params)
+    # disabled monitor: the watchdog is wanted, the scalar files are not
+    session = TelemetrySession(monitor=SummaryMonitor(enabled=False))
+    import deepspeed_tpu
+    eng = deepspeed_tpu.init_inference(
+        model=model, model_parameters=params, telemetry=session,
+        config_params={"serving": {
+            "enabled": True, "max_seqs": num_slots, "block_size": block_size,
+            "num_blocks": num_blocks, "max_model_len": max_model_len,
+            "prefill_chunk": prefill_chunk}})
+    reqs = synth_trace(n_requests, vocab_size=cfg.vocab_size,
+                       max_model_len=max_model_len, seed=seed)
+    t0 = time.time()
+    outs, logs = eng.run(reqs)
+    wall = max(time.time() - t0, 1e-9)
+    fin = [o for o in outs if o.status == "finished"]
+    new_tokens = sum(len(o.tokens) for o in fin)
+    occ = [len(log["decode"]) / num_slots for log in logs]
+    recompiles = sum(session.watchdog.recompiles(n)
+                     for n in session.watchdog.records
+                     if n.startswith("serve:"))
+    return {"requests": len(reqs), "finished": len(fin),
+            "iterations": len(logs), "wall_s": round(wall, 2),
+            # tok_s counts every sampled token (all beam lanes, preempted
+            # work included); goodput only tokens of finished requests
+            "tok_s": round(eng._tokens_sampled / wall, 1),
+            "goodput_tok_s": round(new_tokens / wall, 1),
+            "ttft_ms_mean": round(float(np.mean([o.ttft_ms for o in fin])), 2),
+            "ttft_iters_mean": round(float(np.mean([o.ttft_iters
+                                                    for o in fin])), 2),
+            "occupancy_mean": round(float(np.mean(occ)) if occ else 0.0, 3),
+            "preemptions": sum(o.preemptions for o in fin),
+            "decode_recompiles_after_warmup": recompiles}
+
+
+def bench_serving_smoke():
+    """CPU smoke shape of the serving summary (tiny model, 16 requests)."""
+    return bench_serving_summary(
+        dict(vocab_size=256, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+             loss_chunk=0),
+        n_requests=16, num_slots=4, block_size=8, num_blocks=33,
+        max_model_len=64, prefill_chunk=16)
+
+
+def bench_serving_420m():
+    """TPU serving path: GPT-2 420M bf16, 32-request mixed trace."""
+    import jax.numpy as jnp
+    out = bench_serving_summary(
+        dict(vocab_size=50304, n_positions=1024, n_embd=1024, n_layer=24,
+             n_head=16, use_flash_attention=True),
+        n_requests=32, num_slots=8, block_size=16, num_blocks=513,
+        max_model_len=1024, prefill_chunk=128, param_dtype=jnp.bfloat16)
+    gc.collect()
+    return out
+
+
 def _zero2_step_fn(model, dp_shard):
     """jitted fwd+bwd + the 1/dp fp32 Adam-shard update of one ZeRO-2 rank."""
     import jax
@@ -826,10 +902,15 @@ def main():
             pipeline_goodput = _pipeline_goodput_probe()
         except Exception as e:
             pipeline_goodput = {"error": f"{type(e).__name__}: {e}"}
+        try:  # serving summary rides after the training window, never inside it
+            serving = bench_serving_smoke()
+        except Exception as e:
+            serving = {"error": f"{type(e).__name__}: {e}"}
         print(json.dumps({"metric": "gpt2_tokens_per_sec_per_chip_cpu_smoke",
                           "value": round(tps, 1), "unit": "tokens/s", "vs_baseline": 0.0,
                           "extra": {"telemetry": telemetry, "numerics": numerics,
-                                    "pipeline_goodput": pipeline_goodput}}))
+                                    "pipeline_goodput": pipeline_goodput,
+                                    "serving": serving}}))
         return
 
     extra = bench_420m()
@@ -872,6 +953,10 @@ def main():
         extra["decode_420m"] = bench_decode_420m()
     except Exception as e:
         extra["decode_420m"] = {"error": f"{type(e).__name__}: {e}"}
+    try:  # continuous-batching serving summary (after the headline windows)
+        extra["serving_420m"] = bench_serving_420m()
+    except Exception as e:
+        extra["serving_420m"] = {"error": f"{type(e).__name__}: {e}"}
     mp = max_params_offload()
     extra["max_trainable_params_per_chip_zero_offload"] = int(mp)
     if os.environ.get("DS_BENCH_SKIP_WORKLOADS", "0") != "1":

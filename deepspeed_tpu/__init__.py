@@ -50,6 +50,42 @@ def initialize(args=None,
     return tuple(return_items)
 
 
+def init_inference(model=None,
+                   model_parameters=None,
+                   config_params=None,
+                   telemetry=None,
+                   mirror=False):
+    """Initialize the TPU serving engine (``deepspeed.init_inference``-shaped).
+
+    ``model`` is a ``models.gpt2.GPT2Model`` (dense), ``model_parameters`` its
+    param pytree, ``config_params`` a DeepSpeed config dict/path whose
+    ``"serving"`` block (runtime/constants.py) sizes the paged KV pool and the
+    continuous-batching scheduler. Returns a ``serve.InferenceEngine``:
+    ``submit()`` requests, drive ``step()`` (or ``run()``) to completion.
+    ``telemetry`` is an optional ``utils.telemetry.TelemetrySession`` (compile
+    watchdog + Serving/* scalars); ``mirror=True`` runs the dense-cache oracle
+    in bitwise lockstep (tests/serve-sim only — it doubles the work)."""
+    from .serve.engine import InferenceEngine
+
+    config_params = config_params if config_params is not None else {}
+    if isinstance(config_params, dict):
+        config_params = dict(config_params)
+        # serving is batch-free; satisfy the training config's batch check
+        if not any(k in config_params for k in
+                   ("train_batch_size", "train_micro_batch_size_per_gpu")):
+            config_params["train_batch_size"] = 1
+    ds_config = DeepSpeedConfig(config_params, world_size=1)
+    return InferenceEngine(
+        model, model_parameters,
+        num_slots=ds_config.serving_max_seqs,
+        block_size=ds_config.serving_block_size,
+        num_blocks=ds_config.serving_num_blocks,
+        max_model_len=ds_config.serving_max_model_len,
+        prefill_chunk=ds_config.serving_prefill_chunk,
+        use_pallas=ds_config.serving_use_pallas_decode,
+        telemetry=telemetry, mirror=mirror)
+
+
 def _add_core_arguments(parser):
     """Core DeepSpeed arguments (reference deepspeed/__init__.py:144-192)."""
     group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")

@@ -167,12 +167,47 @@ def _build_pipeline():
     return eng, (x, np.tanh(x))
 
 
+def _tiny_gpt2():
+    from ..models.gpt2 import GPT2Config, GPT2Model
+    cfg = GPT2Config(vocab_size=64, n_positions=64, n_embd=16, n_layer=2,
+                     n_head=2, compute_dtype=jnp.float32, loss_chunk=0)
+    model = GPT2Model(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+class _DecodeLintAdapter:
+    """Engine-shaped wrapper so the gpt2 decode programs (prefill + greedy +
+    beam, models/gpt2.py decode_lint_programs) ride the same capture path."""
+
+    def __init__(self, model, params):
+        self.model, self.params = model, params
+
+    def lint_programs(self, sample_batch=None):
+        return self.model.decode_lint_programs(self.params)
+
+
+def _build_gpt2_decode():
+    return _DecodeLintAdapter(*_tiny_gpt2()), None
+
+
+def _build_serving():
+    # fixed-shape paged serving programs: decode step, prefill chunk, CoW
+    # page copy — the zero-recompile contract ds-tpu serve-sim replays
+    from ..serve.engine import InferenceEngine
+    model, params = _tiny_gpt2()
+    eng = InferenceEngine(model, params, num_slots=4, block_size=4,
+                          num_blocks=17, max_model_len=32, prefill_chunk=8)
+    return eng, None
+
+
 BUILDERS = {
     "standard": _build_standard,
     "external_master_fused": _build_external_master_fused,
     "external_master_accum": _build_external_master_accum,
     "zero_offload": _build_zero_offload,
     "pipeline": _build_pipeline,
+    "gpt2_decode": _build_gpt2_decode,
+    "serving": _build_serving,
 }
 
 

@@ -524,15 +524,24 @@ class GPT2Model:
         return ce + aux
 
     # ------------------------------------------------------------- generation
-    def _cached_jit(self, key, fn):
+    def _cached_jit(self, key, fn, donate_argnums=()):
         """Per-model decode-program cache: generate and beam_search share it (the
         shape-keyed ``("prefill", ...)`` entries are deliberately common so any
-        decode variant reuses the expensive prompt program)."""
+        decode variant reuses the expensive prompt program).
+
+        ``donate_argnums`` is forwarded to ``jax.jit``: the decode-path programs
+        donate their KV-cache arguments so XLA aliases one buffer through
+        input -> scan carry -> output instead of double-buffering the caches.
+        Without the donation the caller's cache stays live across the call —
+        at 1.5B batch-8 decode that is an extra 2x [L, B, nh, max_len, hd]
+        (~5.7 GB) held through the prompt-forward activation peak, which is
+        what pushed the relay-kill repros (tests/perf/decode_crash_repro.py)
+        over the HBM cliff at execution time."""
         cache = getattr(self, "_gen_jit_cache", None)
         if cache is None:
             cache = self._gen_jit_cache = {}
         if key not in cache:
-            cache[key] = jax.jit(fn)
+            cache[key] = jax.jit(fn, donate_argnums=donate_argnums)
         return cache[key]
 
     def _build_cached_forward(self, max_len: int):
@@ -644,12 +653,14 @@ class GPT2Model:
             return cand
 
         def decode(p, first_logits, kcs, vcs):
-            # beam init: top-K first tokens per row from the prefill logits
+            # beam init: top-K first tokens per row from the prefill logits.
+            # kcs/vcs arrive ALREADY replicated per beam ([nl, B*K, ...]) and
+            # donated — the expansion happens eagerly outside this program so
+            # the donated input aliases the scan carry and the returned caches
+            # (an in-jit repeat would leave the [nl, B, ...] input un-aliasable)
             logp0 = jax.nn.log_softmax(first_logits, axis=-1)      # [B, V]
             scores, tok0 = jax.lax.top_k(logp0, K)                  # [B, K]
             live = (tok0 != eos) if eos >= 0 else jnp.ones((B, K), bool)
-            # caches replicate per beam: [nl, B, ...] -> [nl, B*K, ...]
-            kcs, vcs = (jnp.repeat(t, K, axis=1) for t in (kcs, vcs))
             seqs = jnp.full((B, K, L), eos if eos >= 0 else 0, jnp.int32)
             seqs = seqs.at[:, :, 0].set(tok0)
 
@@ -676,7 +687,7 @@ class GPT2Model:
                     live = live & (tok != eos)
                 return (seqs, scores, live, kcs, vcs), ()
 
-            (seqs, scores, live, _, _), _ = jax.lax.scan(
+            (seqs, scores, live, kcs, vcs), _ = jax.lax.scan(
                 step, (seqs, scores, live, kcs, vcs), jnp.arange(L - 1))
             # GNMT length normalization: finished beams count tokens up to and
             # including EOS; an unfinished beam counts exactly L (clamped — the
@@ -689,20 +700,28 @@ class GPT2Model:
                 lengths = jnp.full((B, K), float(L))
             final = scores / jnp.power(lengths, jnp.float32(length_penalty))
             best = jnp.argmax(final, axis=1)                        # [B]
+            # returning the caches lets XLA alias donated input -> carry -> output
             return (jnp.take_along_axis(seqs, best[:, None, None], axis=1)[:, 0],
-                    jnp.take_along_axis(final, best[:, None], axis=1)[:, 0])
+                    jnp.take_along_axis(final, best[:, None], axis=1)[:, 0],
+                    kcs, vcs)
 
         # the prefill program depends only on shapes — key it separately so
         # varying num_beams/eos/length_penalty reuses the expensive prompt jit
-        jit_forward = self._cached_jit(("prefill", B, T0, max_len), forward)
+        jit_forward = self._cached_jit(("prefill", B, T0, max_len), forward,
+                                       donate_argnums=(3, 4))
         jit_decode = self._cached_jit(
-            ("beam", B, T0, L, K, eos, float(length_penalty)), decode)
+            ("beam", B, T0, L, K, eos, float(length_penalty)), decode,
+            donate_argnums=(2, 3))
 
         cache_shape = (c.n_layer, B, c.n_head, max_len, c.head_dim)
         kcs = jnp.zeros(cache_shape, c.compute_dtype)
         vcs = jnp.zeros(cache_shape, c.compute_dtype)
         first_logits, kcs, vcs = jit_forward(params, tokens, 0, kcs, vcs)
-        gen, scores = jit_decode(params, first_logits, kcs, vcs)
+        # per-beam cache expansion [nl, B, ...] -> [nl, B*K, ...] happens here,
+        # outside the jit, so the decode program's donated inputs already have
+        # the carry/output shape and XLA keeps ONE cache buffer end to end
+        kcs, vcs = (jnp.repeat(t, K, axis=1) for t in (kcs, vcs))
+        gen, scores, _, _ = jit_decode(params, first_logits, kcs, vcs)
         return jnp.concatenate([tokens, gen.astype(tokens.dtype)], axis=1), scores
 
     def generate(self, params, tokens, max_new_tokens: int,
@@ -768,19 +787,21 @@ class GPT2Model:
                 nxt = sample(logits, key)
                 return (nxt, pos + 1, kcs, vcs), tok
 
-            (last, _, _, _), outs = jax.lax.scan(
+            (last, _, kcs, vcs), outs = jax.lax.scan(
                 step, (first, jnp.asarray(T0, jnp.int32), kcs, vcs), keys)
-            # outs collects each step's INPUT token; the final sample is `last`
-            return jnp.concatenate([outs.T, last[:, None]], axis=1)
+            # outs collects each step's INPUT token; the final sample is `last`.
+            # The caches ride out so the donated inputs alias carry and output
+            return jnp.concatenate([outs.T, last[:, None]], axis=1), kcs, vcs
 
         # one compile per signature, reused across calls — params are explicit
         # jit arguments, not closure captures. The prefill depends only on
         # shapes (same key beam_search uses), so sampling-parameter variants
         # share the expensive prompt program.
-        jit_forward = self._cached_jit(("prefill", B, T0, max_len), forward)
+        jit_forward = self._cached_jit(("prefill", B, T0, max_len), forward,
+                                       donate_argnums=(3, 4))
         jit_decode = self._cached_jit(
             (B, T0, int(max_new_tokens), float(temperature), int(top_k),
-             float(top_p), str(out_dtype)), decode)
+             float(top_p), str(out_dtype)), decode, donate_argnums=(2, 3))
 
         cache_shape = (c.n_layer, B, nh, max_len, hd)
         kcs = jnp.zeros(cache_shape, c.compute_dtype)
@@ -791,8 +812,60 @@ class GPT2Model:
         first = sample(logits, keys[0])
         if max_new_tokens == 1:
             return jnp.concatenate([tokens, first[:, None]], axis=1)
-        gen = jit_decode(params, first, kcs, vcs, keys[1:])
+        gen, _, _ = jit_decode(params, first, kcs, vcs, keys[1:])
         return jnp.concatenate([tokens, gen], axis=1)
+
+    def decode_lint_programs(self, params, *, batch=2, prompt_len=4,
+                             max_new_tokens=4, num_beams=2):
+        """``(name, jitted, example_args, manifest)`` for the decode-path
+        programs, in the shape ``ds-tpu lint`` consumes (lint/registry.py).
+
+        Runs a tiny ``generate`` (greedy) and ``beam_search`` to populate the
+        per-model program cache, then hands the cached jitted functions back
+        with FRESH example arguments — the lint capture only lowers/compiles,
+        nothing executes, but the arrays the tiny runs donated are dead. The
+        manifests pin the invariant the relay-kill crashes violated: every
+        declared cache donation must actually alias (check_unusable), no
+        cache-sized input may ride un-donated (min_undonated_bytes), and the
+        single-host decode programs carry zero large collectives."""
+        import numpy as np
+
+        c = self.config
+        B, T0, L, K = int(batch), int(prompt_len), int(max_new_tokens), int(num_beams)
+        max_len = T0 + L
+        tokens = jnp.asarray(np.arange(B * T0).reshape(B, T0) % c.vocab_size,
+                             jnp.int32)
+        self.generate(params, tokens, L)
+        self.beam_search(params, tokens, L, num_beams=K)
+
+        dt = jnp.dtype(c.compute_dtype).name
+        compute = {"bfloat16": "bf16", "float16": "f16"}.get(dt, "f32")
+        manifest = {"compute_dtype": compute,
+                    "donation": {"check_unusable": True,
+                                 "min_undonated_bytes": 1024},
+                    "strict": True, "any_reduction": {"max": 0}}
+
+        cache_shape = (c.n_layer, B, c.n_head, max_len, c.head_dim)
+
+        def caches(beams=1):
+            s = (cache_shape[0], B * beams) + cache_shape[2:]
+            return jnp.zeros(s, c.compute_dtype), jnp.zeros(s, c.compute_dtype)
+
+        cache = self._gen_jit_cache
+        kcs, vcs = caches()
+        keys = jax.random.split(jax.random.PRNGKey(0), L)
+        first = jnp.zeros((B,), jnp.int32)
+        first_logits = jnp.zeros((B, c.vocab_size), jnp.float32)
+        bk, bv = caches(beams=K)
+        return [
+            ("gpt2_prefill", cache[("prefill", B, T0, max_len)],
+             (params, tokens, 0) + caches(), manifest),
+            ("gpt2_decode_greedy",
+             cache[(B, T0, L, 0.0, 0, 1.0, str(tokens.dtype))],
+             (params, first, kcs, vcs, keys[1:]), manifest),
+            ("gpt2_decode_beam", cache[("beam", B, T0, L, K, -1, 1.0)],
+             (params, first_logits, bk, bv), manifest),
+        ]
 
     def param_count(self, params) -> int:
         from ..runtime.utils import param_count

@@ -1,0 +1,136 @@
+"""Paged decode attention Pallas kernel (single-query, block-table gather).
+
+One grid cell per (slot, page): the scalar-prefetched block table steers each
+cell's k/v ``BlockSpec`` index map straight at the slot's page in the HBM pool
+``[n_layer, num_blocks, block_size, n_head, head_dim]`` — the pages are DMA'd
+by table indirection, never gathered into a contiguous [slots, max_len, ...]
+buffer (that gather is exactly what the XLA fallback in serve/paged.py pays
+for). Online-softmax (m, l, acc) scratch carries the reduction across a slot's
+pages, vLLM's PagedAttention shape specialized to decode (query length 1).
+
+Numerics: scores and the softmax accumulate in f32 regardless of pool dtype;
+the result matches the dense path to float tolerance, NOT bitwise (the dense
+path computes one flat softmax over max_len, this kernel reduces page by
+page). Hence the engine default is the bitwise XLA gather path; this kernel
+is opt-in via ``serving.use_pallas_decode`` and pinned by an allclose parity
+test (tests/unit/test_paged_attention.py).
+
+``interpret=True`` (automatic off-TPU) runs the same grid sequentially on
+CPU — scratch persistence across the page dimension matches TPU semantics.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    _HAS_PLTPU = False
+
+_NEG_INF = -1e30  # python float: a jnp scalar would be a captured constant
+
+
+def _decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, block_size, head_dim):
+    """Grid (slots, pages): accumulate one page of one slot's KV history into
+    the slot's online-softmax state; finalize on the last page."""
+    b = pl.program_id(1)
+    s = pl.program_id(0)
+    num_pages = pl.num_programs(1)
+
+    @pl.when(b == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, _NEG_INF, m_ref.dtype)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[:, 0, :].astype(jnp.float32)                 # [nh, hd]
+    k = k_ref[...].astype(jnp.float32)                     # [BS, nh, hd]
+    v = v_ref[...].astype(jnp.float32)
+
+    # scores [nh, BS]: batch over heads, contract head_dim
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (2,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32) / math.sqrt(head_dim)
+
+    # causal frontier: token index within the whole history
+    idx = b * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_size), 1)                     # [1, BS]
+    scores = jnp.where(idx < lengths_ref[s], scores, _NEG_INF)
+
+    m_prev = m_ref[...]                                    # [nh, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(scores - m_new)                            # [nh, BS]
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    # pv [nh, hd]: batch over heads, contract the page dimension
+    pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((0,), (1,))),
+                             preferred_element_type=jnp.float32)
+    acc_ref[...] = acc_ref[...] * alpha + pv
+    m_ref[...] = m_new
+
+    @pl.when(b == num_pages - 1)
+    def _finalize():
+        o_ref[:, 0, :] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("li", "block_size", "interpret"))
+def _paged_decode(q, k_pool, v_pool, tables, lengths, *, li, block_size,
+                  interpret):
+    S, nh, _, hd = q.shape
+    MB = tables.shape[1]
+    BS = block_size            # static argname; already an int (see wrapper)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                 # tables, lengths steer the DMA
+        grid=(S, MB),
+        in_specs=[
+            pl.BlockSpec((None, nh, 1, hd), lambda s, b, t, ln: (s, 0, 0, 0)),
+            # the paged gather: page (li, tables[s, b]) of the pool
+            pl.BlockSpec((None, None, BS, nh, hd),
+                         lambda s, b, t, ln: (li, t[s, b], 0, 0, 0)),
+            pl.BlockSpec((None, None, BS, nh, hd),
+                         lambda s, b, t, ln: (li, t[s, b], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, nh, 1, hd),
+                               lambda s, b, t, ln: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nh, 1), jnp.float32),   # running max
+            pltpu.VMEM((nh, 1), jnp.float32),   # running denominator
+            pltpu.VMEM((nh, hd), jnp.float32),  # running numerator
+        ],
+    )
+    kernel = functools.partial(_decode_kernel, block_size=BS, head_dim=hd)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, nh, 1, hd), q.dtype),
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(tables, lengths, q, k_pool, v_pool)
+
+
+def paged_decode_attention(q, k_pool, v_pool, li, tables, lengths, *,
+                           block_size, interpret=None):
+    """Decode attention through the block table.
+
+    q [slots, n_head, 1, head_dim]; k_pool/v_pool the layer-major page pools
+    [n_layer, num_blocks, block_size, n_head, head_dim]; ``li`` the (static)
+    layer; tables [slots, max_blocks] int32 page ids; lengths [slots] valid
+    history lengths (pos + 1). Returns [slots, n_head, 1, head_dim] in
+    q.dtype. ``interpret`` defaults to True off-TPU."""
+    if not _HAS_PLTPU:  # pragma: no cover
+        raise RuntimeError("pallas tpu backend unavailable")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _paged_decode(q, k_pool, v_pool,
+                         tables.astype(jnp.int32), lengths.astype(jnp.int32),
+                         li=int(li), block_size=int(block_size),
+                         interpret=bool(interpret))

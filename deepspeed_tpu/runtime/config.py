@@ -298,6 +298,36 @@ class DeepSpeedConfig:
                     f"DeepSpeedConfig: numerics.{attr[len('numerics_'):]} must be an "
                     f"int >= {minimum}, got {val!r}")
 
+        sv_dict = param_dict.get(SERVING, {})
+        self._warn_unknown_nested(SERVING, sv_dict, SERVING_CONFIG_KEYS)
+        self.serving_enabled = get_scalar_param(sv_dict, SERVING_ENABLED, SERVING_ENABLED_DEFAULT)
+        self.serving_block_size = get_scalar_param(sv_dict, SERVING_BLOCK_SIZE, SERVING_BLOCK_SIZE_DEFAULT)
+        self.serving_num_blocks = get_scalar_param(sv_dict, SERVING_NUM_BLOCKS, SERVING_NUM_BLOCKS_DEFAULT)
+        self.serving_max_seqs = get_scalar_param(sv_dict, SERVING_MAX_SEQS, SERVING_MAX_SEQS_DEFAULT)
+        self.serving_max_model_len = get_scalar_param(sv_dict, SERVING_MAX_MODEL_LEN,
+                                                      SERVING_MAX_MODEL_LEN_DEFAULT)
+        self.serving_prefill_chunk = get_scalar_param(sv_dict, SERVING_PREFILL_CHUNK,
+                                                      SERVING_PREFILL_CHUNK_DEFAULT)
+        self.serving_use_pallas_decode = get_scalar_param(sv_dict, SERVING_USE_PALLAS_DECODE,
+                                                          SERVING_USE_PALLAS_DECODE_DEFAULT)
+        for attr, minimum in (("serving_block_size", 1),
+                              ("serving_num_blocks", 2),  # block 0 is the reserved null page
+                              ("serving_max_seqs", 1),
+                              ("serving_max_model_len", 1),
+                              ("serving_prefill_chunk", 1)):
+            val = getattr(self, attr)
+            if isinstance(val, bool) or not isinstance(val, int) or val < minimum:
+                raise ValueError(
+                    f"DeepSpeedConfig: serving.{attr[len('serving_'):]} must be an "
+                    f"int >= {minimum}, got {val!r}")
+        if self.serving_max_model_len % self.serving_block_size != 0:
+            # the paged gather reconstructs a [max_blocks * block_size] dense view;
+            # it bit-matches the dense decode oracle only when the tiling is exact
+            raise ValueError(
+                "DeepSpeedConfig: serving.max_model_len must be a multiple of "
+                f"serving.block_size, got {self.serving_max_model_len} % "
+                f"{self.serving_block_size} != 0")
+
         self.sparse_attention = None
         if SPARSE_ATTENTION in param_dict:
             self.sparse_attention = SparseAttentionConfig(param_dict[SPARSE_ATTENTION])

@@ -222,6 +222,29 @@ NUMERICS_INSTALL_SIGNAL_HANDLERS = "install_signal_handlers"
 NUMERICS_INSTALL_SIGNAL_HANDLERS_DEFAULT = False
 
 #############################################
+# Serving (TPU-native inference engine, no reference key — the reference
+# 0.3.0 ships no inference path. Block-paged KV cache + continuous batching;
+# see docs/serving.md. Sizes are in tokens; the pool holds num_blocks pages of
+# block_size tokens per layer, and block 0 is the reserved null page padded
+# writes are routed to.)
+#############################################
+SERVING = "serving"
+SERVING_ENABLED = "enabled"
+SERVING_ENABLED_DEFAULT = False
+SERVING_BLOCK_SIZE = "block_size"
+SERVING_BLOCK_SIZE_DEFAULT = 16
+SERVING_NUM_BLOCKS = "num_blocks"
+SERVING_NUM_BLOCKS_DEFAULT = 257  # 256 usable + the reserved null block
+SERVING_MAX_SEQS = "max_seqs"
+SERVING_MAX_SEQS_DEFAULT = 8
+SERVING_MAX_MODEL_LEN = "max_model_len"
+SERVING_MAX_MODEL_LEN_DEFAULT = 256
+SERVING_PREFILL_CHUNK = "prefill_chunk"
+SERVING_PREFILL_CHUNK_DEFAULT = 32
+SERVING_USE_PALLAS_DECODE = "use_pallas_decode"
+SERVING_USE_PALLAS_DECODE_DEFAULT = False
+
+#############################################
 # Gradient accumulation fp32 buffer
 #############################################
 FP32_ALLREDUCE = "fp32_allreduce"
@@ -333,6 +356,7 @@ TOP_LEVEL_CONFIG_KEYS = frozenset({
     TENSORBOARD,
     TELEMETRY,
     NUMERICS,
+    SERVING,
     SPARSE_ATTENTION,
     SEQUENCE_PARALLEL,
     PIPELINE,
@@ -374,4 +398,14 @@ NUMERICS_CONFIG_KEYS = frozenset({
     NUMERICS_CONSECUTIVE_SKIP_TRIGGER,
     NUMERICS_TRIGGER_ON_NONFINITE_LOSS,
     NUMERICS_INSTALL_SIGNAL_HANDLERS,
+})
+
+SERVING_CONFIG_KEYS = frozenset({
+    SERVING_ENABLED,
+    SERVING_BLOCK_SIZE,
+    SERVING_NUM_BLOCKS,
+    SERVING_MAX_SEQS,
+    SERVING_MAX_MODEL_LEN,
+    SERVING_PREFILL_CHUNK,
+    SERVING_USE_PALLAS_DECODE,
 })

@@ -1,0 +1,25 @@
+"""TPU-native serving engine: block-paged KV cache + continuous batching.
+
+The reference DeepSpeed 0.3.0 ships no inference engine; this package is the
+serving layer the ROADMAP's "millions of users" north star needs. Three parts:
+
+- :mod:`block_allocator` — host-side free-list allocator over a fixed HBM pool
+  of KV pages, with per-sequence block tables and refcounted copy-on-write
+  forks for beam search (vLLM's PagedAttention memory model, SOSP '23);
+- :mod:`paged` + :mod:`scheduler` — fixed-shape paged decode/prefill programs
+  (one compile each, ever) and an iteration-granular continuous-batching
+  scheduler with chunked prefill interleaved into in-flight decodes (Orca,
+  OSDI '22);
+- :mod:`engine` — the ``deepspeed_tpu.init_inference``-shaped facade wrapping
+  models/gpt2.py, config block ``"serving"``, telemetry Serving/* scalars.
+
+``serve/oracle.py`` holds the dense-cache mirror programs the equivalence
+tests and ``ds-tpu serve-sim`` bit-compare the paged path against.
+"""
+
+from .block_allocator import AllocationError, BlockAllocator
+from .engine import InferenceEngine
+from .scheduler import Request, RequestOutput, Scheduler
+
+__all__ = ["AllocationError", "BlockAllocator", "InferenceEngine", "Request",
+           "RequestOutput", "Scheduler"]

@@ -1,0 +1,120 @@
+"""Host-side block allocator for the paged KV cache.
+
+The device holds one fixed pool of ``num_blocks`` pages per layer
+(``[n_layer, num_blocks, block_size, n_head, head_dim]``, serve/paged.py);
+this allocator hands out page indices. Pure host bookkeeping — allocation
+never touches the device, so admission control is a free-list length check,
+not an OOM recovery path.
+
+Design points (vLLM's PagedAttention memory model):
+
+- **Block 0 is reserved** as the null page: padded/inactive lanes of the
+  fixed-shape programs route their writes there, and unallocated block-table
+  entries point at it. It is never handed out, so a stray write can never
+  corrupt a live sequence.
+- **Free list is FIFO** (appendleft/pop would be LIFO; we pop from the left
+  of a deque seeded in index order) — allocation order is deterministic for
+  the byte-identical schedule-replay tests.
+- **Refcounts + copy-on-write**: beam search forks a parent sequence's table
+  by incrementing refcounts; a writer that needs an exclusive page calls
+  :meth:`ensure_exclusive`, which returns the ``(src, dst)`` page copy the
+  caller must mirror on-device (paged.copy_blocks) when the page was shared.
+"""
+
+from collections import deque
+
+NULL_BLOCK = 0
+
+
+class AllocationError(RuntimeError):
+    """Out of KV pages (or a request can never fit) — admission refusal, not
+    a crash: callers catch this and keep the request waiting or reject it."""
+
+
+class BlockAllocator:
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(f"need >= 2 blocks (one is the reserved null "
+                             f"page), got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._free = deque(range(1, self.num_blocks))   # block 0 reserved
+        self._refcount = {}                              # block -> int (>0)
+
+    # ------------------------------------------------------------- queries
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return self.num_blocks - 1 - len(self._free)
+
+    def blocks_for_tokens(self, num_tokens: int) -> int:
+        return -(-int(num_tokens) // self.block_size)  # ceil div
+
+    def can_allocate(self, num_blocks: int) -> bool:
+        return num_blocks <= len(self._free)
+
+    def refcount(self, block: int) -> int:
+        return self._refcount.get(block, 0)
+
+    def stats(self) -> dict:
+        return {"num_blocks": self.num_blocks, "block_size": self.block_size,
+                "free": self.num_free, "used": self.num_used,
+                "shared": sum(1 for c in self._refcount.values() if c > 1)}
+
+    # ------------------------------------------------------- alloc/free/fork
+    def allocate(self, num_blocks: int) -> list:
+        if num_blocks > len(self._free):
+            raise AllocationError(
+                f"requested {num_blocks} KV pages with {len(self._free)} free "
+                f"(pool {self.num_blocks - 1} usable pages of "
+                f"{self.block_size} tokens)")
+        out = [self._free.popleft() for _ in range(num_blocks)]
+        for b in out:
+            self._refcount[b] = 1
+        return out
+
+    def free(self, blocks) -> None:
+        """Drop one reference per block; pages return to the free list when
+        their last reference goes. Order of return is the order given —
+        deterministic for replay."""
+        for b in blocks:
+            if b == NULL_BLOCK:
+                continue
+            c = self._refcount.get(b)
+            if c is None:
+                raise ValueError(f"double free of block {b}")
+            if c == 1:
+                del self._refcount[b]
+                self._free.append(b)
+            else:
+                self._refcount[b] = c - 1
+
+    def fork(self, blocks) -> list:
+        """Share a table: +1 ref on every page, returns a copy of the list.
+        The forked table reads the same pages until a write forces CoW."""
+        for b in blocks:
+            if b == NULL_BLOCK:
+                continue
+            if b not in self._refcount:
+                raise ValueError(f"fork of unallocated block {b}")
+            self._refcount[b] += 1
+        return list(blocks)
+
+    def ensure_exclusive(self, block: int):
+        """Make ``block`` writable by exactly one owner. Returns
+        ``(new_block, (src, dst))`` when the page was shared and had to be
+        copied (the caller mirrors the copy on-device), or ``(block, None)``
+        when it was already exclusive."""
+        c = self._refcount.get(block)
+        if c is None:
+            raise ValueError(f"ensure_exclusive of unallocated block {block}")
+        if c == 1:
+            return block, None
+        fresh = self.allocate(1)[0]
+        self._refcount[block] = c - 1
+        return fresh, (block, fresh)

@@ -1,0 +1,380 @@
+"""InferenceEngine: continuous-batching serving over the paged KV cache.
+
+The engine owns the device state (params + one paged KV pool, aliased through
+every program by donation) and executes the scheduler's host decisions in a
+fixed per-iteration order:
+
+    admit -> ensure write blocks (CoW page copies) -> one prefill chunk
+          -> one decode step for every live lane -> sampling heads
+
+Every device program has one fixed abstract signature (serve/paged.py), so the
+whole serving loop compiles each program exactly once — ``ds-tpu serve-sim``
+asserts this through the compile watchdog. Sampling is host-side for greedy
+(np.argmax over the fetched f32 logits row — same first-max tie-break as the
+in-graph jnp.argmax) and a tiny fixed-shape device program per beam step.
+
+``mirror=True`` runs the dense-cache oracle (serve/oracle.py) in lockstep and
+asserts the paged logits are **bitwise identical** to the dense ones every
+prefill chunk and every decode step — the standing proof that paging is a
+memory-layout change, not a numerics change.
+"""
+
+import time
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .block_allocator import NULL_BLOCK
+from .paged import build_paged_programs
+from .scheduler import RequestOutput, Scheduler
+
+_MAX_IDLE_SKIP = 1 << 30
+
+
+class InferenceEngine:
+    def __init__(self, model, params, *, num_slots=8, block_size=16,
+                 num_blocks=257, max_model_len=256, prefill_chunk=32,
+                 use_pallas=False, telemetry=None, mirror=False):
+        c = model.config
+        if max_model_len % block_size != 0:
+            raise ValueError(f"max_model_len {max_model_len} not a multiple "
+                             f"of block_size {block_size}")
+        if max_model_len > c.n_positions:
+            raise ValueError(f"max_model_len {max_model_len} exceeds the "
+                             f"model's n_positions {c.n_positions}")
+        if getattr(c, "moe_experts", 0):
+            raise ValueError("serving supports dense models only (no MoE)")
+        if getattr(c, "sparse_attention", None):
+            raise ValueError("serving supports dense attention only")
+        self.model = model
+        self.params = params
+        self.num_slots = int(num_slots)
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.max_model_len = int(max_model_len)
+        self.max_blocks = self.max_model_len // self.block_size
+        self.prefill_chunk = int(prefill_chunk)
+        self.telemetry = telemetry
+
+        self._raw = build_paged_programs(
+            model, num_slots=self.num_slots, block_size=self.block_size,
+            max_blocks=self.max_blocks, prefill_chunk=self.prefill_chunk,
+            use_pallas=use_pallas)
+        self._decode = self._watch("serve:decode_step", self._raw["decode_step"])
+        self._prefill = self._watch("serve:prefill_chunk",
+                                    self._raw["prefill_chunk"])
+        self._copy = self._watch("serve:copy_blocks", self._raw["copy_blocks"])
+        self._beam_watched = {}
+        self.copy_width = self._raw["copy_width"]
+
+        pool_shape = (c.n_layer, self.num_blocks, self.block_size,
+                      c.n_head, c.head_dim)
+        self.k_pool = jnp.zeros(pool_shape, c.compute_dtype)
+        self.v_pool = jnp.zeros(pool_shape, c.compute_dtype)
+
+        self.scheduler = Scheduler(
+            num_slots=self.num_slots, num_blocks=self.num_blocks,
+            block_size=self.block_size, max_model_len=self.max_model_len,
+            prefill_chunk=self.prefill_chunk)
+
+        self._mirror = None
+        self.mirror_checks = 0
+        if mirror:
+            from .oracle import build_oracle_programs
+            self._mirror = build_oracle_programs(
+                model, num_slots=self.num_slots, max_len=self.max_model_len,
+                prefill_chunk=self.prefill_chunk)
+            self._okcs, self._ovcs = self._mirror["fresh_caches"]()
+
+        self._it = 0
+        self._order = []                    # req_id submission order
+        self.outputs = {}                   # req_id -> RequestOutput
+        self._submit_ms = {}
+        self._start_wall = None
+        self._tokens_sampled = 0            # every appended token
+        self._tokens_finished = 0           # tokens of finished requests only
+
+    # ------------------------------------------------------------- plumbing
+    def _watch(self, name, fn):
+        return self.telemetry.watch(name, fn) if self.telemetry else fn
+
+    def _beam_head(self, kind, g):
+        K, eos = g.lanes, g.req.eos_token_id
+        key = (kind, K, eos)
+        if key not in self._beam_watched:
+            fn = self._raw[f"beam_{kind}"](K, eos)
+            self._beam_watched[key] = self._watch(
+                f"serve:beam_{kind}_k{K}_e{eos}", fn)
+        return self._beam_watched[key]
+
+    def _scalar(self, name, value):
+        if self.telemetry is not None:
+            self.telemetry.monitor.add_scalar(f"Serving/{name}",
+                                              float(value), self._it)
+
+    # ----------------------------------------------------------- submission
+    def submit(self, req):
+        """Queue a request; infeasible ones are refused (a RequestOutput with
+        status "refused"), never crash the engine."""
+        self._order.append(req.req_id)
+        self._submit_ms[req.req_id] = time.perf_counter()
+        reason = self.scheduler.submit(req)
+        if reason is not None:
+            out = RequestOutput(req.req_id, "refused", refusal=reason)
+            self.outputs[req.req_id] = out
+            return out
+        return None
+
+    # ---------------------------------------------------------- the big loop
+    def step(self):
+        """One serving iteration. Returns the schedule-log dict — pure host
+        decisions only, so a trace replay is byte-identical (json.dumps)."""
+        if self._start_wall is None:
+            self._start_wall = time.perf_counter()
+        sched, it = self.scheduler, self._it
+        log = {"it": it}
+
+        admitted = sched.admit(it)
+        preempted, copies = sched.ensure_decode_room()
+        log["admitted"] = [g.req.req_id for g in admitted]
+        log["preempted"] = [g.req.req_id for g in preempted]
+        log["copies"] = [list(c) for c in copies]
+        self._run_copies(copies)
+
+        log["prefill"] = self._prefill_one(it)
+        log["decode"], log["finished"] = self._decode_all(it)
+
+        self._scalar("occupancy", sched.occupancy())
+        self._scalar("waiting", len(sched.waiting))
+        self._scalar("free_blocks", sched.allocator.num_free)
+        elapsed = max(time.perf_counter() - self._start_wall, 1e-9)
+        self._scalar("tok_s", self._tokens_sampled / elapsed)
+        self._scalar("goodput_tok_s", self._tokens_finished / elapsed)
+
+        self._it += 1
+        return log
+
+    def run(self, requests):
+        """Submit everything, drive steps until drained. Returns (outputs in
+        submission order, per-iteration schedule log)."""
+        for r in requests:
+            self.submit(r)
+        logs = []
+        guard = 0
+        while not self.scheduler.idle:
+            if not self.scheduler.running:
+                na = self.scheduler.next_arrival()
+                if na is not None and na > self._it:
+                    self._it = na           # fast-forward idle iterations
+            logs.append(self.step())
+            guard += 1
+            if guard > 200000:
+                raise RuntimeError("serving loop failed to drain (bug)")
+        return [self.outputs[rid] for rid in self._order], logs
+
+    # -------------------------------------------------------------- internals
+    def _run_copies(self, copies):
+        P = self.copy_width
+        for i in range(0, len(copies), P):
+            batch = copies[i:i + P]
+            src = np.zeros(P, np.int32)     # pads: null 0 -> 0 self-copy
+            dst = np.zeros(P, np.int32)
+            for j, (s, d) in enumerate(batch):
+                src[j], dst[j] = s, d
+            self.k_pool, self.v_pool = self._copy(
+                self.k_pool, self.v_pool, jnp.asarray(src), jnp.asarray(dst))
+
+    def _pad_table(self, table):
+        out = np.full(self.max_blocks, NULL_BLOCK, np.int32)
+        out[:len(table)] = table
+        return out
+
+    def _prefill_one(self, it):
+        pf = self.scheduler.next_prefill(it)
+        if pf is None:
+            return None
+        g, pos, n, chunk = pf
+        toks = jnp.asarray([chunk], jnp.int32)
+        table = jnp.asarray(self._pad_table(g.tables[0]))
+        logits, self.k_pool, self.v_pool = self._prefill(
+            self.params, toks, jnp.int32(pos), jnp.int32(n), table,
+            self.k_pool, self.v_pool)
+        if self._mirror is not None:
+            ol, self._okcs, self._ovcs = self._mirror["prefill_chunk"](
+                self.params, toks, jnp.int32(pos), jnp.int32(n),
+                jnp.int32(g.slots[0]), self._okcs, self._ovcs)
+            self._assert_bitwise(logits, ol, f"prefill it={it} "
+                                 f"req={g.req.req_id} pos={pos}")
+        done = self.scheduler.finish_prefill_chunk(g, n, it)
+        if done:
+            self._first_tokens(g, logits, it)
+        return [g.req.req_id, pos, n, bool(done)]
+
+    def _first_tokens(self, g, logits, it):
+        if g.lanes == 1:
+            tok = int(np.argmax(np.asarray(logits[0])))
+            self.scheduler.begin_decode(g, [tok], it)
+        else:
+            scores, tok0, live = self._beam_head("init", g)(logits)
+            self.scheduler.begin_decode(
+                g, [int(t) for t in np.asarray(tok0)], it,
+                scores=np.asarray(scores), live=np.asarray(live))
+            if self._mirror is not None and g.lanes > 1:
+                perm = np.arange(self.num_slots, dtype=np.int32)
+                perm[np.asarray(g.slots[1:], np.int32)] = g.slots[0]
+                self._okcs, self._ovcs = self._mirror["reorder"](
+                    self._okcs, self._ovcs, jnp.asarray(perm))
+        g.first_token_ms = (time.perf_counter()
+                            - self._submit_ms[g.req.req_id]) * 1000.0
+        self._tokens_sampled += g.lanes
+        self._scalar("ttft_ms", g.first_token_ms)
+        self._scalar("ttft_iters", it - g.req.arrival)
+
+    def _decode_all(self, it):
+        # a group that completed prefill THIS iteration sits out one decode:
+        # its first write block is ensured at the NEXT iteration's start
+        lanes = [(g, lane, slot) for g, lane, slot in
+                 self.scheduler.decode_lanes() if g.entered_decode_it != it]
+        decode_log = [[g.req.req_id, lane, slot] for g, lane, slot in lanes]
+        if not lanes:
+            return decode_log, []
+        S = self.num_slots
+        toks = np.zeros(S, np.int32)
+        pos = np.zeros(S, np.int32)
+        tables = np.full((S, self.max_blocks), NULL_BLOCK, np.int32)
+        active = np.zeros(S, bool)
+        for g, lane, slot in lanes:
+            toks[slot] = g.generated[lane][-1]
+            pos[slot] = g.next_pos(lane)
+            tables[slot] = self._pad_table(g.tables[lane])
+            active[slot] = True
+        logits, self.k_pool, self.v_pool = self._decode(
+            self.params, jnp.asarray(toks), jnp.asarray(pos),
+            jnp.asarray(tables), jnp.asarray(active),
+            self.k_pool, self.v_pool)
+        if self._mirror is not None:
+            ol, self._okcs, self._ovcs = self._mirror["decode_step"](
+                self.params, jnp.asarray(toks), jnp.asarray(pos),
+                jnp.asarray(active), self._okcs, self._ovcs)
+            self._assert_bitwise(logits, ol, f"decode it={it}", rows=active)
+        logits_np = np.asarray(logits)
+
+        finished = []
+        for g in list(self.scheduler.running):
+            if g.phase != "decode" or g.entered_decode_it == it:
+                continue                    # groups that just prefilled wait
+            if g.lanes == 1:
+                self._sample_greedy(g, logits_np, finished, it)
+            else:
+                self._sample_beam(g, logits, finished, it)
+        return decode_log, finished
+
+    def _sample_greedy(self, g, logits_np, finished, it):
+        tok = int(np.argmax(logits_np[g.slots[0]]))
+        g.generated[0].append(tok)
+        self._tokens_sampled += 1
+        eos = g.req.eos_token_id
+        if (len(g.generated[0]) >= g.req.max_new_tokens
+                or (eos >= 0 and tok == eos)):
+            self._finish(g, g.generated[0], None, finished, it)
+
+    def _sample_beam(self, g, logits, finished, it):
+        scores, parents, toks, live = self._beam_head("select", g)(
+            logits, jnp.asarray(g.slots, jnp.int32),
+            jnp.asarray(g.scores, jnp.float32), jnp.asarray(g.live, bool))
+        parents = [int(p) for p in np.asarray(parents)]
+        old_slot_of = [g.slots[p] for p in parents]
+        self.scheduler.reorder_beams(g, parents)
+        if self._mirror is not None:
+            perm = np.arange(self.num_slots, dtype=np.int32)
+            perm[np.asarray(g.slots, np.int32)] = old_slot_of
+            self._okcs, self._ovcs = self._mirror["reorder"](
+                self._okcs, self._ovcs, jnp.asarray(perm))
+        for k, t in enumerate(np.asarray(toks)):
+            g.generated[k].append(int(t))
+        self._tokens_sampled += g.lanes
+        g.scores = np.asarray(scores)
+        g.live = np.asarray(live)
+        if len(g.generated[0]) >= g.req.max_new_tokens:
+            best, score = self._rank_beams(g)
+            self._finish(g, best, score, finished, it)
+
+    def _rank_beams(self, g):
+        """Host replay of beam_search's GNMT final ranking: finished beams
+        count tokens through EOS (clamped to L), unfinished count exactly L.
+        Bitwise-identical to the dense path for length_penalty == 1.0."""
+        L = float(g.req.max_new_tokens)
+        eos = g.req.eos_token_id
+        scores = np.asarray(g.scores, np.float32)
+        if eos >= 0:
+            lengths = []
+            for toks in g.generated:
+                n = 0
+                for t in toks:
+                    if t == eos:
+                        break
+                    n += 1
+                lengths.append(min(n + 1.0, L))
+        else:
+            lengths = [L] * g.lanes
+        lengths = np.asarray(lengths, np.float32)
+        final = scores / np.power(lengths, np.float32(g.req.length_penalty))
+        best = int(np.argmax(final))
+        return g.generated[best], float(final[best])
+
+    def _finish(self, g, tokens, score, finished, it):
+        self.scheduler.finish_group(g)
+        n = len(tokens)
+        self._tokens_finished += n
+        self.outputs[g.req.req_id] = RequestOutput(
+            g.req.req_id, "finished", tokens=list(tokens), score=score,
+            ttft_iters=(g.first_token_it - g.req.arrival),
+            ttft_ms=g.first_token_ms, finished_it=it,
+            preemptions=getattr(g.req, "_preemptions_carry", g.preemptions))
+        finished.append(g.req.req_id)
+
+    def _assert_bitwise(self, paged, dense, what, rows=None):
+        a, b = np.asarray(paged), np.asarray(dense)
+        if rows is not None:
+            a, b = a[rows], b[rows]
+        if not np.array_equal(a, b):
+            bad = int(np.sum(a != b))
+            raise AssertionError(
+                f"paged/dense logits diverged ({what}): {bad} of {a.size} "
+                f"entries differ; max abs diff "
+                f"{float(np.max(np.abs(a - b)))!r}")
+        self.mirror_checks += 1
+
+    # ------------------------------------------------------------------ lint
+    def lint_programs(self, sample_batch=None):
+        """(name, jitted, example_args, manifest) for the lint registry —
+        same contract as runtime engine.lint_programs. Fresh example pools so
+        capture never lowers against donated-dead buffers."""
+        c = self.model.config
+        compute = {"bfloat16": "bf16", "float16": "f16"}.get(
+            jnp.dtype(c.compute_dtype).name, "f32")
+        manifest = {
+            "compute_dtype": compute,
+            "donation": {"check_unusable": True, "min_undonated_bytes": 1024},
+            "strict": True,
+            "any_reduction": {"max": 0},
+        }
+        S, MB, C, P = (self.num_slots, self.max_blocks, self.prefill_chunk,
+                       self.copy_width)
+        pool_shape = (c.n_layer, self.num_blocks, self.block_size,
+                      c.n_head, c.head_dim)
+        kp = jnp.zeros(pool_shape, c.compute_dtype)
+        vp = jnp.zeros(pool_shape, c.compute_dtype)
+        zs = jnp.zeros(S, jnp.int32)
+        return [
+            ("serve_decode_step", self._raw["decode_step"],
+             (self.params, zs, zs, jnp.zeros((S, MB), jnp.int32),
+              jnp.zeros(S, bool), kp, vp), manifest),
+            ("serve_prefill_chunk", self._raw["prefill_chunk"],
+             (self.params, jnp.zeros((1, C), jnp.int32), jnp.int32(0),
+              jnp.int32(1), jnp.zeros(MB, jnp.int32), kp, vp), manifest),
+            ("serve_copy_blocks", self._raw["copy_blocks"],
+             (kp, vp, jnp.zeros(P, jnp.int32), jnp.zeros(P, jnp.int32)),
+             manifest),
+        ]

@@ -1,0 +1,143 @@
+"""Dense-cache mirror of the paged serving programs — the bit-exactness oracle.
+
+The paged programs (serve/paged.py) claim to be the dense cached-forward math
+with only the *memory layout* changed. This module is the referee: the same
+slot-shaped programs over plain contiguous per-slot caches
+``[n_layer, num_slots + 1, n_head, max_len, head_dim]`` (the +1 row is the
+null slot padded lanes write to — the dense analog of the null page), with no
+block tables, no pools, no paging. ``ds-tpu serve-sim`` and the equivalence
+tests run it in lockstep with the engine and assert the logits are **bitwise
+identical** every iteration; any divergence means the paging machinery
+(allocator, tables, scatter/gather, copy-on-write) changed the numbers.
+
+Why a mirror rather than ``model.generate`` directly: XLA's CPU gemm is not
+batch-size independent in the last ulp, so the oracle must issue dots at the
+SAME shapes as the engine ([num_slots, 1, H] decode rows, [1, chunk, H]
+prefill rows). The aligned-batch test in tests/unit/test_paged_attention.py
+closes the remaining gap by driving ``_build_cached_forward`` itself at
+matching shapes.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def build_oracle_programs(model, *, num_slots, max_len, prefill_chunk):
+    """``decode_step(p, toks, pos, active, kcs, vcs)`` and
+    ``prefill_chunk(p, toks, pos, n_valid, slot, kcs, vcs)`` over dense
+    per-slot caches, plus ``reorder(kcs, vcs, perm)`` (the beam-search cache
+    shuffle the paged path does with table forks)."""
+    c = model.config
+    nh, hd = c.n_head, c.head_dim
+    S, ML, C = int(num_slots), int(max_len), int(prefill_chunk)
+    cd = c.compute_dtype
+    eps = c.layer_norm_epsilon
+    import math as _math
+
+    def _qkv(x, bp):
+        B_, Tn, _ = x.shape
+        qkv = jnp.dot(x, bp["c_attn_w"].astype(x.dtype),
+                      preferred_element_type=jnp.float32).astype(x.dtype) \
+            + bp["c_attn_b"].astype(x.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B_, Tn, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(B_, Tn, nh, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B_, Tn, nh, hd).transpose(0, 2, 1, 3)
+        return q, k, v
+
+    def _proj(y, bp, x_dtype):
+        return (jnp.dot(y, bp["c_proj_w"].astype(x_dtype),
+                        preferred_element_type=jnp.float32).astype(x_dtype)
+                + bp["c_proj_b"].astype(x_dtype))
+
+    def _attend(q, kg, vg, mask, x_dtype):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kg,
+                       preferred_element_type=jnp.float32) / _math.sqrt(hd)
+        s = jnp.where(mask, s, jnp.float32(-1e9))
+        p = jax.nn.softmax(s, axis=-1).astype(x_dtype)
+        y = jnp.einsum("bhqk,bhkd->bhqd", p, vg,
+                       preferred_element_type=jnp.float32).astype(x_dtype)
+        B_, _, Tn, _ = y.shape
+        return y.transpose(0, 2, 1, 3).reshape(B_, Tn, nh * hd)
+
+    def _blocks_forward(p, x, attn_fn):
+        for li, bp in enumerate(p["blocks"]):
+            a = attn_fn(model._layer_norm(x, bp["ln_1"], eps), bp["attn"], li)
+            x = x + a
+            h = model._layer_norm(x, bp["ln_2"], eps)
+            x = x + model._mlp(h, bp["mlp"])
+        return model._layer_norm(x, p["ln_f"], eps)
+
+    def _logits(row, p):
+        return jnp.einsum("bh,vh->bv", row, p["wte"].astype(row.dtype),
+                          preferred_element_type=jnp.float32)
+
+    hh = jnp.arange(nh)
+
+    def decode_step(p, toks, pos, active, kcs, vcs):
+        caches = {"k": kcs, "v": vcs}
+        x = p["wte"][toks[:, None]].astype(cd) \
+            + p["wpe"][pos[:, None]].astype(cd)
+        wslot = jnp.where(active, jnp.arange(S), S)      # pads -> null slot
+        pc = jnp.minimum(pos, ML - 1)
+
+        def attn(xin, bp, li):
+            q, k, v = _qkv(xin, bp)                      # [S, nh, 1, hd]
+            caches["k"] = caches["k"].at[
+                li, wslot[:, None], hh[None, :], pc[:, None]].set(
+                k[:, :, 0, :].astype(caches["k"].dtype))
+            caches["v"] = caches["v"].at[
+                li, wslot[:, None], hh[None, :], pc[:, None]].set(
+                v[:, :, 0, :].astype(caches["v"].dtype))
+            kg = caches["k"][li, :S]                     # [S, nh, ML, hd]
+            vg = caches["v"][li, :S]
+            mask = (jnp.arange(ML)[None, :] <= pos[:, None])[:, None, None, :]
+            return _proj(_attend(q, kg, vg, mask, xin.dtype), bp, xin.dtype)
+
+        x = _blocks_forward(p, x, attn)
+        return _logits(x[:, -1], p), caches["k"], caches["v"]
+
+    def prefill_chunk_fn(p, toks, pos, n_valid, slot, kcs, vcs):
+        caches = {"k": kcs, "v": vcs}
+        wpe_cap = p["wpe"].shape[0] - 1
+        tp = pos + jnp.arange(C)
+        positions = jnp.minimum(tp, wpe_cap)
+        x = p["wte"][toks].astype(cd) + p["wpe"][positions][None].astype(cd)
+        valid = jnp.arange(C) < n_valid
+        wslot = jnp.where(valid, slot, S)
+        pc = jnp.minimum(tp, ML - 1)
+
+        def attn(xin, bp, li):
+            q, k, v = _qkv(xin, bp)                      # [1, nh, C, hd]
+            caches["k"] = caches["k"].at[
+                li, wslot[:, None], hh[None, :], pc[:, None]].set(
+                k[0].transpose(1, 0, 2).astype(caches["k"].dtype))
+            caches["v"] = caches["v"].at[
+                li, wslot[:, None], hh[None, :], pc[:, None]].set(
+                v[0].transpose(1, 0, 2).astype(caches["v"].dtype))
+            kg = jax.lax.dynamic_slice_in_dim(caches["k"][li], slot, 1, axis=0)
+            vg = jax.lax.dynamic_slice_in_dim(caches["v"][li], slot, 1, axis=0)
+            mask = jnp.arange(ML)[None, :] <= tp[:, None]
+            return _proj(_attend(q, kg, vg, mask, xin.dtype), bp, xin.dtype)
+
+        x = _blocks_forward(p, x, attn)
+        last = jax.lax.dynamic_slice(x, (0, n_valid - 1, 0),
+                                     (1, 1, x.shape[-1]))[:, 0]
+        return _logits(last, p), caches["k"], caches["v"]
+
+    def reorder(kcs, vcs, perm):
+        """Slot permutation/duplication [S] — the dense analog of beam-search
+        block-table forking: new slot s takes old slot perm[s]'s cache.
+        Identity entries keep non-beam slots untouched."""
+        return kcs.at[:, :S].set(kcs[:, perm]), vcs.at[:, :S].set(vcs[:, perm])
+
+    def fresh_caches():
+        shape = (c.n_layer, S + 1, nh, ML, hd)
+        return jnp.zeros(shape, cd), jnp.zeros(shape, cd)
+
+    return {
+        "decode_step": jax.jit(decode_step, donate_argnums=(4, 5)),
+        "prefill_chunk": jax.jit(prefill_chunk_fn, donate_argnums=(5, 6)),
+        "reorder": jax.jit(reorder, donate_argnums=(0, 1)),
+        "fresh_caches": fresh_caches,
+    }

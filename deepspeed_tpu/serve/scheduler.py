@@ -1,0 +1,304 @@
+"""Iteration-granular continuous-batching scheduler (host-only, deterministic).
+
+Orca's insight (OSDI '22): schedule at *iteration* granularity — every device
+step, finished sequences leave, waiting sequences join, and one long prompt
+prefills one chunk while everyone else decodes. This module is the pure host
+half of that loop: admission, chunked-prefill selection, per-step write-block
+accounting (with beam copy-on-write), preemption, and beam table forking. It
+never touches the device — the engine executes the plan each method returns.
+
+Determinism contract (pinned by tests/unit/test_serving_scheduler.py): every
+decision is a pure function of the submitted trace, so a replay produces a
+byte-identical schedule log. Concretely: the waiting queue orders by
+``(arrival, submit index)`` and is *front-blocking* (an unadmittable front
+blocks later arrivals — no overtaking); free slots and KV pages are handed
+out in index order; preemption victims are the latest-admitted groups first;
+and preemption is full restart (vLLM's recompute mode) — the restarted run
+recomputes bit-identical logits because every device program has one fixed
+shape, so discarding progress never changes the tokens (the preempt-resume
+equivalence test pins exactly this).
+"""
+
+from .block_allocator import AllocationError, BlockAllocator
+
+
+class Request:
+    """One serving request. ``arrival`` is the iteration index at which the
+    scheduler may first admit it (request traces are replayed in iteration
+    time, keeping schedules machine-independent)."""
+
+    def __init__(self, req_id, prompt, max_new_tokens, arrival=0, num_beams=1,
+                 eos_token_id=None, length_penalty=1.0):
+        self.req_id = req_id
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.arrival = int(arrival)
+        self.num_beams = int(num_beams)
+        self.eos_token_id = -1 if eos_token_id is None else int(eos_token_id)
+        self.length_penalty = float(length_penalty)
+
+
+class RequestOutput:
+    def __init__(self, req_id, status, tokens=None, score=None, refusal=None,
+                 ttft_iters=None, ttft_ms=None, finished_it=None,
+                 preemptions=0):
+        self.req_id = req_id
+        self.status = status            # "finished" | "refused"
+        self.tokens = tokens or []      # generated tokens (best beam)
+        self.score = score              # beam: GNMT-normalized score
+        self.refusal = refusal          # refusal reason when status=="refused"
+        self.ttft_iters = ttft_iters
+        self.ttft_ms = ttft_ms
+        self.finished_it = finished_it
+        self.preemptions = preemptions
+
+
+class Group:
+    """One admitted request in flight: 1 lane (greedy) or K beam lanes.
+    ``tables[k]`` is lane k's block table; ``generated[k]`` its tokens."""
+
+    def __init__(self, req, submit_idx, admission_idx, slots, table):
+        self.req = req
+        self.submit_idx = submit_idx
+        self.admission_idx = admission_idx
+        self.slots = slots                      # K slot ids, lane order
+        self.tables = [table]                   # lanes fork at prefill end
+        self.prefill_done = 0
+        self.phase = "prefill"
+        self.generated = []                     # per lane after first token
+        self.scores = None                      # beam lane scores (host floats)
+        self.live = None
+        self.entered_decode_it = None
+        self.first_token_it = None
+        self.first_token_ms = None
+        self.preemptions = 0
+
+    @property
+    def lanes(self):
+        return self.req.num_beams
+
+    @property
+    def prompt_len(self):
+        return len(self.req.prompt)
+
+    def next_pos(self, lane):
+        """Cache position the lane's next decode step writes (= position of
+        its newest token, which that step consumes)."""
+        return self.prompt_len + len(self.generated[lane]) - 1
+
+
+class Scheduler:
+    def __init__(self, *, num_slots, num_blocks, block_size, max_model_len,
+                 prefill_chunk):
+        self.num_slots = int(num_slots)
+        self.block_size = int(block_size)
+        self.max_model_len = int(max_model_len)
+        self.prefill_chunk = int(prefill_chunk)
+        self.allocator = BlockAllocator(num_blocks, block_size)
+        self.free_slots = list(range(self.num_slots))
+        self.waiting = []                       # Groups-to-be: (req, submit_idx)
+        self.running = []                       # admission order
+        self._submit_counter = 0
+        self._admission_counter = 0
+
+    # ------------------------------------------------------------ submission
+    def infeasible_reason(self, req):
+        T0, L, K = len(req.prompt), req.max_new_tokens, req.num_beams
+        BS = self.block_size
+        usable = self.allocator.num_blocks - 1
+        if T0 < 1 or L < 1:
+            return f"prompt ({T0}) and max_new_tokens ({L}) must be >= 1"
+        if K < 1 or K > self.num_slots:
+            return f"num_beams {K} exceeds {self.num_slots} slots"
+        if T0 + L > self.max_model_len:
+            return (f"prompt {T0} + {L} new tokens exceeds max_model_len "
+                    f"{self.max_model_len}")
+        shared = T0 // BS                       # full prompt blocks stay shared
+        per_lane = -(-(T0 + L) // BS) - shared  # worst-case exclusive suffix
+        worst = shared + K * per_lane
+        if worst > usable:
+            return (f"needs up to {worst} KV pages ({K} beam(s), "
+                    f"{T0 + L} tokens) but the pool has {usable}")
+        return None
+
+    def submit(self, req):
+        """Queue a request. Returns None on acceptance, or the refusal reason
+        for a request that can NEVER fit (refusal, not a crash)."""
+        reason = self.infeasible_reason(req)
+        if reason is not None:
+            return reason
+        self.waiting.append((req, self._submit_counter))
+        self._submit_counter += 1
+        self.waiting.sort(key=lambda e: (e[0].arrival, e[1]))
+        return None
+
+    @property
+    def idle(self):
+        return not self.waiting and not self.running
+
+    def next_arrival(self):
+        return self.waiting[0][0].arrival if self.waiting else None
+
+    # ------------------------------------------------------------- admission
+    def _admit_blocks_needed(self, req):
+        # prompt + first decode write, plus one-block fork headroom per extra
+        # beam — enough that an admitted group always reaches its first tokens
+        return (self.allocator.blocks_for_tokens(len(req.prompt) + 1)
+                + (req.num_beams - 1))
+
+    def admit(self, it):
+        """FIFO, front-blocking admission of every due request that fits."""
+        admitted = []
+        while self.waiting:
+            req, submit_idx = self.waiting[0]
+            if req.arrival > it:
+                break
+            if (req.num_beams > len(self.free_slots)
+                    or not self.allocator.can_allocate(
+                        self._admit_blocks_needed(req))):
+                break                            # front-blocking: no overtaking
+            self.waiting.pop(0)
+            slots = [self.free_slots.pop(0) for _ in range(req.num_beams)]
+            table = self.allocator.allocate(
+                self.allocator.blocks_for_tokens(len(req.prompt)))
+            g = Group(req, submit_idx, self._admission_counter, slots, table)
+            self._admission_counter += 1
+            self.running.append(g)
+            admitted.append(g)
+        return admitted
+
+    # ------------------------------------------------------------ preemption
+    def _preempt(self, g):
+        """Full restart: free everything, requeue at the group's original
+        queue position. The fixed-shape programs make the restarted run
+        bit-identical, so no generated state needs saving."""
+        for t in g.tables:
+            self.allocator.free(t)
+        g.tables = []
+        self.free_slots.extend(g.slots)
+        self.free_slots.sort()
+        self.running.remove(g)
+        g.preemptions += 1
+        req = g.req
+        req._preemptions_carry = g.preemptions  # survives the restart
+        self.waiting.append((req, g.submit_idx))
+        self.waiting.sort(key=lambda e: (e[0].arrival, e[1]))
+
+    def ensure_decode_room(self):
+        """Give every decode-phase lane an exclusive write block for this
+        iteration's token, preempting latest-admitted groups when the pool
+        runs dry. Returns (preempted_groups, copies) — ``copies`` are the
+        (src, dst) page copies the engine must run before decode."""
+        preempted, copies = [], []
+        i = 0
+        while i < len(self.running):
+            g = self.running[i]
+            if g.phase != "decode":
+                i += 1
+                continue
+            try:
+                # appends into ``copies`` in place so CoW pages claimed
+                # before a mid-group AllocationError keep their device copy
+                self._ensure_group_blocks(g, copies)
+            except AllocationError:
+                victim = self._pick_victim(g)
+                # copies targeting the victim's pages die with it (their dst
+                # pages go back to the pool and could be re-handed out)
+                victim_pages = set()
+                for t in victim.tables:
+                    victim_pages.update(t)
+                copies = [cp for cp in copies if cp[1] not in victim_pages]
+                self._preempt(victim)
+                preempted.append(victim)
+                continue          # retry index i (g again, or next if g died)
+            i += 1
+        return preempted, copies
+
+    def _pick_victim(self, needy):
+        later = [g for g in self.running if g.admission_idx > needy.admission_idx]
+        if later:
+            return max(later, key=lambda g: g.admission_idx)
+        return needy
+
+    def _ensure_group_blocks(self, g, copies):
+        BS = self.block_size
+        for lane in range(g.lanes):
+            bi = g.next_pos(lane) // BS
+            table = g.tables[lane]
+            if bi == len(table):
+                table.append(self.allocator.allocate(1)[0])
+            elif bi < len(table):
+                blk, copy = self.allocator.ensure_exclusive(table[bi])
+                if copy is not None:
+                    table[bi] = blk
+                    copies.append(copy)
+            else:  # can't happen: positions grow one token at a time
+                raise AssertionError("write block beyond table end")
+
+    # --------------------------------------------------------------- prefill
+    def next_prefill(self, it):
+        """Earliest-admitted group still prefilling gets one chunk. Returns
+        (group, pos, n_valid, chunk_tokens) or None; ``chunk_tokens`` is
+        padded to the fixed chunk length."""
+        for g in self.running:
+            if g.phase != "prefill":
+                continue
+            pos = g.prefill_done
+            n = min(self.prefill_chunk, g.prompt_len - pos)
+            chunk = g.req.prompt[pos:pos + n]
+            chunk = chunk + [0] * (self.prefill_chunk - n)
+            return g, pos, n, chunk
+        return None
+
+    def finish_prefill_chunk(self, g, n_valid, it):
+        """Advance prefill progress; returns True when the prompt completed
+        (the engine then samples the first token and calls begin_decode)."""
+        g.prefill_done += n_valid
+        return g.prefill_done == g.prompt_len
+
+    def begin_decode(self, g, first_tokens, it, scores=None, live=None):
+        """Move a group to decode. ``first_tokens`` is [K] (greedy: [tok]);
+        beam lanes fork the prefilled table. First decode runs NEXT iteration
+        (its write block is ensured at that iteration's start)."""
+        g.generated = [[int(t)] for t in first_tokens]
+        g.scores = scores
+        g.live = live
+        g.phase = "decode"
+        g.entered_decode_it = it
+        g.first_token_it = it
+        base = g.tables[0]
+        g.tables = [base] + [self.allocator.fork(base)
+                             for _ in range(g.lanes - 1)]
+
+    # ---------------------------------------------------------------- decode
+    def decode_lanes(self):
+        """[(group, lane, slot)] for every decode-phase lane, admission/lane
+        order — the deterministic decode-batch composition."""
+        out = []
+        for g in self.running:
+            if g.phase == "decode":
+                for lane, slot in enumerate(g.slots):
+                    out.append((g, lane, slot))
+        return out
+
+    def reorder_beams(self, g, parents):
+        """Apply a beam step's parent selection to tables and generated
+        tokens — the paged analog of the dense path's ``kcs[:, flatp]``
+        cache shuffle, done with refcount forks instead of copies."""
+        old_tables = g.tables
+        g.tables = [self.allocator.fork(old_tables[p]) for p in parents]
+        for t in old_tables:
+            self.allocator.free(t)
+        g.generated = [list(g.generated[p]) for p in parents]
+
+    def finish_group(self, g):
+        for t in g.tables:
+            self.allocator.free(t)
+        g.tables = []
+        self.free_slots.extend(g.slots)
+        self.free_slots.sort()
+        self.running.remove(g)
+
+    # ------------------------------------------------------------------ misc
+    def occupancy(self):
+        return 1.0 - len(self.free_slots) / self.num_slots

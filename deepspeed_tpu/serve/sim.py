@@ -1,0 +1,179 @@
+"""``ds-tpu serve-sim`` — deterministic request-replay driver for the engine.
+
+Replays a seeded synthetic trace (mixed prompt/generation lengths, staggered
+arrivals, a sprinkle of beam-search requests) through InferenceEngine on the
+CPU mesh and asserts the three serving invariants:
+
+1. **Zero recompiles after warmup** — every serve:* program compiles exactly
+   once for the whole trace (compile watchdog through TelemetrySession).
+2. **Bit-exact paging** — the engine runs with ``mirror=True``, so every
+   prefill chunk and decode step is compared bitwise against the dense-cache
+   oracle (serve/oracle.py); one diverging ulp fails the run.
+3. **Deterministic schedule** — with ``--replay``, the whole trace is run
+   twice on fresh engines and the per-iteration schedule logs must be
+   byte-identical (json.dumps) and the outputs token-identical.
+
+Serving/* scalars (occupancy, TTFT, goodput) land in the TelemetrySession's
+scalars.jsonl. Exit 0 = all invariants held.
+"""
+
+import argparse
+import json
+import sys
+
+
+def synth_trace(n, *, vocab_size, max_model_len, seed, beam_every=7,
+                include_infeasible=False):
+    """Seeded mixed trace: prompts 1..~ML/2, generations 1..~ML/4, arrivals
+    staggered 0-2 iterations apart, every ``beam_every``-th request beam-4."""
+    import numpy as np
+    from .scheduler import Request
+
+    rng = np.random.RandomState(seed)
+    reqs, arrival = [], 0
+    for i in range(n):
+        arrival += int(rng.randint(0, 3))
+        T0 = int(rng.randint(1, max(2, max_model_len // 2)))
+        L = int(rng.randint(1, max(2, max_model_len // 4)))
+        if T0 + L > max_model_len:          # keep the trace feasible
+            L = max_model_len - T0
+        K = 4 if (beam_every and i % beam_every == beam_every - 1) else 1
+        prompt = rng.randint(0, vocab_size, size=T0).tolist()
+        reqs.append(Request(f"req{i:03d}", prompt, L, arrival=arrival,
+                            num_beams=K))
+    if include_infeasible:
+        prompt = rng.randint(0, vocab_size, size=max_model_len).tolist()
+        reqs.append(Request("req-too-long", prompt, max_model_len,
+                            arrival=0))
+    return reqs
+
+
+def _build(args, telemetry):
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.gpt2 import GPT2Config, GPT2Model
+    from .engine import InferenceEngine
+
+    cfg = GPT2Config(vocab_size=args.vocab_size, n_positions=args.max_model_len,
+                     n_embd=args.n_embd, n_layer=args.n_layer,
+                     n_head=args.n_head, compute_dtype=jnp.float32,
+                     loss_chunk=0)
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(args.seed))
+    engine = InferenceEngine(
+        model, params, num_slots=args.slots, block_size=args.block_size,
+        num_blocks=args.num_blocks, max_model_len=args.max_model_len,
+        prefill_chunk=args.prefill_chunk, use_pallas=args.pallas,
+        telemetry=telemetry, mirror=not args.no_mirror)
+    return engine
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="ds-tpu serve-sim",
+        description="deterministic serving-engine replay with bitwise oracle "
+                    "+ zero-recompile assertions")
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--num-blocks", type=int, default=257)
+    ap.add_argument("--max-model-len", type=int, default=256)
+    ap.add_argument("--prefill-chunk", type=int, default=32)
+    ap.add_argument("--vocab-size", type=int, default=128)
+    ap.add_argument("--n-embd", type=int, default=32)
+    ap.add_argument("--n-layer", type=int, default=2)
+    ap.add_argument("--n-head", type=int, default=2)
+    ap.add_argument("--no-mirror", action="store_true",
+                    help="skip the dense-oracle bitwise lockstep (faster)")
+    ap.add_argument("--pallas", action="store_true",
+                    help="use the Pallas paged-decode kernel (interpret mode "
+                         "on CPU)")
+    ap.add_argument("--replay", action="store_true",
+                    help="run the trace twice and assert byte-identical "
+                         "schedules")
+    ap.add_argument("--include-infeasible", action="store_true",
+                    help="append a request that can never fit (exercises "
+                         "admission refusal)")
+    ap.add_argument("--output", default="serve_sim_telemetry",
+                    help="TelemetrySession output dir for Serving/* scalars")
+    args = ap.parse_args(argv)
+
+    from ..utils.telemetry import TelemetrySession
+
+    trace = synth_trace(args.requests, vocab_size=args.vocab_size,
+                        max_model_len=args.max_model_len, seed=args.seed,
+                        include_infeasible=args.include_infeasible)
+
+    session = TelemetrySession(output_path=args.output, job_name="serve_sim")
+    engine = _build(args, session)
+    outputs, logs = engine.run(trace)
+
+    finished = [o for o in outputs if o.status == "finished"]
+    refused = [o for o in outputs if o.status == "refused"]
+    tokens = sum(len(o.tokens) for o in finished)
+    preempts = sum(len(l["preempted"]) for l in logs)
+    ttfts = [o.ttft_iters for o in finished if o.ttft_iters is not None]
+
+    failures = []
+
+    # invariant 1: one compile per program, zero recompiles, whole trace
+    serve_names = sorted(n for n in session.watchdog.records
+                         if n.startswith("serve:"))
+    total_recompiles = 0
+    for name in serve_names:
+        n_c = session.watchdog.compiles(name)
+        n_r = session.watchdog.recompiles(name)
+        total_recompiles += n_r
+        if n_r:
+            failures.append(f"{name}: {n_r} recompile(s) after warmup")
+    if not serve_names:
+        failures.append("no serve:* programs reached the compile watchdog")
+
+    # invariant 2: the oracle lockstep actually ran
+    if not args.no_mirror and engine.mirror_checks == 0:
+        failures.append("mirror enabled but no bitwise checks executed")
+
+    # invariant 3 (optional): byte-identical replay on a fresh engine
+    if args.replay:
+        engine2 = _build(args, None)
+        outputs2, logs2 = engine2.run(
+            synth_trace(args.requests, vocab_size=args.vocab_size,
+                        max_model_len=args.max_model_len, seed=args.seed,
+                        include_infeasible=args.include_infeasible))
+        if json.dumps(logs) != json.dumps(logs2):
+            failures.append("replay schedule log diverged")
+        toks1 = [(o.req_id, o.status, o.tokens) for o in outputs]
+        toks2 = [(o.req_id, o.status, o.tokens) for o in outputs2]
+        if toks1 != toks2:
+            failures.append("replay outputs diverged")
+
+    session.close()
+
+    print(f"serve-sim: {len(finished)} finished / {len(refused)} refused "
+          f"of {len(trace)} requests over {len(logs)} iterations")
+    print(f"  tokens generated : {tokens}")
+    print(f"  preemptions      : {preempts}")
+    if ttfts:
+        print(f"  TTFT iters       : mean {sum(ttfts) / len(ttfts):.1f} "
+              f"max {max(ttfts)}")
+    print(f"  programs watched : {len(serve_names)} "
+          f"(recompiles after warmup: {total_recompiles})")
+    if not args.no_mirror:
+        print(f"  oracle lockstep  : {engine.mirror_checks} bitwise checks, "
+              f"all identical")
+    if args.replay:
+        print("  replay           : byte-identical schedule + outputs")
+    print(f"  scalars          : {session.monitor.log_dir}/scalars.jsonl")
+
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print("serve-sim: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,97 @@
+"""Block allocator unit tests: alloc/free/fragmentation, refcounted fork +
+copy-on-write, and OOM surfacing as AllocationError (admission refusal),
+never a crash."""
+
+import pytest
+
+from deepspeed_tpu.serve.block_allocator import (AllocationError,
+                                                 BlockAllocator, NULL_BLOCK)
+
+
+def test_block_zero_is_reserved():
+    a = BlockAllocator(8, 4)
+    got = a.allocate(7)
+    assert NULL_BLOCK not in got
+    assert sorted(got) == list(range(1, 8))
+    assert a.num_free == 0
+
+
+def test_ceil_div_blocks_for_tokens():
+    a = BlockAllocator(8, 4)
+    assert a.blocks_for_tokens(1) == 1
+    assert a.blocks_for_tokens(4) == 1
+    assert a.blocks_for_tokens(5) == 2
+    assert a.blocks_for_tokens(16) == 4
+
+
+def test_oom_is_a_refusal_not_a_crash():
+    a = BlockAllocator(4, 4)          # 3 usable
+    a.allocate(2)
+    assert not a.can_allocate(2)
+    with pytest.raises(AllocationError):
+        a.allocate(2)
+    assert a.num_free == 1            # failed allocation took nothing
+
+
+def test_free_returns_blocks_and_double_free_raises():
+    a = BlockAllocator(8, 4)
+    got = a.allocate(3)
+    a.free(got)
+    assert a.num_free == 7
+    with pytest.raises(ValueError):
+        a.free(got)
+
+
+def test_fragmented_free_list_still_serves_fifo_deterministically():
+    """Interleaved alloc/free leaves a shuffled free list; allocation order
+    must still be a pure function of the history (replay determinism)."""
+    def history(a):
+        x = a.allocate(3)
+        y = a.allocate(2)
+        a.free([x[1]])
+        a.free(y)
+        a.free([x[0]])
+        return a.allocate(4)
+
+    first = history(BlockAllocator(8, 4))
+    second = history(BlockAllocator(8, 4))
+    assert first == second
+    assert len(set(first)) == 4
+
+
+def test_fork_shares_and_free_releases_at_last_ref():
+    a = BlockAllocator(8, 4)
+    table = a.allocate(2)
+    forked = a.fork(table)
+    assert forked == table
+    assert all(a.refcount(b) == 2 for b in table)
+    a.free(table)
+    assert a.num_free == 5            # still held by the fork
+    a.free(forked)
+    assert a.num_free == 7
+
+
+def test_ensure_exclusive_copy_on_write():
+    a = BlockAllocator(8, 4)
+    table = a.allocate(1)
+    a.fork(table)
+    blk, copy = a.ensure_exclusive(table[0])
+    assert blk != table[0]
+    assert copy == (table[0], blk)    # device must mirror src -> dst
+    assert a.refcount(table[0]) == 1 and a.refcount(blk) == 1
+    # already-exclusive page: no copy
+    blk2, copy2 = a.ensure_exclusive(blk)
+    assert blk2 == blk and copy2 is None
+
+
+def test_null_block_is_ignored_by_free_and_fork():
+    a = BlockAllocator(8, 4)
+    a.free([NULL_BLOCK])              # no-op, no raise
+    assert a.fork([NULL_BLOCK]) == [NULL_BLOCK]
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError):
+        BlockAllocator(1, 4)          # no room for the null page
+    with pytest.raises(ValueError):
+        BlockAllocator(8, 0)

@@ -104,6 +104,18 @@ SWEEP = {
         ({"enabled": True, "subtree_depth": 0}, ("raise", ValueError)),
         ({"enabled": True, "ring_size": 0}, ("raise", ValueError)),
     ),
+    "serving": (
+        ({"enabled": True, "block_size": 8, "max_model_len": 64},
+         ("attr", "serving_block_size", 8)),
+        ({"num_blocks": 1025}, ("attr", "serving_num_blocks", 1025)),
+        ({"max_seqs": 16}, ("attr", "serving_max_seqs", 16)),
+        ({"prefill_chunk": 64}, ("attr", "serving_prefill_chunk", 64)),
+        ({"use_pallas_decode": True}, ("attr", "serving_use_pallas_decode", True)),
+        ({"num_blocks": 1}, ("raise", ValueError)),     # no room for null page
+        ({"block_size": 0}, ("raise", ValueError)),
+        # paged gather bit-matches the oracle only when the tiling is exact
+        ({"block_size": 16, "max_model_len": 100}, ("raise", ValueError)),
+    ),
     "sparse_attention": ({"mode": "fixed", "block": 16},
                          ("attr_pred", lambda c: c.sparse_attention.mode == "fixed")),
     "sequence_parallel": ({"enabled": True, "schedule": "masked"},
@@ -172,6 +184,12 @@ def test_unknown_pipeline_trace_key_warns(capture):
     _cfg(telemetry={"pipeline_trace": {"enabled": True, "capactiy": 7}})
     assert "unknown telemetry.pipeline_trace config key" in capture.text
     assert "capactiy" in capture.text
+
+
+def test_unknown_serving_key_warns(capture):
+    _cfg(serving={"enabled": True, "blok_size": 8})
+    assert "unknown serving config key" in capture.text
+    assert "blok_size" in capture.text
 
 
 def test_unknown_numerics_key_warns(capture):

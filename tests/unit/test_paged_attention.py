@@ -1,0 +1,211 @@
+"""Paged KV-cache numerics.
+
+The load-bearing bitwise pair is paged <-> serve/oracle.py: the oracle's dense
+cached programs are written with the same op structure (same einsum shapes,
+same mask widths, same write-then-read order) so XLA compiles the same
+arithmetic and the logits match BIT FOR BIT — that is the invariant the
+engine's mirror mode and ds-tpu serve-sim replay at scale.
+
+Against the model's own ``_build_cached_forward`` the guarantee is weaker:
+same math, but a DIFFERENT jit program (contiguous cache, no page gather), so
+XLA may fuse differently and individual logits can land 1 ulp apart for some
+inputs (observed: 3e-08 on one of four random prompts on CPU). We pin that
+comparison to float tolerance + argmax-token agreement, not bits.
+
+The Pallas decode kernel reduces page-by-page (online softmax) and is pinned
+to float tolerance against the flat-softmax gather reference."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.serve.block_allocator import BlockAllocator
+from deepspeed_tpu.serve.oracle import build_oracle_programs
+from deepspeed_tpu.serve.paged import build_paged_programs
+
+S, BS, MB, C = 4, 4, 8, 8          # slots, block size, table width, chunk
+ML = MB * BS                       # 32
+NB = 33                            # pool pages (1 null + 32)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = GPT2Config(vocab_size=64, n_positions=ML, n_embd=16, n_layer=2,
+                     n_head=2, compute_dtype=jnp.float32, loss_chunk=0)
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    progs = build_paged_programs(model, num_slots=S, block_size=BS,
+                                 max_blocks=MB, prefill_chunk=C)
+    oracle = build_oracle_programs(model, num_slots=S, max_len=ML,
+                                   prefill_chunk=C)
+    return model, params, progs, oracle
+
+
+def _paged_state(model):
+    c = model.config
+    shape = (c.n_layer, NB, BS, c.n_head, c.head_dim)
+    return jnp.zeros(shape, c.compute_dtype), jnp.zeros(shape, c.compute_dtype)
+
+
+def test_paged_decode_bitwise_matches_dense_oracle(setup):
+    """Prefill S sequences through the paged path AND the dense-cache oracle,
+    then decode 6 greedy steps at [S, 1] in lockstep — every logit row must be
+    bit-identical at every step. The model's own ``_build_cached_forward`` is
+    held to tolerance + identical argmax tokens (different jit program ->
+    fusion may round 1 ulp apart; see module docstring)."""
+    model, params, progs, oracle = setup
+    T0, steps = C, 6                            # one full chunk per prompt
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(0, 64, size=(S, T0)).astype(np.int32)
+
+    # paged prefill: one chunk per sequence through its block table (pages
+    # for the whole prompt + decode horizon up front — the engine's scheduler
+    # grows tables one page per step instead)
+    alloc = BlockAllocator(NB, BS)
+    kp, vp = _paged_state(model)
+    okcs, ovcs = oracle["fresh_caches"]()
+    tbl = np.zeros((S, MB), np.int32)
+    fwd = model._build_cached_forward(ML)
+    c = model.config
+    kcs = jnp.zeros((c.n_layer, S, c.n_head, ML, c.head_dim), c.compute_dtype)
+    vcs = jnp.zeros_like(kcs)
+    paged_first = []
+    for s in range(S):
+        t = alloc.allocate(alloc.blocks_for_tokens(T0 + steps))
+        tbl[s, :len(t)] = t
+        plg, kp, vp = progs["prefill_chunk"](
+            params, jnp.asarray(prompts[s:s + 1]), jnp.int32(0),
+            jnp.int32(T0), jnp.asarray(tbl[s]), kp, vp)
+        olg, okcs, ovcs = oracle["prefill_chunk"](
+            params, jnp.asarray(prompts[s:s + 1]), jnp.int32(0),
+            jnp.int32(T0), jnp.int32(s), okcs, ovcs)
+        np.testing.assert_array_equal(np.asarray(plg[0]), np.asarray(olg[0]))
+        paged_first.append(np.asarray(plg[0]))
+
+    # model forward reference: [S, T0] batched prefill, tolerance only
+    flg, kcs, vcs = fwd(params, jnp.asarray(prompts), 0, kcs, vcs)
+    np.testing.assert_allclose(np.asarray(paged_first), np.asarray(flg),
+                               atol=1e-5)
+
+    # greedy decode lockstep at [S, 1] on all three, 6 tokens
+    toks = np.argmax(np.asarray(paged_first), axis=1).astype(np.int32)
+    pos = np.full(S, T0, np.int32)
+    active = np.ones(S, bool)
+    for _ in range(steps):
+        pl_, kp, vp = progs["decode_step"](
+            params, jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(tbl),
+            jnp.asarray(active), kp, vp)
+        ol_, okcs, ovcs = oracle["decode_step"](
+            params, jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(active),
+            okcs, ovcs)
+        dl, kcs, vcs = fwd(params, jnp.asarray(toks[:, None]),
+                           int(pos[0]), kcs, vcs)
+        np.testing.assert_array_equal(np.asarray(pl_), np.asarray(ol_))
+        np.testing.assert_allclose(np.asarray(pl_), np.asarray(dl), atol=1e-5)
+        assert (np.argmax(np.asarray(pl_), axis=1)
+                == np.argmax(np.asarray(dl), axis=1)).all()
+        toks = np.argmax(np.asarray(pl_), axis=1).astype(np.int32)
+        pos += 1
+
+
+def test_chunked_prefill_bitwise_matches_oracle_chunks(setup):
+    """Splitting a prompt across chunks must write the identical cache bytes:
+    the paged 2-chunk prefill and the oracle fed the same two [1, C] chunks
+    agree bitwise through the decode that follows (chunk boundaries change
+    gemm shapes, but each position's row math is independent — pinned here)."""
+    model, params, progs, oracle = setup
+    rng = np.random.RandomState(1)
+    T0 = C + 3                                  # forces a second, padded chunk
+    prompt = rng.randint(0, 64, size=T0).astype(np.int32)
+
+    alloc = BlockAllocator(NB, BS)
+    kp, vp = _paged_state(model)
+    okcs, ovcs = oracle["fresh_caches"]()
+    t = alloc.allocate(alloc.blocks_for_tokens(T0 + 1))
+    tbl = np.zeros(MB, np.int32)
+    tbl[:len(t)] = t
+    for start in (0, C):
+        n = min(C, T0 - start)
+        chunk = np.zeros(C, np.int32)
+        chunk[:n] = prompt[start:start + n]
+        lg, kp, vp = progs["prefill_chunk"](
+            params, jnp.asarray(chunk[None]), jnp.int32(start), jnp.int32(n),
+            jnp.asarray(tbl), kp, vp)
+        og, okcs, ovcs = oracle["prefill_chunk"](
+            params, jnp.asarray(chunk[None]), jnp.int32(start), jnp.int32(n),
+            jnp.int32(0), okcs, ovcs)
+    np.testing.assert_array_equal(np.asarray(lg[0]), np.asarray(og[0]))
+    tok = int(np.argmax(np.asarray(lg[0])))
+
+    # decode comparison at [S, 1] with only slot 0 active — the oracle decode
+    # runs all S rows, so keep the padded rows' inputs fixed on both sides
+    toks = np.zeros(S, np.int32)
+    toks[0] = tok
+    pos = np.zeros(S, np.int32)
+    pos[0] = T0
+    tables = np.zeros((S, MB), np.int32)
+    tables[0] = tbl
+    active = np.zeros(S, bool)
+    active[0] = True
+    pl_, kp, vp = progs["decode_step"](
+        params, jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(tables),
+        jnp.asarray(active), kp, vp)
+    ol_, okcs, ovcs = oracle["decode_step"](
+        params, jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(active),
+        okcs, ovcs)
+    np.testing.assert_array_equal(np.asarray(pl_[0]), np.asarray(ol_[0]))
+
+    # the model's full (uncached, unchunked) forward agrees on the next token
+    full = model.apply(params, jnp.asarray(prompt[None]))
+    assert int(np.argmax(np.asarray(full[0, T0 - 1]))) == tok
+
+
+def test_copy_blocks_copies_pages_and_null_self_copy_is_noop(setup):
+    model, params, progs, oracle = setup
+    kp, vp = _paged_state(model)
+    rng = np.random.RandomState(2)
+    kp = jnp.asarray(rng.randn(*kp.shape), kp.dtype)
+    vp = jnp.asarray(rng.randn(*vp.shape), vp.dtype)
+    before_k = np.asarray(kp)
+    src = np.zeros(S, np.int32)
+    dst = np.zeros(S, np.int32)
+    src[0], dst[0] = 3, 7                        # one real copy, rest pads
+    kp2, vp2 = progs["copy_blocks"](kp, vp, jnp.asarray(src),
+                                    jnp.asarray(dst))
+    after_k = np.asarray(kp2)
+    np.testing.assert_array_equal(after_k[:, 7], before_k[:, 3])
+    mask = np.ones(NB, bool)
+    mask[7] = False
+    np.testing.assert_array_equal(after_k[:, mask], before_k[:, mask])
+
+
+def test_pallas_paged_decode_matches_dense_gather_reference():
+    """The opt-in Pallas kernel (online softmax, page-by-page) matches the
+    flat-softmax dense gather to float tolerance across history lengths."""
+    from deepspeed_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+    rng = np.random.RandomState(0)
+    nl, nh, hd = 2, 2, 8
+    kp = jnp.asarray(rng.randn(nl, NB, BS, nh, hd), jnp.float32)
+    vp = jnp.asarray(rng.randn(nl, NB, BS, nh, hd), jnp.float32)
+    q = jnp.asarray(rng.randn(S, nh, 1, hd), jnp.float32)
+    tables = jnp.asarray(rng.randint(1, NB, size=(S, MB)), jnp.int32)
+    lengths = jnp.asarray([1, 5, BS * MB, 17], jnp.int32)
+
+    for li in range(nl):
+        y = paged_decode_attention(q, kp, vp, li, tables, lengths,
+                                   block_size=BS)
+        g = kp[li][tables].reshape(S, ML, nh, hd).transpose(0, 2, 1, 3)
+        gv = vp[li][tables].reshape(S, ML, nh, hd).transpose(0, 2, 1, 3)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, g,
+                       preferred_element_type=jnp.float32) / math.sqrt(hd)
+        mask = (jnp.arange(ML)[None, :] < lengths[:, None])[:, None, None, :]
+        s = jnp.where(mask, s, jnp.float32(-1e9))
+        p = jax.nn.softmax(s, axis=-1)
+        ref = jnp.einsum("bhqk,bhkd->bhqd", p, gv,
+                         preferred_element_type=jnp.float32)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5)

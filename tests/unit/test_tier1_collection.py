@@ -36,6 +36,16 @@ def test_perf_scripts_never_collected_by_tier1():
         f"rename them (the perf drivers are invoked directly, not collected)")
 
 
+def test_serving_perf_driver_stays_out_of_tier1():
+    """The serving benchmark (TPU-only, minutes of wall clock) must exist as
+    a direct-invocation driver and never under a collectable name."""
+    perf = REPO / "tests" / "perf"
+    assert (perf / "serving_perf.py").exists()
+    assert not (perf / "test_serving_perf.py").exists(), (
+        "serving perf driver must not be collectable — tier-1 would sys.exit "
+        "on the CPU mesh")
+
+
 def test_perf_directory_has_no_conftest_collection_override():
     """A conftest.py in tests/perf/ could re-add collection via collect_ignore
     tricks or python_files overrides; keep the directory plugin-free."""
