@@ -1,0 +1,19 @@
+"""Topology-aware communication subsystem: two-level ICI+DCN collectives.
+
+- :mod:`.topology` — slice factorization of the mesh's data axis.
+- :mod:`.hierarchical` — two-level (reduce-scatter / compressed-allreduce /
+  all-gather) schedules generalizing ``runtime/custom_collectives``.
+- :mod:`.sim` — ``ds-tpu comm-sim``: deterministic replay + per-level
+  collective manifest gate on the 8-device CPU mesh.
+"""
+
+from .topology import CommTopology, derive_num_slices, derive_topology
+from .hierarchical import (two_level_allreduce, two_level_compressed_allreduce,
+                           two_level_sum, two_level_compressed,
+                           error_state_shapes)
+
+__all__ = [
+    "CommTopology", "derive_num_slices", "derive_topology",
+    "two_level_allreduce", "two_level_compressed_allreduce",
+    "two_level_sum", "two_level_compressed", "error_state_shapes",
+]

@@ -116,6 +116,32 @@ def _build_external_master_accum():
     return eng, _sample_batch()
 
 
+def _build_comm_hierarchical():
+    # two-level ICI+DCN grad exchange (uncompressed): reduce-scatter/all-gather
+    # ride inside the 2x4 slice factorization, one fp32 psum crosses slices
+    import deepspeed_tpu
+    model = LintModel()
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+        config_params=_config(
+            zero_optimization={"stage": 2},
+            comm={"mode": "hierarchical", "dcn_slices": 2}))
+    return eng, _sample_batch()
+
+
+def _build_comm_compressed():
+    # error-feedback 1-bit cross-slice exchange: the DCN phases ship packed u8
+    # signs (all-to-all + all-gather) and fp32 per-segment scales
+    import deepspeed_tpu
+    model = LintModel()
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+        config_params=_config(
+            zero_optimization={"stage": 2},
+            comm={"mode": "hierarchical_compressed", "dcn_slices": 2}))
+    return eng, _sample_batch()
+
+
 def _build_zero_offload():
     import deepspeed_tpu
     model = LintModel()
@@ -204,6 +230,8 @@ BUILDERS = {
     "standard": _build_standard,
     "external_master_fused": _build_external_master_fused,
     "external_master_accum": _build_external_master_accum,
+    "comm_hierarchical": _build_comm_hierarchical,
+    "comm_compressed": _build_comm_compressed,
     "zero_offload": _build_zero_offload,
     "pipeline": _build_pipeline,
     "gpt2_decode": _build_gpt2_decode,

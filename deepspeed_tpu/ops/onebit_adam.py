@@ -17,8 +17,14 @@ reference flattens per-param; one fused buffer is friendlier to the TPU's collec
 granularity) padded so each of the dp server chunks is lane-aligned. State:
 
   exp_avg / exp_avg_sq : (n_pad,) replicated
-  worker_error         : (dp, n_pad) sharded P(data, None) — row i lives on worker i
+  worker_error         : (dp, n_pad // slice_size) sharded P(data, None) — row i on worker i
   server_error         : (dp, n_pad // dp) sharded P(data, None)
+
+With a hierarchical :class:`~..comm.topology.CommTopology` the frozen-phase momentum
+averaging routes through the two-level ICI+DCN schedule (comm/hierarchical.py): the
+worker residual then covers only the device's post-reduce-scatter ICI chunk. The flat
+layout is the ``slice_size == 1`` special case, keeping the historical ``(dp, n_pad)``
+worker shape.
 
 ``apply`` expects **stacked unreduced gradients**: each leaf has a leading dp axis,
 sharded over ``data``, produced by the engine's shard_map grad path. ZeRO stages >= 1 are
@@ -32,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..comm.hierarchical import error_state_shapes, two_level_compressed_allreduce
 from ..parallel.mesh import DATA_AXIS
 from ..runtime.custom_collectives import compressed_allreduce, padded_size
 
@@ -39,7 +46,7 @@ from ..runtime.custom_collectives import compressed_allreduce, padded_size
 class OneBitAdamState(NamedTuple):
     exp_avg: jnp.ndarray      # (n_pad,) fp32
     exp_avg_sq: jnp.ndarray   # (n_pad,) fp32
-    worker_error: jnp.ndarray  # (dp, n_pad) fp32
+    worker_error: jnp.ndarray  # (dp, n_pad // slice_size) fp32
     server_error: jnp.ndarray  # (dp, n_pad // dp) fp32
 
 
@@ -69,11 +76,19 @@ def _unflatten(vec, recipe):
 class OneBitAdam:
     """(init, apply) optimizer pair with 1-bit compressed momentum averaging."""
 
-    def __init__(self, freeze_step: int, dp_size: int, mesh: Mesh):
+    def __init__(self, freeze_step: int, dp_size: int, mesh: Mesh, topology=None):
         assert mesh is not None, "OneBitAdam needs the device mesh for its compressed allreduce"
         self.freeze_step = int(freeze_step)
         self.dp_size = int(dp_size)
         self.mesh = mesh
+        # Hierarchical CommTopology routes frozen-phase momentum averaging over the
+        # two-level ICI+DCN schedule; None (or a single-slice topology) keeps the
+        # historical flat compressed allreduce, HLO-for-HLO.
+        self.topology = topology
+        self._hier = topology is not None and topology.is_hierarchical
+        if self._hier:
+            assert topology.dp == self.dp_size, (
+                f"topology dp={topology.dp} != optimizer dp={self.dp_size}")
         self._seg_ids = None   # per-leaf scale segments (built lazily from the param tree)
         self._seg_key = None   # (treedef, leaf shapes, n_pad) the cached map was built for
 
@@ -100,11 +115,15 @@ class OneBitAdam:
         n = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(master_params))
         n_pad = padded_size(n, self.dp_size)
         dp = self.dp_size
+        if self._hier:
+            we_shape, se_shape = error_state_shapes(n_pad, self.topology)
+        else:
+            we_shape, se_shape = (dp, n_pad), (dp, n_pad // dp)
         return OneBitAdamState(
             exp_avg=jnp.zeros((n_pad,), jnp.float32),
             exp_avg_sq=jnp.zeros((n_pad,), jnp.float32),
-            worker_error=jnp.zeros((dp, n_pad), jnp.float32),
-            server_error=jnp.zeros((dp, n_pad // dp), jnp.float32))
+            worker_error=jnp.zeros(we_shape, jnp.float32),
+            server_error=jnp.zeros(se_shape, jnp.float32))
 
     def state_shardings(self, mesh: Mesh):
         return OneBitAdamState(
@@ -147,8 +166,12 @@ class OneBitAdam:
             # Worker-local momentum update (onebit_adam.py:335-336), then 1-bit averaging
             # with per-tensor scales (reference compresses each param separately).
             m_local = beta1 * m[None, :] + (1.0 - beta1) * g_stacked
-            new_m, new_we, new_se = compressed_allreduce(self.mesh, m_local, we, se,
-                                                         seg_ids=seg_ids)
+            if self._hier:
+                new_m, new_we, new_se = two_level_compressed_allreduce(
+                    self.mesh, m_local, we, se, self.topology, seg_ids=seg_ids)
+            else:
+                new_m, new_we, new_se = compressed_allreduce(self.mesh, m_local, we, se,
+                                                             seg_ids=seg_ids)
             return new_m, v, new_we, new_se
 
         m, v, we, se = jax.lax.cond(
@@ -161,26 +184,111 @@ class OneBitAdam:
         return new_params, OneBitAdamState(m, v, we, se)
 
     # ---------------------------------------------------------------- elastic restore
+    @staticmethod
+    def _ef_geometry(we_shape, se_shape):
+        """(dp, slice_size, n_pad) implied by the two error-buffer shapes: the
+        server rows give dp, its columns give n_pad = dp * csize, and the worker
+        columns give slice_size = n_pad / worker_cols (flat layout -> 1)."""
+        dp = int(se_shape[0])
+        n_pad = dp * int(se_shape[1])
+        L = n_pad // int(we_shape[1])
+        assert (int(we_shape[0]) == dp and L >= 1 and dp % L == 0
+                and L * int(we_shape[1]) == n_pad), (we_shape, se_shape)
+        return dp, L, n_pad
+
+    @staticmethod
+    def _server_offsets(dp, L, n_pad):
+        """Global start offset of each device's server sub-chunk: device d owns
+        ``(d % L) * (n_pad // L) + (d // L) * (n_pad // dp)`` — the flat layout
+        (L == 1) reduces to the historical ``d * csize`` tiling."""
+        C, csize = n_pad // L, n_pad // dp
+        return [(d % L) * C + (d // L) * csize for d in range(dp)]
+
     def elastic_adapt(self, loaded_flat: dict, template_flat: dict) -> dict:
         """Adapt a checkpointed state dict saved under a different DP world size.
 
-        The moment vectors are truncated/zero-extended to the new lane-padded length
-        (the padded tail never reaches parameters); the (dp, ...) error-feedback buffers
-        are residuals, so on a topology change they reset to zero — costing one step of
-        extra compression error, the same trade the reference makes when it lazily
-        (re)allocates worker/server errors (onebit_adam.py:302-312).
+        Moment vectors are truncated/zero-extended to the new lane-padded length
+        (the padded tail never reaches parameters). The (dp, ...) error-feedback
+        buffers are residuals of one fixed global vector chunked by
+        topology-dependent global offsets, so instead of zeroing them on a
+        world-size change (losing accumulated compression correction — the
+        reference's lazy-reallocation trade, onebit_adam.py:302-312), the global
+        residual is reconstructed from the old chunking and re-chunked under the
+        new one:
+
+        - ``server_error``: the dp sub-chunks tile the padded vector exactly, so
+          re-chunking is a pure index permutation — every element of the
+          real-data region survives BIT-IDENTICALLY; only the old padded tail
+          (residual of structural zeros) is dropped or zero-filled when the
+          lane padding changes with dp.
+        - ``worker_error``: the ``num_slices`` devices sharing a chunk position
+          hold independent residuals (each slice compressed its own partial
+          mean), and only their mean enters the averaged output — so the f64
+          mean is re-placed onto every new holder of the position:
+          mean-preserving, the strongest invariant a topology change admits.
         """
         out = {}
         for key, tmpl in template_flat.items():
             v = loaded_flat.get(key)
-            tmpl_shape = tuple(tmpl.shape)
-            if v is not None and tuple(v.shape) == tmpl_shape:
-                out[key] = v
-            elif v is not None and v.ndim == 1 and len(tmpl_shape) == 1:
-                buf = np.zeros(tmpl_shape, np.float32)
-                keep = min(v.size, int(tmpl_shape[0]))
-                buf[:keep] = np.asarray(v)[:keep]
-                out[key] = buf
+            tshape = tuple(int(s) for s in tmpl.shape)
+            kind = ("worker_error" if key.endswith("worker_error")
+                    else "server_error" if key.endswith("server_error") else None)
+            if v is None:
+                out[key] = np.zeros(tshape, np.float32)
+                continue
+            if kind is None:
+                if tuple(v.shape) == tshape:
+                    out[key] = v  # geometry unchanged: carried over bit-identically
+                elif v.ndim == 1 and len(tshape) == 1:
+                    buf = np.zeros(tshape, np.float32)
+                    keep = min(v.size, tshape[0])
+                    buf[:keep] = np.asarray(v)[:keep]
+                    out[key] = buf
+                else:
+                    out[key] = np.zeros(tshape, np.float32)
+                continue
+            # Pair the two error buffers sharing this key's prefix: both shapes
+            # are needed to pin each side's (dp, slice_size, n_pad) geometry.
+            # (A matching per-key shape alone is NOT enough to pass through —
+            # the same dp with a different slice factorization permutes the
+            # chunk -> global-offset map without changing the server shape.)
+            prefix = key[:-len(kind)]
+            quad = (loaded_flat.get(prefix + "worker_error"),
+                    loaded_flat.get(prefix + "server_error"),
+                    template_flat.get(prefix + "worker_error"),
+                    template_flat.get(prefix + "server_error"))
+            try:
+                dp_o, L_o, np_o = self._ef_geometry(quad[0].shape, quad[1].shape)
+                dp_n, L_n, np_n = self._ef_geometry(quad[2].shape, quad[3].shape)
+            except (AssertionError, AttributeError, IndexError, ZeroDivisionError):
+                out[key] = np.zeros(tshape, np.float32)  # unrecognizable layout
+                continue
+            if (dp_o, L_o, np_o) == (dp_n, L_n, np_n):
+                out[key] = v  # full geometry unchanged: bit-identical passthrough
+                continue
+            keep = min(np_o, np_n)
+            if kind == "server_error":
+                g = np.zeros(np_o, np.float32)
+                cs_o = np_o // dp_o
+                for d, off in enumerate(self._server_offsets(dp_o, L_o, np_o)):
+                    g[off:off + cs_o] = np.asarray(v)[d]
+                g_new = np.zeros(np_n, np.float32)
+                g_new[:keep] = g[:keep]
+                cs_n = np_n // dp_n
+                out[key] = np.stack(
+                    [g_new[off:off + cs_n]
+                     for off in self._server_offsets(dp_n, L_n, np_n)])
             else:
-                out[key] = np.zeros(tmpl_shape, np.float32)
+                C_o = np_o // L_o
+                g = np.zeros(np_o, np.float64)
+                v64 = np.asarray(v, np.float64)
+                for l in range(L_o):
+                    # rows holding chunk l are devices d with d % L_o == l
+                    g[l * C_o:(l + 1) * C_o] = v64[l::L_o].mean(axis=0)
+                g_new = np.zeros(np_n, np.float64)
+                g_new[:keep] = g[:keep]
+                C_n = np_n // L_n
+                out[key] = np.stack(
+                    [g_new[(d % L_n) * C_n:(d % L_n + 1) * C_n]
+                     for d in range(dp_n)]).astype(np.float32)
         return out

@@ -328,6 +328,23 @@ class DeepSpeedConfig:
                 f"serving.block_size, got {self.serving_max_model_len} % "
                 f"{self.serving_block_size} != 0")
 
+        cm_dict = param_dict.get(COMM, {})
+        self._warn_unknown_nested(COMM, cm_dict, COMM_CONFIG_KEYS)
+        self.comm_mode = get_scalar_param(cm_dict, COMM_MODE, COMM_MODE_DEFAULT)
+        self.comm_dcn_slices = get_scalar_param(cm_dict, COMM_DCN_SLICES, COMM_DCN_SLICES_DEFAULT)
+        self.comm_compress_start_step = get_scalar_param(cm_dict, COMM_COMPRESS_START_STEP,
+                                                         COMM_COMPRESS_START_STEP_DEFAULT)
+        if self.comm_mode not in COMM_MODES:
+            raise ValueError(
+                f"DeepSpeedConfig: comm.mode must be one of {COMM_MODES}, "
+                f"got {self.comm_mode!r}")
+        for attr in ("comm_dcn_slices", "comm_compress_start_step"):
+            val = getattr(self, attr)
+            if isinstance(val, bool) or not isinstance(val, int) or val < 0:
+                raise ValueError(
+                    f"DeepSpeedConfig: comm.{attr[len('comm_'):]} must be an "
+                    f"int >= 0, got {val!r}")
+
         self.sparse_attention = None
         if SPARSE_ATTENTION in param_dict:
             self.sparse_attention = SparseAttentionConfig(param_dict[SPARSE_ATTENTION])

@@ -245,6 +245,32 @@ SERVING_USE_PALLAS_DECODE = "use_pallas_decode"
 SERVING_USE_PALLAS_DECODE_DEFAULT = False
 
 #############################################
+# Comm (hierarchical ICI+DCN collectives)
+#
+# Routes data-parallel gradient exchange through the two-level schedule in
+# deepspeed_tpu/comm: reduce-scatter within a slice over ICI, (optionally
+# 1-bit sign-compressed) allreduce across slices over DCN, all-gather within
+# the slice. "mode" selects flat (single-axis, the historical behaviour),
+# hierarchical (two-level, full precision), or hierarchical_compressed
+# (two-level with error-feedback sign compression of the cross-slice hop
+# after "compress_start_step" warmup steps). "dcn_slices" fixes the slice
+# count; 0 derives it from the jax.distributed process topology (one slice
+# per process), falling back to a virtual 2x4 factorization of the 8-device
+# CPU test mesh.
+#############################################
+COMM = "comm"
+COMM_MODE = "mode"
+COMM_MODE_DEFAULT = "flat"
+COMM_MODE_FLAT = "flat"
+COMM_MODE_HIERARCHICAL = "hierarchical"
+COMM_MODE_COMPRESSED = "hierarchical_compressed"
+COMM_MODES = (COMM_MODE_FLAT, COMM_MODE_HIERARCHICAL, COMM_MODE_COMPRESSED)
+COMM_DCN_SLICES = "dcn_slices"
+COMM_DCN_SLICES_DEFAULT = 0
+COMM_COMPRESS_START_STEP = "compress_start_step"
+COMM_COMPRESS_START_STEP_DEFAULT = 0
+
+#############################################
 # Gradient accumulation fp32 buffer
 #############################################
 FP32_ALLREDUCE = "fp32_allreduce"
@@ -357,6 +383,7 @@ TOP_LEVEL_CONFIG_KEYS = frozenset({
     TELEMETRY,
     NUMERICS,
     SERVING,
+    COMM,
     SPARSE_ATTENTION,
     SEQUENCE_PARALLEL,
     PIPELINE,
@@ -408,4 +435,10 @@ SERVING_CONFIG_KEYS = frozenset({
     SERVING_MAX_MODEL_LEN,
     SERVING_PREFILL_CHUNK,
     SERVING_USE_PALLAS_DECODE,
+})
+
+COMM_CONFIG_KEYS = frozenset({
+    COMM_MODE,
+    COMM_DCN_SLICES,
+    COMM_COMPRESS_START_STEP,
 })

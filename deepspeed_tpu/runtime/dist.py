@@ -125,6 +125,21 @@ def init_distributed(coordinator_address: Optional[str] = None,
     if num_processes is not None and num_processes <= 1:
         return False
 
+    # Multi-process CPU worlds (the launcher tests / multichip dry run) need a
+    # cross-host collectives transport: jaxlib's CPU client defaults to 'none'
+    # and then refuses to compile any computation spanning processes. Gloo-TCP
+    # must be selected BEFORE the first backend touch creates the client —
+    # init_distributed is the one place guaranteed to run that early. On TPU
+    # the platform is not 'cpu' and collectives ride ICI/DCN natively.
+    platforms = (jax.config.jax_platforms
+                 or os.environ.get("JAX_PLATFORMS", ""))
+    if platforms.split(",")[0].strip().lower() == "cpu":
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):  # jaxlib built without gloo
+            logger.warning("CPU multi-process world without gloo collectives: "
+                           "cross-process computations will fail to compile")
+
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id,

@@ -36,7 +36,8 @@ from ..parallel.mesh import DATA_AXIS, build_mesh, mesh_from_mpu
 from ..utils import ThroughputTimer, SynchronizedWallClockTimer, log_dist, logger
 from .config import DeepSpeedConfig
 from .constants import (ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER,
-                        SGD_OPTIMIZER, ROUTE_TRAIN)
+                        SGD_OPTIMIZER, ROUTE_TRAIN,
+                        COMM_MODE_FLAT, COMM_MODE_COMPRESSED)
 from .dataloader import DeepSpeedDataLoader
 from .fp16 import loss_scaler as ls
 from .lr_schedules import get_scheduler
@@ -178,6 +179,38 @@ class DeepSpeedEngine:
         else:
             assert config_file is not None, "DeepSpeed requires --deepspeed_config or config_params"
             self.config = DeepSpeedConfig(config_file, world_size=self.dp_size)
+
+        # ---- comm topology (hierarchical ICI+DCN collectives; docs/multislice.md) ----
+        # Derived for every engine (the per-level desync audit and wire ledger
+        # read the factorization); the MODE decides whether the grad exchange
+        # actually routes through the two-level schedule.
+        from ..comm import derive_topology
+        self._comm_mode = self.config.comm_mode
+        self._comm_topo = derive_topology(self.dp_size, self.config.comm_dcn_slices)
+        if self._comm_mode != COMM_MODE_FLAT:
+            if self.zero_optimization() and self.zero_cpu_offload():
+                raise ValueError(
+                    f"comm.mode={self._comm_mode!r} does not compose with "
+                    "ZeRO-Offload (the host-tier step owns the grad layout)")
+            if self.zero_optimization_stage() >= 3:
+                raise ValueError(
+                    f"comm.mode={self._comm_mode!r} requires ZeRO stage <= 2: the "
+                    "two-level exchange runs in a shard_map with replicated "
+                    "parameter in_specs, which would re-gather stage-3 sharded "
+                    "parameters every step")
+            if self.config.sparse_gradients_enabled:
+                raise ValueError(
+                    f"comm.mode={self._comm_mode!r} does not compose with "
+                    "sparse_gradients (the row-sparse reduction owns the grad "
+                    "exchange); pick one")
+            if (self._comm_mode == COMM_MODE_COMPRESSED
+                    and self.gradient_accumulation_steps() > 1
+                    and self.config.optimizer_name != ONEBIT_ADAM_OPTIMIZER):
+                raise ValueError(
+                    "comm.mode='hierarchical_compressed' requires "
+                    "gradient_accumulation_steps == 1: error-feedback compression "
+                    "of per-micro-batch partial gradients would accumulate "
+                    "compression error across the window")
 
         # ---- persistent compilation cache (opt-in; see constants.py) ----
         if self.config.compilation_cache_dir:
@@ -435,6 +468,12 @@ class DeepSpeedEngine:
                 recompile_warn=self.config.telemetry_recompile_warn,
                 output_path=self.config.telemetry_output_path or None,
                 job_name=self.config.telemetry_job_name)
+            if self._comm_topo.is_hierarchical:
+                # per-axis wire ledger: split every program's collective bytes
+                # into ICI (intra-slice) vs DCN (cross-slice) — installed before
+                # _compile_steps so the step programs analyze against it
+                self.telemetry.set_comm_topology(
+                    self._comm_topo.slice_device_sets(self.mesh))
 
         # ---- numerics observatory (docs/numerics.md): in-graph sentinel,
         # loss-scale journal, cross-rank desync audit, flight recorder. Built
@@ -667,8 +706,13 @@ class DeepSpeedEngine:
                                   "error feedback is not per-group)"
                 from ..ops import onebit_adam as onebit
                 freeze_step = (self.config.optimizer_params or {}).get("freeze_step", 100000)
+                # under a non-flat comm mode the frozen-phase momentum exchange
+                # runs the two-level ICI+DCN schedule instead of the flat
+                # compressed allreduce (docs/multislice.md)
+                onebit_topo = (self._comm_topo
+                               if self._comm_mode != COMM_MODE_FLAT else None)
                 self._onebit = onebit.OneBitAdam(freeze_step=freeze_step, dp_size=self.dp_size,
-                                                 mesh=self.mesh)
+                                                 mesh=self.mesh, topology=onebit_topo)
                 self._opt_init, self._opt_apply = self._onebit.init, self._onebit.apply
             elif name in _OPTIMIZER_APPLY:
                 self._opt_init, self._opt_apply = _OPTIMIZER_APPLY[name]
@@ -874,6 +918,32 @@ class DeepSpeedEngine:
 
             loss_and_grad = shard_mapped_loss_and_grad(
                 reduce_sparse, jax.tree_util.tree_map(lambda _: P(), self.params))
+        elif self._comm_mode != COMM_MODE_FLAT and self.dp_size > 1:
+            # hierarchical comm (docs/multislice.md): the gradient exchange runs
+            # the explicit two-level schedule — reduce-scatter within each slice
+            # over ICI, allreduce across slices over DCN, all-gather within the
+            # slice — instead of GSPMD's flat single-axis psum. One division at
+            # the end, same placement as the flat pmean. Under
+            # hierarchical_compressed this full-precision path is also the
+            # warmup phase (forward() switches to the compressed program at
+            # comm.compress_start_step).
+            from ..comm.hierarchical import (flatten_tree, unflatten_tree,
+                                             tree_size, two_level_sum,
+                                             padded_size)
+            topo = self._comm_topo
+            dp = self.dp_size
+            n_total = tree_size(self.params)
+            n_pad = padded_size(n_total, dp)
+
+            def reduce_hier(grads, batch):
+                del batch
+                vec, recipe = flatten_tree(grads)
+                vec = jnp.pad(vec, (0, n_pad - n_total))
+                mean = two_level_sum(vec, topo) / dp
+                return unflatten_tree(mean[:n_total].astype(grad_dtype), recipe)
+
+            loss_and_grad = shard_mapped_loss_and_grad(
+                reduce_hier, jax.tree_util.tree_map(lambda _: P(), self.params))
         else:
             loss_and_grad = local_loss_and_grad
 
@@ -887,8 +957,8 @@ class DeepSpeedEngine:
                 "[deepspeed_tpu] fused_step requested but ineligible (it needs "
                 "gradient_accumulation_steps == 1 and the plain local grad path — "
                 "no 1-bit Adam stacked grads, sparse-gradient reduction, "
-                "ZeRO-Offload, or cpu activation checkpointing); using the "
-                "two-jit step")
+                "hierarchical comm, ZeRO-Offload, or cpu activation "
+                "checkpointing); using the two-jit step")
 
         # Inputs carry their shardings (params/batch were device_put with the right
         # layouts); out_shardings on the grads is what makes stage-2 store them
@@ -903,6 +973,62 @@ class DeepSpeedEngine:
         self._loss_and_grad_fn = loss_and_grad
         self._jit_loss_and_grad_cached = None
         self._jit_eval_cached = None
+
+        # ---- compressed comm scaffold (comm.mode=hierarchical_compressed) ----
+        # A second grad program carrying the persistent error-feedback buffers:
+        # forward() runs it once global_steps reaches comm.compress_start_step
+        # (the 1-bit two-phase rule: full-precision warmup, compressed after).
+        # EF state is engine-held (it belongs to the EXCHANGE, not the
+        # optimizer) and starts zeroed at the phase switch.
+        self._loss_and_grad_comm_fn = None
+        self._jit_loss_and_grad_comm_cached = None
+        self._comm_we = self._comm_se = None
+        if (self._comm_mode == COMM_MODE_COMPRESSED and not use_stacked
+                and self._sparse_grad_flags is None and self.dp_size > 1):
+            from ..comm.hierarchical import (flatten_tree, unflatten_tree,
+                                             tree_size, grad_segment_ids,
+                                             two_level_compressed,
+                                             error_state_shapes, padded_size)
+            from ..parallel.mesh import shard_map
+            topo = self._comm_topo
+            n_total = tree_size(self.params)
+            n_pad = padded_size(n_total, self.dp_size)
+            seg_np = grad_segment_ids(self.params, n_pad)
+            n_segs = int(seg_np.max()) + 1
+            seg_const = jnp.asarray(seg_np)
+            we_shape, se_shape = error_state_shapes(n_pad, topo)
+            ef_sharding = NamedSharding(self.mesh, P(DATA_AXIS, None))
+            self._comm_we = jax.device_put(jnp.zeros(we_shape, jnp.float32),
+                                           ef_sharding)
+            self._comm_se = jax.device_put(jnp.zeros(se_shape, jnp.float32),
+                                           ef_sharding)
+            param_specs = jax.tree_util.tree_map(lambda _: P(), self.params)
+            grad_specs = jax.tree_util.tree_map(lambda _: P(), self.params)
+
+            def loss_and_grad_comm(params, scale, we, se, *batch):
+                def local(params, scale, we_row, se_row, *local_batch):
+                    loss, grads = local_loss_and_grad(params, scale, *local_batch)
+                    vec, recipe = flatten_tree(grads)
+                    # compression runs in fp32: the sign + per-segment scale IS
+                    # the wire format, whatever grad_dtype is
+                    vec = jnp.pad(vec.astype(jnp.float32), (0, n_pad - n_total))
+                    out, new_we, new_se = two_level_compressed(
+                        vec, we_row[0], se_row[0], topo, seg_const, n_segs)
+                    grads_out = unflatten_tree(
+                        out[:n_total].astype(grad_dtype), recipe)
+                    return (jax.lax.pmean(loss, DATA_AXIS), grads_out,
+                            new_we[None], new_se[None])
+
+                batch_specs = tuple(P(DATA_AXIS) for _ in batch)
+                fn = shard_map(local, mesh=self.mesh,
+                               in_specs=(param_specs, P(), P(DATA_AXIS, None),
+                                         P(DATA_AXIS, None)) + batch_specs,
+                               out_specs=(P(), grad_specs, P(DATA_AXIS, None),
+                                          P(DATA_AXIS, None)),
+                               check_vma=False)
+                return fn(params, scale, we, se, *batch)
+
+            self._loss_and_grad_comm_fn = loss_and_grad_comm
 
         # Per-microbatch grads stay in the compute dtype (halves the backward HBM
         # footprint) but the ACCUMULATOR is fp32 when the window spans multiple
@@ -1225,12 +1351,20 @@ class DeepSpeedEngine:
         # the backward's cross-data reduction rides in exactly grad_dtype
         red = ({"min": 1, "dtypes": [grad_dt]} if dp > 1 else {"max": 0})
         gather_gate = {"all-gather": {"min": 1, "dtypes": [compute, "f32"]}}
+        comm_hier = (self._comm_mode != COMM_MODE_FLAT
+                     and not self._use_stacked_grads
+                     and self._sparse_grad_flags is None and dp > 1)
         lg_man = {
             "compute_dtype": compute,
             "any_reduction": red,
             # ZeRO-3 re-gathers params in forward; below stage 3 any large
-            # all-gather in the backward is an undeclared-collective violation
-            "collectives": dict(gather_gate) if zstage >= 3 else {},
+            # all-gather in the backward is an undeclared-collective violation.
+            # Hierarchical comm's intra-slice all-gather (level 3 of the
+            # two-level schedule) is a declared exception.
+            "collectives": (dict(gather_gate) if zstage >= 3 else
+                            ({"all-gather": {"min": 1,
+                                             "dtypes": sorted({grad_dt, "f32"})}}
+                             if comm_hier else {})),
             "donation": {"check_unusable": True},
             "strict": True,
         }
@@ -1271,6 +1405,30 @@ class DeepSpeedEngine:
 
         progs.append(("loss_and_grad", self._jit_loss_and_grad,
                       (self.params, scale) + batch, lg_man))
+        if self._loss_and_grad_comm_fn is not None:
+            # frozen-phase compressed exchange: sign payloads ride as packed u8
+            # (or raw s8 when the sub-chunk defeats packing) over the DCN
+            # all-to-all / all-gather; the per-segment scales and the ICI
+            # reduce-scatter stay f32
+            comm_man = {
+                "compute_dtype": compute,
+                "any_reduction": {"min": 1, "dtypes": ["f32"]},
+                "collectives": {
+                    "all-gather": {"min": 1,
+                                   "dtypes": sorted({"f32", "u8", "s8", grad_dt})},
+                    "all-to-all": {"min": 1, "dtypes": ["s8", "u8"]},
+                },
+                # the 1-bit phases ship PACKED signs: n/8 u8 elements, far below
+                # the default large-collective floor at test scale — lower it so
+                # the sign exchange is linted, while per-segment scale gathers
+                # (~n_segs elements) still ride free
+                "small_element_threshold": 16,
+                "donation": {"check_unusable": True},
+                "strict": True,
+            }
+            progs.append(("loss_and_grad_comm", self._jit_loss_and_grad_comm,
+                          (self.params, scale, self._comm_we, self._comm_se)
+                          + batch, comm_man))
         acc_in = grads_like(self._acc_dtype, self._grad_shardings)
         if gas > 1:
             g_in = grads_like(self._grad_dtype, self._grad_shardings)
@@ -1343,6 +1501,22 @@ class DeepSpeedEngine:
         return self._jit_loss_and_grad_cached
 
     @property
+    def _jit_loss_and_grad_comm(self):
+        """Compressed-exchange grad program (comm.mode=hierarchical_compressed,
+        frozen phase): carries the error-feedback buffers through, donated —
+        they are persistent state rewritten every step."""
+        if self._jit_loss_and_grad_comm_cached is None:
+            ef = NamedSharding(self.mesh, P(DATA_AXIS, None))
+            jitted = jax.jit(
+                self._loss_and_grad_comm_fn,
+                out_shardings=(NamedSharding(self.mesh, P()),
+                               self._grad_shardings, ef, ef),
+                donate_argnums=(2, 3))
+            self._jit_loss_and_grad_comm_cached = self._watch(
+                "loss_and_grad_comm", jitted)
+        return self._jit_loss_and_grad_comm_cached
+
+    @property
     def _jit_eval(self):
         """Jitted loss-only forward for eval() mode — the train path jits, and an
         op-by-op eval dispatch on a billion-parameter model is pathologically slow.
@@ -1394,6 +1568,17 @@ class DeepSpeedEngine:
                         "forward() (strict forward/backward/step rotation)")
                 loss, self._fused_pending = self._run_fused_step(batch)
                 self._pending_grads = _FUSED
+                self._pending_loss = loss
+            elif (self._loss_and_grad_comm_fn is not None
+                  and self.global_steps >= self.config.comm_compress_start_step):
+                # compressed phase of hierarchical_compressed: host-side step
+                # switch (the two-phase warmup rule) — cheaper than a traced
+                # cond around two full backward programs
+                loss, grads, self._comm_we, self._comm_se = \
+                    self._jit_loss_and_grad_comm(
+                        self.params, self.scaler_state.cur_scale,
+                        self._comm_we, self._comm_se, *batch)
+                self._pending_grads = grads
                 self._pending_loss = loss
             else:
                 loss, grads = self._jit_loss_and_grad(self.params,
@@ -1667,7 +1852,11 @@ class DeepSpeedEngine:
             logger.warning(f"[numerics] desync audit failed, disabling: {e!r}")
             self._audit_fn_cached = False
             return
-        self._numerics.commit_audit(self.global_steps, matrix, names, seconds=seconds)
+        slice_rows = (self._comm_topo.slice_rows
+                      if (self._comm_mode != COMM_MODE_FLAT
+                          and self._comm_topo.is_hierarchical) else None)
+        self._numerics.commit_audit(self.global_steps, matrix, names,
+                                    seconds=seconds, slice_rows=slice_rows)
 
     def _build_audit_fn(self):
         """Compile the audit program once: per-subtree uint32 checksums of every

@@ -9,9 +9,12 @@ fixed per-iteration order:
 
 Every device program has one fixed abstract signature (serve/paged.py), so the
 whole serving loop compiles each program exactly once — ``ds-tpu serve-sim``
-asserts this through the compile watchdog. Sampling is host-side for greedy
-(np.argmax over the fetched f32 logits row — same first-max tie-break as the
-in-graph jnp.argmax) and a tiny fixed-shape device program per beam step.
+asserts this through the compile watchdog. Sampling is host-side for the
+single-lane path — exact greedy (np.argmax over the fetched f32 logits row,
+same first-max tie-break as the in-graph jnp.argmax) when ``temperature <= 0``,
+else temperature/top-k/top-p sampling with a counter-based RNG keyed on
+``(request seed, token position)`` so replays and preempt-restarts regenerate
+identical tokens — and a tiny fixed-shape device program per beam step.
 
 ``mirror=True`` runs the dense-cache oracle (serve/oracle.py) in lockstep and
 asserts the paged logits are **bitwise identical** to the dense ones every
@@ -213,7 +216,7 @@ class InferenceEngine:
 
     def _first_tokens(self, g, logits, it):
         if g.lanes == 1:
-            tok = int(np.argmax(np.asarray(logits[0])))
+            tok = self._sample_token(g, np.asarray(logits[0]), 0)
             self.scheduler.begin_decode(g, [tok], it)
         else:
             scores, tok0, live = self._beam_head("init", g)(logits)
@@ -270,8 +273,43 @@ class InferenceEngine:
                 self._sample_beam(g, logits, finished, it)
         return decode_log, finished
 
+    def _sample_token(self, g, logits_row, position):
+        """Next token for a single-lane group from its f32 logits row.
+
+        ``temperature <= 0`` is the exact historical greedy path. Otherwise:
+        scale by temperature, apply top-k then nucleus truncation, softmax in
+        f64 (host math — bit-stable across platforms), and invert the CDF at a
+        uniform drawn from ``default_rng([seed, position])``. The counter-based
+        keying makes every draw a pure function of (request, position): replays
+        and preempt-restarts (bit-identical logits) resample identical tokens,
+        and no RNG state needs checkpointing or preemption care."""
+        req = g.req
+        if req.temperature <= 0.0:
+            return int(np.argmax(logits_row))
+        logits = np.asarray(logits_row, np.float64) / req.temperature
+        if 0 < req.top_k < logits.size:
+            kth = np.partition(logits, -req.top_k)[-req.top_k]
+            logits = np.where(logits < kth, -np.inf, logits)
+        probs = np.exp(logits - np.max(logits))
+        probs /= probs.sum()
+        if req.top_p < 1.0:
+            order = np.argsort(-logits, kind="stable")
+            csum = np.cumsum(probs[order])
+            # smallest prefix reaching top_p, always keeping the crossing token
+            cut = int(np.searchsorted(csum, req.top_p, side="left")) + 1
+            mask = np.zeros(probs.size, bool)
+            mask[order[:cut]] = True
+            probs = np.where(mask, probs, 0.0)
+            probs /= probs.sum()
+        u = np.random.default_rng([req.seed, position]).random()
+        tok = int(np.searchsorted(np.cumsum(probs), u, side="right"))
+        tok = min(tok, probs.size - 1)
+        while tok > 0 and probs[tok] == 0.0:   # float-edge guard: never emit a
+            tok -= 1                           # truncated (zero-mass) token
+        return tok
+
     def _sample_greedy(self, g, logits_np, finished, it):
-        tok = int(np.argmax(logits_np[g.slots[0]]))
+        tok = self._sample_token(g, logits_np[g.slots[0]], len(g.generated[0]))
         g.generated[0].append(tok)
         self._tokens_sampled += 1
         eos = g.req.eos_token_id

@@ -25,10 +25,18 @@ from .block_allocator import AllocationError, BlockAllocator
 class Request:
     """One serving request. ``arrival`` is the iteration index at which the
     scheduler may first admit it (request traces are replayed in iteration
-    time, keeping schedules machine-independent)."""
+    time, keeping schedules machine-independent).
+
+    Sampling (single-lane requests only): ``temperature <= 0`` is exact greedy
+    (np.argmax, first-max tie-break); ``temperature > 0`` draws from the
+    temperature-scaled softmax after optional top-k / nucleus (top-p)
+    truncation. Draws are counter-based on ``(seed, token position)`` — no
+    mutable RNG state — so a trace replay, and a preempt-restarted prefill
+    (which recomputes bit-identical logits), regenerate the exact same tokens."""
 
     def __init__(self, req_id, prompt, max_new_tokens, arrival=0, num_beams=1,
-                 eos_token_id=None, length_penalty=1.0):
+                 eos_token_id=None, length_penalty=1.0, temperature=0.0,
+                 top_k=0, top_p=1.0, seed=0):
         self.req_id = req_id
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -36,6 +44,19 @@ class Request:
         self.num_beams = int(num_beams)
         self.eos_token_id = -1 if eos_token_id is None else int(eos_token_id)
         self.length_penalty = float(length_penalty)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
+        if self.temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0 (0 = disabled), got {top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if self.temperature > 0.0 and self.num_beams > 1:
+            raise ValueError("sampling (temperature > 0) is incompatible with "
+                             "beam search — beams rank exact log-probs")
 
 
 class RequestOutput:

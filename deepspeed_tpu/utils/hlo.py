@@ -15,6 +15,8 @@ parsed here for the same single-parser reason.
 import re
 from collections import Counter
 
+import numpy as np
+
 COLLECTIVE_OPS = ("all-to-all", "all-gather", "all-reduce", "reduce-scatter",
                   "collective-permute")
 
@@ -132,6 +134,101 @@ def collective_bytes(hlo_text):
             continue
         total += _elements(dims) * _DTYPE_BYTES[dt]
     return total
+
+
+# ----------------------------------------------------------------- per-axis ledger
+# A collective instruction names its participant grouping inline:
+#   replica_groups={{0,1,2,3},{4,5,6,7}}        explicit groups
+#   replica_groups=[4,2]<=[2,4]T(1,0)           iota form: reshape/transpose of
+#                                               iota(N) into [groups, group_size]
+#   replica_groups={}                           every participant, one group
+#   source_target_pairs={{0,1},{1,2}}           collective-permute's equivalent
+# Ids are the program's logical device numbers (device-assignment order == the
+# flattened mesh.devices order, which on every mesh this repo builds equals the
+# global device id — the same convention CommTopology.slice_device_sets uses).
+_RG_EXPLICIT_RE = re.compile(r"replica_groups=\{((?:\{[^}]*\},?)*)\}")
+_RG_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
+_STP_RE = re.compile(r"source_target_pairs=\{((?:\{[^}]*\},?)*)\}")
+
+
+def parse_replica_groups(line):
+    """Participant groups of one collective instruction line: a list of int
+    tuples, or None when the instruction names no grouping (or the empty
+    ``{}`` grouping) — i.e. every participating device is one group."""
+    m = _RG_IOTA_RE.search(line)
+    if m:
+        g, s = int(m.group(1)), int(m.group(2))
+        dims = [int(d) for d in m.group(3).split(",")]
+        arr = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group(4):
+            arr = arr.transpose([int(p) for p in m.group(4).split(",")])
+        return [tuple(int(v) for v in row) for row in arr.reshape(g, s)]
+    m = _RG_EXPLICIT_RE.search(line) or _STP_RE.search(line)
+    if m is None or not m.group(1):
+        return None
+    return [tuple(int(v) for v in grp.split(",") if v)
+            for grp in re.findall(r"\{([^}]*)\}", m.group(1))]
+
+
+def collective_instructions(hlo_text):
+    """[(base op, [(dtype, dims)...] produced results, groups-or-None)] for
+    every collective instruction, line by line (async ``-start`` folded into
+    the base op exactly as in ``collective_counts``)."""
+    out = []
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        ty, op, start = m.groups()
+        out.append((op, _result_shapes(ty, op, bool(start)),
+                    parse_replica_groups(line)))
+    return out
+
+
+def collective_axis_bytes(hlo_text, slice_sets):
+    """Split ``collective_bytes`` per network level against a slice
+    factorization: ``{"ici": bytes, "dcn": bytes}``.
+
+    ``slice_sets`` is a list of device-id sets (one per slice — see
+    ``CommTopology.slice_device_sets``). An instruction accounts as ICI iff
+    every one of its replica groups stays inside a single slice; any group
+    spanning two slices rides the DCN. Ungrouped instructions (all devices)
+    are ICI only on a single-slice factorization. The two buckets sum exactly
+    to ``collective_bytes`` on the same program.
+    """
+    sets = [frozenset(s) for s in slice_sets]
+    totals = {"ici": 0, "dcn": 0}
+    for _op, shaped, groups in collective_instructions(hlo_text):
+        b = sum(_elements(dims) * _DTYPE_BYTES[dt]
+                for dt, dims in shaped if dt in _DTYPE_BYTES)
+        if groups is None:
+            intra = len(sets) <= 1
+        else:
+            intra = all(any(set(g) <= ss for ss in sets) for g in groups)
+        totals["ici" if intra else "dcn"] += b
+    return totals
+
+
+def collective_axis_breakdown(hlo_text, slice_sets):
+    """Per-op refinement of ``collective_axis_bytes``:
+    ``{op: {"ici": {"count": n, "bytes": b}, "dcn": {...}}}`` with the same
+    group-membership rule, so summing the leaves reproduces the two-bucket
+    split exactly (the comm-sim CLI report is built from this)."""
+    sets = [frozenset(s) for s in slice_sets]
+    out = {}
+    for op, shaped, groups in collective_instructions(hlo_text):
+        b = sum(_elements(dims) * _DTYPE_BYTES[dt]
+                for dt, dims in shaped if dt in _DTYPE_BYTES)
+        if groups is None:
+            intra = len(sets) <= 1
+        else:
+            intra = all(any(set(g) <= ss for ss in sets) for g in groups)
+        lvl = out.setdefault(op, {"ici": {"count": 0, "bytes": 0},
+                                  "dcn": {"count": 0, "bytes": 0}})
+        lvl["ici" if intra else "dcn"]["count"] += 1
+        lvl["ici" if intra else "dcn"]["bytes"] += b
+    return out
 
 
 # --------------------------------------------------------------------- lint surface

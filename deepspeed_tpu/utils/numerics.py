@@ -138,13 +138,21 @@ def leaf_checksum(leaf):
 # --------------------------------------------------------- host-side compare
 
 
-def compare_audit_rows(matrix, names):
+def compare_audit_rows(matrix, names, slice_rows=None):
     """Host comparator for the audit all-gather result.
 
     `matrix` is a [replicas, n_subtrees] array of uint32 checksums (already
     fetched by the engine). Returns None when every replica agrees, else a
     dict naming the FIRST diverging subtree and which replicas disagree with
     replica 0.
+
+    `slice_rows` (optional) is the comm topology's per-slice replica grouping
+    (CommTopology.slice_rows): when given, the divergence is classified per
+    network LEVEL — "intra_slice" when some slice's members disagree among
+    themselves (the ICI exchange or the local compute went wrong), else
+    "cross_slice" (each slice internally consistent but the slices disagree:
+    the DCN hop is the culprit). The payload then also carries
+    `diverging_slices` (slices whose consensus differs from slice 0's).
     """
     rows = [[int(v) for v in row] for row in matrix]
     if len(rows) <= 1:
@@ -153,12 +161,22 @@ def compare_audit_rows(matrix, names):
     for j in range(n):
         col = [row[j] for row in rows]
         if any(c != col[0] for c in col):
-            return {
+            div = {
                 "subtree": names[j] if j < len(names) else f"<{j}>",
                 "index": j,
                 "checksums": col,
                 "diverging_replicas": [i for i, c in enumerate(col) if c != col[0]],
             }
+            if slice_rows and len(slice_rows) > 1:
+                intra = any(
+                    any(col[r] != col[grp[0]] for r in grp if r < len(col))
+                    for grp in slice_rows if grp and grp[0] < len(col))
+                div["level"] = "intra_slice" if intra else "cross_slice"
+                ref = col[slice_rows[0][0]] if slice_rows[0][0] < len(col) else col[0]
+                div["diverging_slices"] = [
+                    s for s, grp in enumerate(slice_rows)
+                    if grp and grp[0] < len(col) and col[grp[0]] != ref]
+            return div
     return None
 
 
@@ -417,11 +435,13 @@ class NumericsMonitor:
         return self.audit_interval > 0 and step > 0 \
             and step % self.audit_interval == 0
 
-    def commit_audit(self, step, matrix, names, seconds=0.0):
-        """`matrix` is the host-fetched [replicas, n] checksum matrix."""
+    def commit_audit(self, step, matrix, names, seconds=0.0, slice_rows=None):
+        """`matrix` is the host-fetched [replicas, n] checksum matrix;
+        `slice_rows` (optional, CommTopology.slice_rows) classifies any
+        divergence per network level (intra_slice vs cross_slice)."""
         self.audit_runs += 1
         self.audit_seconds += float(seconds)
-        divergence = compare_audit_rows(matrix, names)
+        divergence = compare_audit_rows(matrix, names, slice_rows=slice_rows)
         payload = {"replicas": len(matrix), "subtrees": len(names),
                    "seconds": seconds,
                    "divergence": divergence}
@@ -431,10 +451,12 @@ class NumericsMonitor:
             self.recorder.record_event("desync_audit", payload, step)
         if divergence is not None:
             self.desync = dict(divergence, step=step)
+            level = divergence.get("level")
             logger.error(
                 f"numerics: CROSS-RANK DESYNC at step {step}: subtree "
                 f"'{divergence['subtree']}' disagrees on replicas "
-                f"{divergence['diverging_replicas']}")
+                f"{divergence['diverging_replicas']}"
+                + (f" (level: {level})" if level else ""))
             if self.recorder is not None:
                 self.recorder.note_anomaly()
                 self.recorder.trigger("desync", dict(divergence, step=step))

@@ -55,11 +55,12 @@ def _abstract_signature(args) -> tuple:
     return tuple(sig)
 
 
-def _analyze_compiled(compiled):
-    """(flops, argument/output/temp bytes, collective wire bytes) of a compiled
-    executable, each 0 when the backend doesn't report it."""
+def _analyze_compiled(compiled, slice_sets=None):
+    """(flops, argument/output/temp bytes, collective wire bytes, wire bytes
+    split (ici, dcn)) of a compiled executable, each 0 when the backend doesn't
+    report it. With no slice factorization every wire byte accounts as ICI."""
     flops = 0.0
-    arg_b = out_b = tmp_b = wire = 0
+    arg_b = out_b = tmp_b = wire = wire_ici = wire_dcn = 0
     try:
         ca = compiled.cost_analysis()
         if not isinstance(ca, dict):  # older jax returned [dict]
@@ -75,21 +76,28 @@ def _analyze_compiled(compiled):
     except Exception:
         pass
     try:
-        from .hlo import collective_bytes
-        wire = collective_bytes(compiled.as_text())
+        from .hlo import collective_bytes, collective_axis_bytes
+        text = compiled.as_text()
+        wire = collective_bytes(text)
+        wire_ici = wire
+        if slice_sets and len(slice_sets) > 1:
+            split = collective_axis_bytes(text, slice_sets)
+            wire_ici, wire_dcn = split["ici"], split["dcn"]
     except Exception:
         pass
-    return flops, arg_b, out_b, tmp_b, wire
+    return flops, arg_b, out_b, tmp_b, wire, wire_ici, wire_dcn
 
 
 class CompileRecord:
     """One observed compile of one program signature."""
 
     __slots__ = ("signature", "compile_seconds", "flops", "argument_bytes",
-                 "output_bytes", "temp_bytes", "wire_bytes", "count")
+                 "output_bytes", "temp_bytes", "wire_bytes", "wire_bytes_ici",
+                 "wire_bytes_dcn", "count")
 
     def __init__(self, signature, compile_seconds, flops=0.0, argument_bytes=0,
-                 output_bytes=0, temp_bytes=0, wire_bytes=0):
+                 output_bytes=0, temp_bytes=0, wire_bytes=0, wire_bytes_ici=0,
+                 wire_bytes_dcn=0):
         self.signature = signature
         self.compile_seconds = compile_seconds
         self.flops = flops
@@ -97,6 +105,8 @@ class CompileRecord:
         self.output_bytes = output_bytes
         self.temp_bytes = temp_bytes
         self.wire_bytes = wire_bytes
+        self.wire_bytes_ici = wire_bytes_ici
+        self.wire_bytes_dcn = wire_bytes_dcn
         self.count = 1
 
 
@@ -110,6 +120,9 @@ class CompileWatchdog:
         self.recompile_warn = max(int(recompile_warn), 2)
         self.records: Dict[str, Dict[tuple, CompileRecord]] = {}
         self._storm_warned = set()
+        # slice factorization for the per-axis (ICI vs DCN) wire-byte split;
+        # None means single-slice — every collective byte accounts as ICI
+        self.slice_sets = None
 
     def record(self, name: str, sig, seconds: float, compiled=None) -> CompileRecord:
         per = self.records.setdefault(name, {})
@@ -119,11 +132,12 @@ class CompileWatchdog:
             rec.compile_seconds += seconds
         else:
             if compiled is not None:
-                flops, arg_b, out_b, tmp_b, wire = _analyze_compiled(compiled)
+                (flops, arg_b, out_b, tmp_b, wire, wire_ici,
+                 wire_dcn) = _analyze_compiled(compiled, self.slice_sets)
             else:
-                flops = arg_b = out_b = tmp_b = wire = 0
+                flops = arg_b = out_b = tmp_b = wire = wire_ici = wire_dcn = 0
             rec = per[sig] = CompileRecord(sig, seconds, flops, arg_b, out_b,
-                                           tmp_b, wire)
+                                           tmp_b, wire, wire_ici, wire_dcn)
         n = sum(r.count for r in per.values())
         if len(per) >= self.recompile_warn and name not in self._storm_warned:
             self._storm_warned.add(name)
@@ -201,8 +215,9 @@ class _WatchedJit:
                 return self._call_fallback(sig, *args)
             rec = self._session.watchdog.record(
                 self._name, sig, time.perf_counter() - t0, compiled)
-            entry = self._cache[sig] = (compiled, rec.flops, rec.wire_bytes)
-        compiled, flops, wire = entry
+            entry = self._cache[sig] = (compiled, rec.flops, rec.wire_bytes,
+                                        rec.wire_bytes_ici, rec.wire_bytes_dcn)
+        compiled, flops, wire, wire_ici, wire_dcn = entry
         try:
             out = compiled(*args)
         except Exception as e:
@@ -212,7 +227,7 @@ class _WatchedJit:
                            f"program {self._name!r} ({e!r}); falling back to the "
                            "raw jit (signature tracking only)")
             return self._jit(*args)
-        self._session.note_execution(flops, wire)
+        self._session.note_execution(flops, wire, wire_ici, wire_dcn)
         return out
 
 
@@ -253,14 +268,20 @@ class TelemetrySession:
         # end_step differences them — no device work, no barriers
         self.flops_executed = 0.0
         self.wire_bytes_executed = 0
+        self.wire_ici_executed = 0
+        self.wire_dcn_executed = 0
         self.steps_recorded = 0
         self.last_mfu = None
         self.last_step_ms = None
         self.last_wire_bytes = 0
+        self.last_wire_bytes_ici = 0
+        self.last_wire_bytes_dcn = 0
         self._window = deque(maxlen=max(int(mfu_window), 1))  # (dt, flops)
         self._last_end = time.perf_counter()
         self._last_flops = 0.0
         self._last_wire = 0
+        self._last_wire_ici = 0
+        self._last_wire_dcn = 0
         self._last_compiles = 0
 
         self._trace_active = False
@@ -278,9 +299,20 @@ class TelemetrySession:
             return None
         return _WatchedJit(name, jitted, self)
 
-    def note_execution(self, flops: float, wire_bytes: int):
+    def note_execution(self, flops: float, wire_bytes: int,
+                       wire_ici: int = 0, wire_dcn: int = 0):
         self.flops_executed += flops
         self.wire_bytes_executed += wire_bytes
+        self.wire_ici_executed += wire_ici
+        self.wire_dcn_executed += wire_dcn
+
+    def set_comm_topology(self, slice_sets):
+        """Install the slice factorization (list of per-slice device-id sets,
+        CommTopology.slice_device_sets) that splits every subsequently compiled
+        program's wire bytes into the ICI vs DCN ledger. Call before the step
+        programs compile — already-analyzed records keep their old split."""
+        self.watchdog.slice_sets = (
+            [frozenset(s) for s in slice_sets] if slice_sets else None)
 
     # ------------------------------------------------------------- trace window
     def on_step_begin(self, global_step: int):
@@ -349,22 +381,30 @@ class TelemetrySession:
         dt = now - self._last_end
         flops_d = self.flops_executed - self._last_flops
         wire_d = self.wire_bytes_executed - self._last_wire
+        wire_ici_d = self.wire_ici_executed - self._last_wire_ici
+        wire_dcn_d = self.wire_dcn_executed - self._last_wire_dcn
         had_compile = compiles != self._last_compiles
         self._last_end = now
         self._last_flops = self.flops_executed
         self._last_wire = self.wire_bytes_executed
+        self._last_wire_ici = self.wire_ici_executed
+        self._last_wire_dcn = self.wire_dcn_executed
         self._last_compiles = compiles
 
         samples = global_step * samples_per_step
         mon = self.monitor
         self.last_step_ms = dt * 1000.0
         self.last_wire_bytes = wire_d
+        self.last_wire_bytes_ici = wire_ici_d
+        self.last_wire_bytes_dcn = wire_dcn_d
         self.steps_recorded += 1
         mon.add_scalar("Telemetry/Samples/step_time_ms", dt * 1000.0, samples)
         if dt > 0:
             mon.add_scalar("Telemetry/Samples/samples_per_sec",
                            samples_per_step / dt, samples)
         mon.add_scalar("Telemetry/Samples/wire_bytes", wire_d, samples)
+        mon.add_scalar("Telemetry/Samples/wire_bytes_ici", wire_ici_d, samples)
+        mon.add_scalar("Telemetry/Samples/wire_bytes_dcn", wire_dcn_d, samples)
         # rolling MFU over compile-free steps: a step that paid a compile would
         # poison the window with compile wall-time that is not execution
         if not had_compile and flops_d > 0 and dt > 0:
@@ -427,6 +467,8 @@ class TelemetrySession:
             "step_time_ms": self.last_step_ms,
             "steps_recorded": self.steps_recorded,
             "wire_bytes_per_step": self.last_wire_bytes,
+            "wire_bytes_per_step_ici": self.last_wire_bytes_ici,
+            "wire_bytes_per_step_dcn": self.last_wire_bytes_dcn,
             "hbm_in_use_bytes": int(stats.get("bytes_in_use", 0)),
             "hbm_peak_bytes": int(stats.get("peak_bytes_in_use", 0)),
             "compile_count": self.watchdog.compiles(),

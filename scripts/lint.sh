@@ -18,4 +18,12 @@ REPO="$(cd "$(dirname "$0")/.." && pwd)"
 export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
 # deterministic JSON report on stdout (CI log) and in the --out artifact;
 # engine-build INFO lines go to stderr so stdout stays parseable
-exec timeout -k 10 300 "$REPO/bin/ds-tpu" lint --json --out /tmp/_lint.json
+timeout -k 10 300 "$REPO/bin/ds-tpu" lint --json --out /tmp/_lint.json
+lint_rc=$?
+# comm-sim: two-level ICI+DCN schedule replay — per-level wire-byte manifest
+# (incl. the >= 8x compressed cross-slice reduction floor); /tmp/_comm_sim.json
+# is byte-stable, diff two runs to prove a change is schedule-neutral
+timeout -k 10 300 "$REPO/bin/ds-tpu" comm-sim --out /tmp/_comm_sim.json
+comm_rc=$?
+[ "$lint_rc" -ne 0 ] && exit "$lint_rc"
+exit "$comm_rc"

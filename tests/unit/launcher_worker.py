@@ -99,6 +99,78 @@ def run_elastic_rehearsal(tmp, repo_root, timeout=420):
     return a, b, c
 
 
+def run_hierarchical_rehearsal(tmp, repo_root, timeout=420):
+    """Two-level-comm multi-process rehearsal, shared by test_launcher.py and
+    __graft_entry__'s multichip dry run. Two launcher-spawned jax.distributed
+    processes x 2 virtual devices each = dp 4, auto-factorized into 2 slices of
+    2 (the DCN boundary IS the process boundary):
+
+    (A) ZeRO-2 + Adam with ``comm.mode=hierarchical`` vs (C) a single-process
+        flat engine over the same 4-device global math — loss parity within the
+        two-level reassociation tolerance;
+    (B) stage-0 OneBitAdam(freeze_step=2) with ``hierarchical_compressed`` vs
+        (D) the same optimizer flat — warmup steps are the identical
+        uncompressed mean (tight), compressed steps stay within the documented
+        1-bit tolerance and keep training.
+    Returns the four result dicts."""
+    import base64
+    import subprocess
+
+    import numpy as np
+
+    def clean_env(**extra):
+        return clean_spawn_env(PYTHONPATH=repo_root, **extra)
+
+    worker = os.path.abspath(__file__)
+    world_info = base64.urlsafe_b64encode(
+        json.dumps({"localhost": [0, 1]}).encode()).decode()
+    outs = {x: os.path.join(tmp, f"hier_{x}.json") for x in "abcd"}
+    two_dev = "--xla_force_host_platform_device_count=2"
+    four_dev = "--xla_force_host_platform_device_count=4"
+
+    def launch_two(out, *extra):
+        port = free_port()
+        return subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+             "--node_rank=0", "--master_addr=127.0.0.1",
+             f"--master_port={port}", f"--world_info={world_info}", worker,
+             f"--out={out}", "--steps=4", *extra],
+            env=clean_env(XLA_FLAGS=two_dev), capture_output=True, text=True,
+            timeout=timeout)
+
+    def solo(out, *extra):
+        return subprocess.run(
+            [sys.executable, worker, f"--out={out}", "--steps=4", *extra],
+            env=clean_env(XLA_FLAGS=four_dev), capture_output=True, text=True,
+            timeout=timeout)
+
+    r = launch_two(outs["a"], "--zero_stage=2", "--comm_mode=hierarchical")
+    assert r.returncode == 0, f"phase A failed:\n{r.stdout[-800:]}\n{r.stderr[-1500:]}"
+    r = solo(outs["c"], "--zero_stage=2")
+    assert r.returncode == 0, f"phase C failed:\n{r.stderr[-1500:]}"
+    r = launch_two(outs["b"], "--optimizer=onebit",
+                   "--comm_mode=hierarchical_compressed")
+    assert r.returncode == 0, f"phase B failed:\n{r.stdout[-800:]}\n{r.stderr[-1500:]}"
+    r = solo(outs["d"], "--optimizer=onebit")
+    assert r.returncode == 0, f"phase D failed:\n{r.stderr[-1500:]}"
+
+    a, b, c, d = (json.load(open(outs[x])) for x in "abcd")
+    assert a["world"] == 2 and a["devices"] == 4, a
+    assert (a["num_slices"], a["slice_size"]) == (2, 2), a
+    assert b["world"] == 2 and (b["num_slices"], b["slice_size"]) == (2, 2), b
+    assert c["num_slices"] == 1 and d["num_slices"] == 1, (c, d)
+    # hierarchical vs flat: same mean, reassociated — tolerance, not bits
+    np.testing.assert_allclose(a["losses"], c["losses"], rtol=2e-3, atol=2e-4)
+    # 1-bit warmup (steps 1-2) is the identical uncompressed mean
+    np.testing.assert_allclose(b["losses"][:2], d["losses"][:2],
+                               rtol=1e-4, atol=1e-5)
+    # compressed steps: documented 1-bit tolerance, and still training
+    assert max(abs(x - y) for x, y in zip(b["losses"][2:], d["losses"][2:])) < 0.1, \
+        (b["losses"], d["losses"])
+    assert b["losses"][-1] < b["losses"][0], b["losses"]
+    return a, b, c, d
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--local_rank", type=int, default=0)
@@ -114,6 +186,15 @@ def main():
     parser.add_argument("--data_offset", type=int, default=0,
                         help="skip this many steps of the deterministic stream "
                              "(resume continuity)")
+    parser.add_argument("--zero_stage", type=int, default=0,
+                        help="plain ZeRO stage (no offload) for the comm runs")
+    parser.add_argument("--comm_mode", type=str, default="",
+                        help="comm.mode config ('' = flat default); dcn_slices "
+                             "auto-derives from the jax.distributed world")
+    parser.add_argument("--optimizer", type=str, default="adam",
+                        choices=["adam", "onebit"],
+                        help="onebit = OneBitAdam(freeze_step=2): warmup is the "
+                             "uncompressed mean, later steps 1-bit compressed")
     args = parser.parse_args()
 
     import deepspeed_tpu
@@ -129,6 +210,13 @@ def main():
     cfg = simple_config(batch=8)
     if args.offload:
         cfg["zero_optimization"] = {"stage": 2, "cpu_offload": True}
+    if args.zero_stage:
+        cfg["zero_optimization"] = {"stage": args.zero_stage}
+    if args.optimizer == "onebit":
+        cfg["optimizer"] = {"type": "OneBitAdam",
+                            "params": {"lr": 1e-2, "freeze_step": 2}}
+    if args.comm_mode:
+        cfg["comm"] = {"mode": args.comm_mode}
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
                                                config_params=cfg)
     if args.load:
@@ -146,7 +234,9 @@ def main():
         losses.append(float(jax.device_get(loss)))
 
     result = {"losses": losses, "world": jax.process_count(),
-              "devices": jax.device_count()}
+              "devices": jax.device_count(),
+              "num_slices": engine._comm_topo.num_slices,
+              "slice_size": engine._comm_topo.slice_size}
     if args.ckpt_dir and not args.load:
         # every process writes its offload regions; process 0 writes the rest
         engine.save_checkpoint(args.ckpt_dir, tag="t0")

@@ -116,6 +116,15 @@ SWEEP = {
         # paged gather bit-matches the oracle only when the tiling is exact
         ({"block_size": 16, "max_model_len": 100}, ("raise", ValueError)),
     ),
+    "comm": (
+        ({"mode": "hierarchical"}, ("attr", "comm_mode", "hierarchical")),
+        ({"mode": "hierarchical_compressed", "compress_start_step": 5},
+         ("attr", "comm_compress_start_step", 5)),
+        ({"dcn_slices": 2}, ("attr", "comm_dcn_slices", 2)),
+        ({"mode": "ring"}, ("raise", ValueError)),
+        ({"dcn_slices": -1}, ("raise", ValueError)),
+        ({"compress_start_step": -3}, ("raise", ValueError)),
+    ),
     "sparse_attention": ({"mode": "fixed", "block": 16},
                          ("attr_pred", lambda c: c.sparse_attention.mode == "fixed")),
     "sequence_parallel": ({"enabled": True, "schedule": "masked"},

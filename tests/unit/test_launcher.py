@@ -341,6 +341,20 @@ def test_two_process_offload_elastic_world_change(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_hierarchical_comm_loss_parity(tmp_path):
+    """Two-level ICI+DCN comm across REAL process boundaries: 2 launcher-spawned
+    jax.distributed processes x 2 virtual devices (dp 4, auto-factorized 2x2 —
+    the DCN boundary IS the process boundary) train ZeRO-2 hierarchical and
+    OneBitAdam hierarchical_compressed; losses must match single-process flat
+    oracles over the same 4-device global math (exact-mean tolerance for
+    hierarchical and the OneBit warmup, documented 1-bit tolerance after the
+    freeze step). Shares the implementation with __graft_entry__'s dry run."""
+    from launcher_worker import run_hierarchical_rehearsal
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+    run_hierarchical_rehearsal(str(tmp_path), repo_root)
+
+
+@pytest.mark.slow
 def test_two_process_offload_region_checkpoint(tmp_path):
     """Multi-host ZeRO-Offload end-to-end: 2 real jax.distributed processes train with
     partitioned host-tier Adam, each writes ITS OWN region file on save, and a fresh

@@ -129,7 +129,8 @@ def test_frozen_phase_freezes_variance_and_compresses(mesh):
 
 def test_onebit_elastic_checkpoint_dp_change(tmp_path):
     """Save under dp=8, resume under dp=4: moments carry over (truncated to the new
-    padding), error buffers reset (reference lazily reallocates them on shape change)."""
+    padding) and the error-feedback buffers are re-chunked for the new topology —
+    the accumulated residual survives instead of resetting to zero."""
     from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 
     def run_engine(mesh, load_dir=None):
@@ -160,11 +161,131 @@ def test_onebit_elastic_checkpoint_dp_change(tmp_path):
     eng4 = run_engine(mesh4)
     eng4.load_checkpoint(str(tmp_path), tag="elastic")
     assert eng4.global_steps == eng8.global_steps
-    # moments restored (nonzero), error buffers reset for the new topology
+    # moments restored (nonzero); error-feedback residuals carried over, re-chunked
     assert np.any(np.asarray(eng4.opt_state.exp_avg) != 0)
-    assert np.all(np.asarray(eng4.opt_state.worker_error) == 0)
+    assert np.any(np.asarray(eng4.opt_state.worker_error) != 0), \
+        "elastic restore must preserve the worker residual, not zero it"
+    # server residual data region is a pure re-chunking of the dp=8 one: the two
+    # reconstructed global vectors must agree bit-for-bit on the shared prefix
+    def global_server(se, dp, n_pad):
+        g = np.zeros(n_pad, np.float32)
+        cs = n_pad // dp
+        for d in range(dp):
+            g[d * cs:(d + 1) * cs] = np.asarray(se)[d]
+        return g
+    se8 = np.asarray(eng8.opt_state.server_error)
+    se4 = np.asarray(eng4.opt_state.server_error)
+    g8 = global_server(se8, 8, se8.size)
+    g4 = global_server(se4, 4, se4.size)
+    n_model = sum(int(np.prod(p.shape))
+                  for p in jax.tree_util.tree_leaves(eng8.params))
+    np.testing.assert_array_equal(g4[:n_model], g8[:n_model])
     final = steps(eng4, 4, start=6)
     assert np.isfinite(final)
+
+
+def test_elastic_adapt_round_trip_preserves_residuals(mesh):
+    """dp=8 -> dp=4 -> dp=8: the server residual's real-data region must survive
+    the round trip bit-for-bit (satellite: padded-tail handling across world-size
+    change), and the worker residual's per-position mean — the only quantity the
+    averaged output sees — must be preserved through each hop."""
+    n = 1500  # paddings differ across dp: 2048 (dp=8) vs 1536 (dp=4)
+    n8, n4 = padded_size(n, 8), padded_size(n, 4)
+    assert n8 != n4
+    rng = np.random.default_rng(7)
+    state8 = {"exp_avg": rng.normal(size=n8).astype(np.float32),
+              "exp_avg_sq": rng.normal(size=n8).astype(np.float32) ** 2,
+              "worker_error": rng.normal(size=(8, n8)).astype(np.float32),
+              "server_error": rng.normal(size=(8, n8 // 8)).astype(np.float32)}
+    tmpl4 = {"exp_avg": np.zeros(n4, np.float32),
+             "exp_avg_sq": np.zeros(n4, np.float32),
+             "worker_error": np.zeros((4, n4), np.float32),
+             "server_error": np.zeros((4, n4 // 4), np.float32)}
+    tmpl8 = {k: np.zeros_like(a) for k, a in state8.items()}
+
+    opt = OneBitAdam(freeze_step=1, dp_size=8, mesh=mesh)
+    mid = opt.elastic_adapt(state8, tmpl4)
+    back = opt.elastic_adapt(mid, tmpl8)
+
+    np.testing.assert_array_equal(back["server_error"].reshape(-1)[:n],
+                                  state8["server_error"].reshape(-1)[:n])
+    np.testing.assert_allclose(
+        back["worker_error"].mean(axis=0)[:n],
+        state8["worker_error"].astype(np.float64).mean(axis=0)[:n],
+        rtol=0, atol=1e-6)
+    # moments: truncated to the smaller padding, data region preserved exactly
+    np.testing.assert_array_equal(back["exp_avg"][:n], state8["exp_avg"][:n])
+    assert np.all(back["exp_avg"][n4:] == 0)
+
+
+def test_elastic_adapt_hierarchical_geometry(mesh):
+    """Flat dp=8 residuals re-chunk onto a hierarchical dp=4 (2 slices of 2)
+    template through the (d % L) * C + (d // L) * csize offset map, and the
+    reconstructed global server vector matches the flat one on the data region."""
+    from deepspeed_tpu.comm import derive_topology
+    from deepspeed_tpu.ops.onebit_adam import OneBitAdam as OBA
+
+    n = 1500
+    n8, n4 = padded_size(n, 8), padded_size(n, 4)
+    topo4 = derive_topology(4, 2)
+    rng = np.random.default_rng(11)
+    state8 = {"worker_error": rng.normal(size=(8, n8)).astype(np.float32),
+              "server_error": rng.normal(size=(8, n8 // 8)).astype(np.float32)}
+    tmpl4 = {"worker_error": np.zeros((4, n4 // 2), np.float32),   # L=2 chunking
+             "server_error": np.zeros((4, n4 // 4), np.float32)}
+    opt = OBA(freeze_step=1, dp_size=8, mesh=mesh)
+    mid = opt.elastic_adapt(state8, tmpl4)
+    assert mid["worker_error"].shape == (4, n4 // 2)
+
+    # reassemble both global server residuals and compare the data region
+    g8 = state8["server_error"].reshape(-1)
+    g4 = np.zeros(n4, np.float32)
+    cs4, C4 = n4 // 4, n4 // 2
+    for d in range(4):
+        off = (d % 2) * C4 + (d // 2) * cs4
+        g4[off:off + cs4] = mid["server_error"][d]
+    np.testing.assert_array_equal(g4[:n], g8[:n])
+
+
+def test_onebit_hierarchical_matches_flat_convergence(mesh):
+    """Frozen-phase averaging over a 2x4 factorized topology: the two-level
+    compressed exchange tracks the true momentum no worse (plateau-wise) than
+    the flat one, from the OneBitAdam apply() entry point. The instantaneous
+    momentum sits at the single-shot sign-compression floor in both layouts —
+    error feedback guarantees the time-average, so that is what must agree."""
+    from deepspeed_tpu.comm import derive_topology
+
+    rng = np.random.default_rng(5)
+    params0 = {"w": jnp.asarray(rng.normal(size=(16, 16)), jnp.float32)}
+    grads = _stacked_like(params0, DP, rng)
+    g_mean = np.mean(np.asarray(grads["w"]), axis=0).reshape(-1)
+    hyper = dict(lr=jnp.float32(0.01), beta1=jnp.float32(0.9), beta2=jnp.float32(0.999),
+                 eps=jnp.float32(1e-8), weight_decay=jnp.float32(0.0))
+
+    def run(topology):
+        opt = OneBitAdam(freeze_step=1, dp_size=DP, mesh=mesh, topology=topology)
+        state = opt.init(params0)
+        if topology is not None:
+            n_pad = state.exp_avg.shape[0]
+            assert state.worker_error.shape == (DP, n_pad // topology.slice_size)
+        apply = jax.jit(opt.apply)
+        params = params0
+        ms = []
+        for step in range(1, 20):  # step 1 = warmup, rest frozen on fixed grads
+            params, state = apply(grads, state, params, jnp.int32(step), hyper)
+            ms.append(np.asarray(state.exp_avg)[:g_mean.size])
+        assert np.any(np.asarray(state.worker_error) != 0)
+        # EF contract: the running average of frozen-phase momenta approaches
+        # the true (geometrically saturating) momentum far below the ~0.6
+        # gaussian single-shot floor
+        avg = np.mean(ms[4:], axis=0)
+        tgt = np.mean([(1 - 0.9 ** k) * g_mean for k in range(5, 20)], axis=0)
+        return np.linalg.norm(avg - tgt) / np.linalg.norm(tgt)
+
+    rel_hier = run(derive_topology(DP, 2))
+    rel_flat = run(None)
+    assert rel_hier < 0.4, f"hierarchical EF time-average off: {rel_hier}"
+    assert rel_hier < max(0.25, 1.5 * rel_flat), (rel_hier, rel_flat)
 
 
 @pytest.mark.parametrize("freeze_step,lr,steps", [(100, 1e-2, 20), (10, 3e-3, 40)])

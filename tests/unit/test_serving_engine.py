@@ -142,6 +142,65 @@ def test_preemption_restores_identical_tokens(model_and_params):
     assert small.mirror_checks > 0
 
 
+def test_sampling_temperature_zero_equals_greedy(model_and_params):
+    """The sampled lane must degenerate to the exact greedy path: temperature=0
+    short-circuits to np.argmax, temperature->0 concentrates the softmax onto
+    the argmax token, and top_k=1 truncates to it — all three byte-identical to
+    the default request, whatever the seed."""
+    prompt, L = _prompt(30, 9), 6
+    base = _engine(model_and_params).run([Request("g", prompt, L)])[0][0]
+    for kw in ({"temperature": 0.0, "top_k": 7, "top_p": 0.8, "seed": 99},
+               {"temperature": 1e-6, "seed": 4},
+               {"temperature": 2.0, "top_k": 1, "seed": 5}):
+        out = _engine(model_and_params).run(
+            [Request("s", prompt, L, **kw)])[0][0]
+        assert out.tokens == base.tokens, kw
+
+
+def test_sampling_seeded_replay_and_seed_sensitivity(model_and_params):
+    """Counter-based draws: the same (seed, trace) replays byte-identically in
+    a fresh engine; different seeds explore different continuations."""
+    prompt, L = _prompt(31, 8), 8
+    kw = dict(temperature=1.5, top_p=0.95, seed=7)
+    a = _engine(model_and_params).run([Request("s", prompt, L, **kw)])[0][0]
+    b = _engine(model_and_params).run([Request("s", prompt, L, **kw)])[0][0]
+    assert a.tokens == b.tokens
+    others = [_engine(model_and_params).run(
+        [Request("s", prompt, L, temperature=1.5, top_p=0.95, seed=s)]
+    )[0][0].tokens for s in (8, 9, 10)]
+    assert any(t != a.tokens for t in others), \
+        "three different seeds all reproduced the same 8-token continuation"
+
+
+def test_sampling_survives_preemption(model_and_params):
+    """Preemption restarts recompute bit-identical logits and the counter-based
+    RNG is keyed on (seed, position) with no mutable state, so a starved engine
+    resamples exactly the tokens an un-starved one drew."""
+    reqs = [dict(req_id=f"r{i}", prompt=_prompt(40 + i, 9), max_new_tokens=6,
+                 temperature=1.2, top_k=16, seed=100 + i) for i in range(4)]
+    def mk(r):
+        return Request(r["req_id"], list(r["prompt"]), r["max_new_tokens"],
+                       temperature=r["temperature"], top_k=r["top_k"],
+                       seed=r["seed"])
+    outs_small, _ = _engine(model_and_params, num_blocks=13).run(
+        [mk(r) for r in reqs])
+    outs_big, _ = _engine(model_and_params, num_blocks=33).run(
+        [mk(r) for r in reqs])
+    assert sum(o.preemptions for o in outs_small) > 0
+    assert [o.tokens for o in outs_small] == [o.tokens for o in outs_big]
+
+
+def test_sampling_request_validation():
+    with pytest.raises(ValueError):
+        Request("x", [1, 2], 4, temperature=-0.5)
+    with pytest.raises(ValueError):
+        Request("x", [1, 2], 4, top_p=0.0)
+    with pytest.raises(ValueError):
+        Request("x", [1, 2], 4, top_k=-1)
+    with pytest.raises(ValueError):
+        Request("x", [1, 2], 4, temperature=0.7, num_beams=4)
+
+
 def test_config_facade_init_inference(model_and_params):
     """deepspeed_tpu.init_inference wires the "serving" config block through
     DeepSpeedConfig into a working engine."""
