@@ -505,9 +505,12 @@ def bench_serving_summary(cfg_kwargs, *, n_requests, num_slots, block_size,
                           param_dtype=None, seed=11):
     """Continuous-batching serving summary (docs/serving.md): replay a seeded
     mixed greedy/beam trace through the InferenceEngine and report tok/s,
-    TTFT, mean slot occupancy, and goodput — plus the compile-watchdog
+    TTFT/TPOT latency percentiles (request-trace ledger), preemption-waste
+    fraction, mean slot occupancy, and goodput — plus the compile-watchdog
     recompile count, which must be 0 after warmup (the fixed-shape contract
-    ds-tpu serve-sim gates on)."""
+    ds-tpu serve-sim gates on). Runs OUTSIDE the headline measurement windows
+    (PERF.md): the ledger is host-side bookkeeping, but the headline numbers
+    stay untraced on principle."""
     import jax
     import jax.numpy as jnp
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
@@ -529,7 +532,9 @@ def bench_serving_summary(cfg_kwargs, *, n_requests, num_slots, block_size,
         config_params={"serving": {
             "enabled": True, "max_seqs": num_slots, "block_size": block_size,
             "num_blocks": num_blocks, "max_model_len": max_model_len,
-            "prefill_chunk": prefill_chunk}})
+            "prefill_chunk": prefill_chunk,
+            "request_trace": {"enabled": True,
+                              "capacity": max(n_requests + 1, 256)}}})
     reqs = synth_trace(n_requests, vocab_size=cfg.vocab_size,
                        max_model_len=max_model_len, seed=seed)
     t0 = time.time()
@@ -550,6 +555,12 @@ def bench_serving_summary(cfg_kwargs, *, n_requests, num_slots, block_size,
             "ttft_ms_mean": round(float(np.mean([o.ttft_ms for o in fin])), 2),
             "ttft_iters_mean": round(float(np.mean([o.ttft_iters
                                                     for o in fin])), 2),
+            **{f"{m}_{p}": round(v, 2)
+               for m in ("ttft_ms", "tpot_ms")
+               for p, v in eng.tracer.percentiles(m, ps=(50, 95, 99)).items()
+               if v is not None},
+            "waste_fraction": round(
+                eng.tracer.waste_summary()["waste_fraction"], 4),
             "occupancy_mean": round(float(np.mean(occ)) if occ else 0.0, 3),
             "preemptions": sum(o.preemptions for o in fin),
             "decode_recompiles_after_warmup": recompiles}

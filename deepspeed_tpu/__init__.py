@@ -83,7 +83,16 @@ def init_inference(model=None,
         max_model_len=ds_config.serving_max_model_len,
         prefill_chunk=ds_config.serving_prefill_chunk,
         use_pallas=ds_config.serving_use_pallas_decode,
-        telemetry=telemetry, mirror=mirror)
+        telemetry=telemetry, mirror=mirror,
+        request_trace={
+            "enabled": ds_config.serving_request_trace_enabled,
+            "capacity": ds_config.serving_request_trace_capacity,
+            "iteration_capacity":
+                ds_config.serving_request_trace_iteration_capacity,
+            "dump_dir": ds_config.serving_request_trace_dump_dir,
+            "slo": {"ttft_ms": ds_config.serving_slo_ttft_ms,
+                    "tpot_ms": ds_config.serving_slo_tpot_ms},
+        })
 
 
 def _add_core_arguments(parser):

@@ -51,12 +51,14 @@ def run_ast_surface(report, allowlist, package_dir=None):
     from .config_pass import ConfigKeysPass
     pkg = package_dir or _package_dir()
     root = os.path.dirname(pkg)
-    # the host-sync (no-perturbation) contract covers the observability tier
-    # in utils/ — the data path syncs on purpose (loss fetch, batch placement).
-    # Tracer-hostility and recompile hazards are properties of any jitted code,
-    # so those passes sweep the whole package.
+    # the host-sync (no-perturbation) contract covers the observability tier:
+    # utils/ plus the serving request-trace ledger — the data path syncs on
+    # purpose (loss fetch, batch placement). Tracer-hostility and recompile
+    # hazards are properties of any jitted code, so those passes sweep the
+    # whole package.
     utils_files = [f for f in _package_files(pkg)
-                   if f.startswith(os.path.join(pkg, "utils") + os.sep)]
+                   if f.startswith(os.path.join(pkg, "utils") + os.sep)
+                   or f == os.path.join(pkg, "serve", "request_trace.py")]
     host_sync = HostSyncPass()
     report.passes.append(host_sync.pass_id)
     report.extend(run_ast_passes(utils_files, (host_sync,), root=root),

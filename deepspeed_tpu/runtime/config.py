@@ -328,6 +328,45 @@ class DeepSpeedConfig:
                 f"serving.block_size, got {self.serving_max_model_len} % "
                 f"{self.serving_block_size} != 0")
 
+        rt_dict = sv_dict.get(SERVING_REQUEST_TRACE, {}) or {}
+        self._warn_unknown_nested(f"{SERVING}.{SERVING_REQUEST_TRACE}",
+                                  rt_dict, SERVING_REQUEST_TRACE_CONFIG_KEYS)
+        self.serving_request_trace_enabled = get_scalar_param(
+            rt_dict, SERVING_REQUEST_TRACE_ENABLED,
+            SERVING_REQUEST_TRACE_ENABLED_DEFAULT)
+        self.serving_request_trace_capacity = get_scalar_param(
+            rt_dict, SERVING_REQUEST_TRACE_CAPACITY,
+            SERVING_REQUEST_TRACE_CAPACITY_DEFAULT)
+        self.serving_request_trace_iteration_capacity = get_scalar_param(
+            rt_dict, SERVING_REQUEST_TRACE_ITERATION_CAPACITY,
+            SERVING_REQUEST_TRACE_ITERATION_CAPACITY_DEFAULT)
+        self.serving_request_trace_dump_dir = get_scalar_param(
+            rt_dict, SERVING_REQUEST_TRACE_DUMP_DIR,
+            SERVING_REQUEST_TRACE_DUMP_DIR_DEFAULT)
+        for attr, minimum in (("serving_request_trace_capacity", 1),
+                              ("serving_request_trace_iteration_capacity", 1)):
+            val = getattr(self, attr)
+            if isinstance(val, bool) or not isinstance(val, int) or val < minimum:
+                raise ValueError(
+                    f"DeepSpeedConfig: serving.request_trace."
+                    f"{attr[len('serving_request_trace_'):]} must be an "
+                    f"int >= {minimum}, got {val!r}")
+        slo_dict = rt_dict.get(SERVING_REQUEST_TRACE_SLO, {}) or {}
+        self._warn_unknown_nested(
+            f"{SERVING}.{SERVING_REQUEST_TRACE}.{SERVING_REQUEST_TRACE_SLO}",
+            slo_dict, SERVING_SLO_CONFIG_KEYS)
+        self.serving_slo_ttft_ms = get_scalar_param(
+            slo_dict, SERVING_SLO_TTFT_MS, SERVING_SLO_TTFT_MS_DEFAULT)
+        self.serving_slo_tpot_ms = get_scalar_param(
+            slo_dict, SERVING_SLO_TPOT_MS, SERVING_SLO_TPOT_MS_DEFAULT)
+        for attr in ("serving_slo_ttft_ms", "serving_slo_tpot_ms"):
+            val = getattr(self, attr)
+            if isinstance(val, bool) or not isinstance(val, (int, float)) or val < 0:
+                raise ValueError(
+                    f"DeepSpeedConfig: serving.request_trace.slo."
+                    f"{attr[len('serving_slo_'):]} must be a number >= 0 "
+                    f"(0 = not gated), got {val!r}")
+
         cm_dict = param_dict.get(COMM, {})
         self._warn_unknown_nested(COMM, cm_dict, COMM_CONFIG_KEYS)
         self.comm_mode = get_scalar_param(cm_dict, COMM_MODE, COMM_MODE_DEFAULT)

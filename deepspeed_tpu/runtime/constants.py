@@ -243,6 +243,24 @@ SERVING_PREFILL_CHUNK = "prefill_chunk"
 SERVING_PREFILL_CHUNK_DEFAULT = 32
 SERVING_USE_PALLAS_DECODE = "use_pallas_decode"
 SERVING_USE_PALLAS_DECODE_DEFAULT = False
+# serving.request_trace — the per-request lifecycle ledger
+# (serve/request_trace.py): latency percentiles, preemption-waste accounting,
+# pool timeline, SLO classification, `ds-tpu serve-timeline` Perfetto export.
+# Disabled -> the engine's tracer gate is None (nothing constructed).
+SERVING_REQUEST_TRACE = "request_trace"
+SERVING_REQUEST_TRACE_ENABLED = "enabled"
+SERVING_REQUEST_TRACE_ENABLED_DEFAULT = False
+SERVING_REQUEST_TRACE_CAPACITY = "capacity"          # finished-request ring
+SERVING_REQUEST_TRACE_CAPACITY_DEFAULT = 256
+SERVING_REQUEST_TRACE_ITERATION_CAPACITY = "iteration_capacity"
+SERVING_REQUEST_TRACE_ITERATION_CAPACITY_DEFAULT = 4096
+SERVING_REQUEST_TRACE_DUMP_DIR = "dump_dir"          # "" = no atexit dump
+SERVING_REQUEST_TRACE_DUMP_DIR_DEFAULT = ""
+SERVING_REQUEST_TRACE_SLO = "slo"
+SERVING_SLO_TTFT_MS = "ttft_ms"                      # 0.0 = metric not gated
+SERVING_SLO_TTFT_MS_DEFAULT = 0.0
+SERVING_SLO_TPOT_MS = "tpot_ms"
+SERVING_SLO_TPOT_MS_DEFAULT = 0.0
 
 #############################################
 # Comm (hierarchical ICI+DCN collectives)
@@ -435,6 +453,20 @@ SERVING_CONFIG_KEYS = frozenset({
     SERVING_MAX_MODEL_LEN,
     SERVING_PREFILL_CHUNK,
     SERVING_USE_PALLAS_DECODE,
+    SERVING_REQUEST_TRACE,
+})
+
+SERVING_REQUEST_TRACE_CONFIG_KEYS = frozenset({
+    SERVING_REQUEST_TRACE_ENABLED,
+    SERVING_REQUEST_TRACE_CAPACITY,
+    SERVING_REQUEST_TRACE_ITERATION_CAPACITY,
+    SERVING_REQUEST_TRACE_DUMP_DIR,
+    SERVING_REQUEST_TRACE_SLO,
+})
+
+SERVING_SLO_CONFIG_KEYS = frozenset({
+    SERVING_SLO_TTFT_MS,
+    SERVING_SLO_TPOT_MS,
 })
 
 COMM_CONFIG_KEYS = frozenset({

@@ -11,15 +11,37 @@ serving layer the ROADMAP's "millions of users" north star needs. Three parts:
   scheduler with chunked prefill interleaved into in-flight decodes (Orca,
   OSDI '22);
 - :mod:`engine` — the ``deepspeed_tpu.init_inference``-shaped facade wrapping
-  models/gpt2.py, config block ``"serving"``, telemetry Serving/* scalars.
+  models/gpt2.py, config block ``"serving"``, telemetry Serving/* scalars;
+- :mod:`request_trace` — the serving observatory: per-request lifecycle
+  ledger, latency percentiles, preemption-waste accounting, SLO
+  classification, ``ds-tpu serve-timeline`` Perfetto export (config block
+  ``"serving": {"request_trace": ...}``).
 
 ``serve/oracle.py`` holds the dense-cache mirror programs the equivalence
 tests and ``ds-tpu serve-sim`` bit-compare the paged path against.
 """
 
-from .block_allocator import AllocationError, BlockAllocator
-from .engine import InferenceEngine
-from .scheduler import Request, RequestOutput, Scheduler
+# Lazy exports (PEP 562): `ds-tpu serve-timeline` dispatches into
+# serve/request_trace.py on machines with no accelerator runtime (post-mortem
+# boxes), so importing this package must not pull in the engine's jax stack.
+_EXPORTS = {
+    "AllocationError": ".block_allocator",
+    "BlockAllocator": ".block_allocator",
+    "InferenceEngine": ".engine",
+    "Request": ".scheduler",
+    "RequestOutput": ".scheduler",
+    "RequestTracer": ".request_trace",
+    "Scheduler": ".scheduler",
+    "StreamingHistogram": ".request_trace",
+}
 
-__all__ = ["AllocationError", "BlockAllocator", "InferenceEngine", "Request",
-           "RequestOutput", "Scheduler"]
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        from importlib import import_module
+        val = getattr(import_module(_EXPORTS[name], __name__), name)
+        globals()[name] = val
+        return val
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

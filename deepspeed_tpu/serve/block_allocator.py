@@ -42,6 +42,12 @@ class BlockAllocator:
         self.block_size = int(block_size)
         self._free = deque(range(1, self.num_blocks))   # block 0 reserved
         self._refcount = {}                              # block -> int (>0)
+        # cumulative free-list traffic counters for the serving request-trace
+        # pool timeline (monotonic; never reset)
+        self.alloc_count = 0        # pages handed out
+        self.free_count = 0         # pages returned to the free list
+        self.fork_count = 0         # page references added by table forks
+        self.cow_copies = 0         # shared pages copied by ensure_exclusive
 
     # ------------------------------------------------------------- queries
     @property
@@ -64,7 +70,9 @@ class BlockAllocator:
     def stats(self) -> dict:
         return {"num_blocks": self.num_blocks, "block_size": self.block_size,
                 "free": self.num_free, "used": self.num_used,
-                "shared": sum(1 for c in self._refcount.values() if c > 1)}
+                "shared": sum(1 for c in self._refcount.values() if c > 1),
+                "alloc_count": self.alloc_count, "free_count": self.free_count,
+                "fork_count": self.fork_count, "cow_copies": self.cow_copies}
 
     # ------------------------------------------------------- alloc/free/fork
     def allocate(self, num_blocks: int) -> list:
@@ -76,6 +84,7 @@ class BlockAllocator:
         out = [self._free.popleft() for _ in range(num_blocks)]
         for b in out:
             self._refcount[b] = 1
+        self.alloc_count += num_blocks
         return out
 
     def free(self, blocks) -> None:
@@ -91,6 +100,7 @@ class BlockAllocator:
             if c == 1:
                 del self._refcount[b]
                 self._free.append(b)
+                self.free_count += 1
             else:
                 self._refcount[b] = c - 1
 
@@ -103,6 +113,7 @@ class BlockAllocator:
             if b not in self._refcount:
                 raise ValueError(f"fork of unallocated block {b}")
             self._refcount[b] += 1
+            self.fork_count += 1
         return list(blocks)
 
     def ensure_exclusive(self, block: int):
@@ -117,4 +128,5 @@ class BlockAllocator:
             return block, None
         fresh = self.allocate(1)[0]
         self._refcount[block] = c - 1
+        self.cow_copies += 1
         return fresh, (block, fresh)

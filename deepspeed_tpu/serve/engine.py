@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from .block_allocator import NULL_BLOCK
 from .paged import build_paged_programs
+from .request_trace import RequestTracer
 from .scheduler import RequestOutput, Scheduler
 
 _MAX_IDLE_SKIP = 1 << 30
@@ -38,7 +39,8 @@ _MAX_IDLE_SKIP = 1 << 30
 class InferenceEngine:
     def __init__(self, model, params, *, num_slots=8, block_size=16,
                  num_blocks=257, max_model_len=256, prefill_chunk=32,
-                 use_pallas=False, telemetry=None, mirror=False):
+                 use_pallas=False, telemetry=None, mirror=False,
+                 request_trace=None):
         c = model.config
         if max_model_len % block_size != 0:
             raise ValueError(f"max_model_len {max_model_len} not a multiple "
@@ -59,6 +61,19 @@ class InferenceEngine:
         self.max_blocks = self.max_model_len // self.block_size
         self.prefill_chunk = int(prefill_chunk)
         self.telemetry = telemetry
+        # the non-perturbing gate: with serving.request_trace disabled the
+        # tracer is None — no attribute exists for compiled code to close
+        # over, and every hook below is a `is not None` host branch
+        # (tests/unit/test_request_trace.py pins HLO-identity on/off)
+        rt = request_trace or {}
+        self.tracer = None
+        if rt.get("enabled"):
+            self.tracer = RequestTracer(
+                capacity=rt.get("capacity", 256),
+                iteration_capacity=rt.get("iteration_capacity", 4096),
+                dump_dir=rt.get("dump_dir") or None,
+                slo=rt.get("slo"),
+                host_id=rt.get("host_id", 0))
 
         self._raw = build_paged_programs(
             model, num_slots=self.num_slots, block_size=self.block_size,
@@ -122,8 +137,12 @@ class InferenceEngine:
         status "refused"), never crash the engine."""
         self._order.append(req.req_id)
         self._submit_ms[req.req_id] = time.perf_counter()
+        if self.tracer is not None:
+            self.tracer.on_submit(req)
         reason = self.scheduler.submit(req)
         if reason is not None:
+            if self.tracer is not None:
+                self.tracer.on_refused(req, reason)
             out = RequestOutput(req.req_id, "refused", refusal=reason)
             self.outputs[req.req_id] = out
             return out
@@ -135,14 +154,21 @@ class InferenceEngine:
         decisions only, so a trace replay is byte-identical (json.dumps)."""
         if self._start_wall is None:
             self._start_wall = time.perf_counter()
-        sched, it = self.scheduler, self._it
+        sched, it, tr = self.scheduler, self._it, self.tracer
         log = {"it": it}
+        if tr is not None:
+            tr.begin_iteration(it)
 
         admitted = sched.admit(it)
         preempted, copies = sched.ensure_decode_room()
         log["admitted"] = [g.req.req_id for g in admitted]
         log["preempted"] = [g.req.req_id for g in preempted]
         log["copies"] = [list(c) for c in copies]
+        if tr is not None:
+            for g in admitted:
+                tr.on_admit(g, it)
+            for g in preempted:
+                tr.on_preempt(g, it, g.evicted_blocks)
         self._run_copies(copies)
 
         log["prefill"] = self._prefill_one(it)
@@ -154,6 +180,15 @@ class InferenceEngine:
         elapsed = max(time.perf_counter() - self._start_wall, 1e-9)
         self._scalar("tok_s", self._tokens_sampled / elapsed)
         self._scalar("goodput_tok_s", self._tokens_finished / elapsed)
+        if tr is not None:
+            itrec = tr.end_iteration(len(sched.waiting), len(sched.running),
+                                     sched.pool_stats())
+            ws = tr.waste_summary()
+            self._scalar("Waste/replayed_tokens", ws["replayed_tokens"])
+            self._scalar("Waste/fraction", ws["waste_fraction"])
+            self._scalar("Pool/fragmentation", itrec["pool"]["frag"])
+            if self.telemetry is not None:
+                self.telemetry.end_step(it, 1, serving=tr.latency_summary())
 
         self._it += 1
         return log
@@ -209,6 +244,8 @@ class InferenceEngine:
                 jnp.int32(g.slots[0]), self._okcs, self._ovcs)
             self._assert_bitwise(logits, ol, f"prefill it={it} "
                                  f"req={g.req.req_id} pos={pos}")
+        if self.tracer is not None:
+            self.tracer.on_prefill(g, it, pos, n, g.prefill_replay_tokens(pos, n))
         done = self.scheduler.finish_prefill_chunk(g, n, it)
         if done:
             self._first_tokens(g, logits, it)
@@ -228,11 +265,20 @@ class InferenceEngine:
                 perm[np.asarray(g.slots[1:], np.int32)] = g.slots[0]
                 self._okcs, self._ovcs = self._mirror["reorder"](
                     self._okcs, self._ovcs, jnp.asarray(perm))
-        g.first_token_ms = (time.perf_counter()
-                            - self._submit_ms[g.req.req_id]) * 1000.0
         self._tokens_sampled += g.lanes
-        self._scalar("ttft_ms", g.first_token_ms)
-        self._scalar("ttft_iters", it - g.req.arrival)
+        if self.tracer is not None:
+            # single-source TTFT: the ledger record feeds the Group field,
+            # the Serving/* scalars AND the RequestOutput fields (they read
+            # the same numbers, so they cannot drift)
+            self.tracer.on_fork(g, it)
+            ttft_ms, ttft_iters = self.tracer.on_first_token(g, it)
+        else:
+            ttft_ms = (time.perf_counter()
+                       - self._submit_ms[g.req.req_id]) * 1000.0
+            ttft_iters = it - g.req.arrival
+        g.first_token_ms = ttft_ms
+        self._scalar("ttft_ms", ttft_ms)
+        self._scalar("ttft_iters", ttft_iters)
 
     def _decode_all(self, it):
         # a group that completed prefill THIS iteration sits out one decode:
@@ -242,6 +288,17 @@ class InferenceEngine:
         decode_log = [[g.req.req_id, lane, slot] for g, lane, slot in lanes]
         if not lanes:
             return decode_log, []
+        if self.tracer is not None:
+            # classify BEFORE sampling appends: a step whose pre-append token
+            # count sits below the group's replay high-water mark regenerates
+            # work a preempted attempt already did (all K lanes of it)
+            traced = set()
+            for g, _, _ in lanes:
+                if id(g) in traced:
+                    continue
+                traced.add(id(g))
+                self.tracer.on_decode(
+                    g, it, g.lanes, g.lanes if g.decode_is_replay() else 0)
         S = self.num_slots
         toks = np.zeros(S, np.int32)
         pos = np.zeros(S, np.int32)
@@ -365,11 +422,23 @@ class InferenceEngine:
         self.scheduler.finish_group(g)
         n = len(tokens)
         self._tokens_finished += n
-        self.outputs[g.req.req_id] = RequestOutput(
-            g.req.req_id, "finished", tokens=list(tokens), score=score,
-            ttft_iters=(g.first_token_it - g.req.arrival),
-            ttft_ms=g.first_token_ms, finished_it=it,
-            preemptions=getattr(g.req, "_preemptions_carry", g.preemptions))
+        rec = (self.tracer.on_finish(g, it, n)
+               if self.tracer is not None else None)
+        if rec is not None:
+            # ledger-derived bookkeeping (same record the timeline exports)
+            out = RequestOutput(
+                g.req.req_id, "finished", tokens=list(tokens), score=score,
+                ttft_iters=rec.get("ttft_iters"), ttft_ms=rec.get("ttft_ms"),
+                finished_it=rec["finished_it"],
+                preemptions=rec["preemptions"])
+        else:
+            out = RequestOutput(
+                g.req.req_id, "finished", tokens=list(tokens), score=score,
+                ttft_iters=(g.first_token_it - g.req.arrival),
+                ttft_ms=g.first_token_ms, finished_it=it,
+                preemptions=getattr(g.req, "_preemptions_carry",
+                                    g.preemptions))
+        self.outputs[g.req.req_id] = out
         finished.append(g.req.req_id)
 
     def _assert_bitwise(self, paged, dense, what, rows=None):

@@ -1,0 +1,558 @@
+"""Serving request observatory: per-request lifecycle ledger, latency
+percentiles, preemption-waste accounting, Perfetto timelines, SLO gate.
+
+The serving engine's iteration loop crosses every interesting request-lifecycle
+boundary on the host anyway — admission, each prefill chunk, each decode
+iteration's batch membership, preemption, beam fork, first token, completion.
+``RequestTracer`` records exactly those boundaries (plus one ``perf_counter``
+read each) into a bounded per-host ring, mirroring the pipeline schedule
+observatory's design (utils/pipeline_trace.py): no device fetch, no barrier,
+no added HLO — with ``serving.request_trace`` disabled the engine holds
+``None`` instead of a tracer, and even enabled the module contains zero
+blocking primitives (pinned by the lint HostSyncPass through
+tests/unit/test_no_sync_guard.py and ``ds-tpu lint``).
+
+Four consumers sit on the ledger:
+
+* **Latency percentiles** — streaming log-bucketed histograms for TTFT, TPOT,
+  queue delay and end-to-end latency; ``percentiles()`` reads p50/p90/p99 (or
+  any requested set) and ``latency_summary()`` flows through
+  ``TelemetrySession.end_step`` as ``Serving/Latency/*`` scalars.
+* **Waste accounting** — every scheduled token is classified useful vs
+  replayed-after-preemption (the scheduler knows exactly which prefill
+  positions and decode steps recompute work a preempted attempt already did);
+  the split sums to total scheduled tokens exactly, plus a per-iteration
+  block-pool occupancy / fragmentation / free-list timeline.
+* **SLO accounting** — finished requests are classified met/violated against
+  ``serving.request_trace.slo`` (``ttft_ms`` / ``tpot_ms``) and ``ds-tpu
+  serve-sim`` gates on attainment.
+* **Perfetto export** — ``to_serve_trace_events`` / ``serve_timeline_main``
+  convert a ledger bundle (or a flight-recorder dump embedding one) into
+  deterministic Chrome ``trace_event`` JSON: one track per request, queue /
+  prefill / decode / replay slices on the iteration timebase, counter tracks
+  for pool occupancy, waiting queue and waste fraction. ``bin/ds-tpu
+  serve-timeline`` dispatches here (docs/serving.md).
+"""
+
+import argparse
+import atexit
+import json
+import math
+import os
+import time
+from collections import deque
+
+REQUEST_TRACE_VERSION = 1
+SERVE_TRACE_KIND = "serving_request_trace"
+
+# the wall-clock latency metrics the tracer keeps streaming histograms for
+LATENCY_METRICS = ("ttft_ms", "tpot_ms", "queue_delay_ms", "e2e_ms")
+# SLO-gateable subset (serving.request_trace.slo config keys)
+SLO_METRICS = ("ttft_ms", "tpot_ms")
+
+# lifecycle event names; every event is a compact list
+# [name, iteration, rel_us, *args] (iteration -1 = outside the step loop)
+EV_SUBMIT = "submit"
+EV_REFUSED = "refused"          # args: reason
+EV_ADMIT = "admit"              # args: lanes, queue_delay_iters
+EV_PREFILL = "prefill"          # args: pos, n, replayed
+EV_DECODE = "decode"            # args: lanes, replayed
+EV_FORK = "fork"                # args: lanes (beam CoW table fork)
+EV_PREEMPT = "preempt"          # args: evicted_blocks
+EV_FIRST_TOKEN = "first_token"
+EV_FINISH = "finish"            # args: n_tokens
+
+
+class StreamingHistogram:
+    """Log-bucketed streaming histogram: O(1) add, bounded memory, percentile
+    read-out at ``growth``-factor relative resolution (default ~3%). Quantiles
+    report the upper bound of the covering bucket, so they never understate a
+    tail — the conservative direction for an SLO read-out."""
+
+    def __init__(self, growth=1.03, min_value=1e-3):
+        self._min = float(min_value)
+        self._lg = math.log(float(growth))
+        self._growth = float(growth)
+        self._buckets = {}
+        self.count = 0
+        self.total = 0.0
+
+    def add(self, value):
+        if value is None:
+            return
+        v = max(float(value), self._min)
+        idx = int(math.log(v / self._min) / self._lg)
+        self._buckets[idx] = self._buckets.get(idx, 0) + 1
+        self.count += 1
+        self.total += float(value)
+
+    def percentile(self, p):
+        """Value at percentile ``p`` (0..100], or None on an empty histogram."""
+        if not self.count:
+            return None
+        target = max(float(p) / 100.0 * self.count, 1.0)
+        seen = 0
+        last = None
+        for idx in sorted(self._buckets):
+            seen += self._buckets[idx]
+            last = idx
+            if seen >= target:
+                break
+        return self._min * self._growth ** (last + 1)
+
+    def percentiles(self, ps=(50, 90, 99)):
+        return {f"p{p:g}": self.percentile(p) for p in ps}
+
+    @property
+    def mean(self):
+        return (self.total / self.count) if self.count else None
+
+
+class RequestTracer:
+    """Bounded per-host ledger of per-request lifecycle events plus the
+    per-iteration goodput/pool timeline. Only stdlib calls on the hot path:
+    one ``perf_counter`` read and a list append per recorded boundary."""
+
+    def __init__(self, capacity=256, iteration_capacity=4096, dump_dir=None,
+                 slo=None, host_id=0):
+        self.capacity = int(capacity)
+        self.iteration_capacity = int(iteration_capacity)
+        self.dump_dir = dump_dir or None
+        self.host_id = int(host_id)
+        # configured SLO thresholds; 0 / missing = that metric is not gated
+        slo = slo or {}
+        self.slo = {m: float(slo[m]) for m in SLO_METRICS
+                    if slo.get(m) and float(slo[m]) > 0.0}
+        self.requests = deque(maxlen=self.capacity)   # finished/refused records
+        self.live = {}                                # req_id -> open record
+        self.iterations = deque(maxlen=self.iteration_capacity)
+        self.hist = {m: StreamingHistogram() for m in LATENCY_METRICS}
+        self.totals = {"prefill_tokens": 0, "prefill_replayed": 0,
+                       "decode_tokens": 0, "decode_replayed": 0}
+        self.slo_met = 0
+        self.slo_violated = 0
+        self.refused = 0
+        self.finished = 0
+        self.preemptions = 0
+        self._epoch = time.perf_counter()
+        self._cur = None                              # open iteration record
+        if self.dump_dir:
+            atexit.register(self._atexit_dump)
+
+    # -- plumbing ----------------------------------------------------------
+    def _now_us(self):
+        return int((time.perf_counter() - self._epoch) * 1e6)
+
+    def _event(self, rec, name, it, *args):
+        rec["events"].append([name, int(it), self._now_us()] + list(args))
+
+    # -- request lifecycle -------------------------------------------------
+    def on_submit(self, req):
+        rec = {
+            "req_id": req.req_id,
+            "arrival": int(req.arrival),
+            "lanes": int(req.num_beams),
+            "prompt_len": len(req.prompt),
+            "max_new_tokens": int(req.max_new_tokens),
+            "status": "live",
+            "preemptions": 0,
+            "events": [],
+        }
+        self.live[req.req_id] = rec
+        self._event(rec, EV_SUBMIT, -1)
+        return rec
+
+    def on_refused(self, req, reason):
+        rec = self.live.pop(req.req_id, None) or self.on_submit(req)
+        self.live.pop(req.req_id, None)
+        self._event(rec, EV_REFUSED, -1, reason)
+        rec["status"] = "refused"
+        self.refused += 1
+        self.requests.append(rec)
+        return rec
+
+    def on_admit(self, g, it):
+        rec = self.live.get(g.req.req_id)
+        if rec is None:
+            return
+        self._event(rec, EV_ADMIT, it, g.lanes, int(it) - rec["arrival"])
+
+    def on_prefill(self, g, it, pos, n, replayed):
+        rec = self.live.get(g.req.req_id)
+        if rec is None:
+            return
+        self._event(rec, EV_PREFILL, it, int(pos), int(n), int(replayed))
+        if self._cur is not None:
+            self._cur["prefill"][0] += int(n) - int(replayed)
+            self._cur["prefill"][1] += int(replayed)
+        self.totals["prefill_tokens"] += int(n)
+        self.totals["prefill_replayed"] += int(replayed)
+
+    def on_decode(self, g, it, lanes, replayed):
+        rec = self.live.get(g.req.req_id)
+        if rec is not None:
+            self._event(rec, EV_DECODE, it, int(lanes), int(replayed))
+        if self._cur is not None:
+            self._cur["decode"][0] += int(lanes) - int(replayed)
+            self._cur["decode"][1] += int(replayed)
+        self.totals["decode_tokens"] += int(lanes)
+        self.totals["decode_replayed"] += int(replayed)
+
+    def on_fork(self, g, it):
+        rec = self.live.get(g.req.req_id)
+        if rec is not None and g.lanes > 1:
+            self._event(rec, EV_FORK, it, g.lanes)
+
+    def on_preempt(self, g, it, evicted_blocks):
+        rec = self.live.get(g.req.req_id)
+        if rec is None:
+            return
+        self._event(rec, EV_PREEMPT, it, int(evicted_blocks))
+        rec["preemptions"] += 1
+        self.preemptions += 1
+
+    def on_first_token(self, g, it):
+        """Record the first-token boundary and return ``(ttft_ms,
+        ttft_iters)`` — the single source both the engine's scalar emission
+        and the RequestOutput fields derive from (they cannot drift)."""
+        rec = self.live.get(g.req.req_id)
+        if rec is None:
+            return None, None
+        self._event(rec, EV_FIRST_TOKEN, it)
+        ttft_ms = (rec["events"][-1][2] - rec["events"][0][2]) / 1000.0
+        ttft_iters = int(it) - rec["arrival"]
+        rec["ttft_ms"] = ttft_ms
+        rec["ttft_iters"] = ttft_iters
+        return ttft_ms, ttft_iters
+
+    def on_finish(self, g, it, n_tokens):
+        rec = self.live.pop(g.req.req_id, None)
+        if rec is None:
+            return None
+        self._event(rec, EV_FINISH, it, int(n_tokens))
+        rec["status"] = "finished"
+        rec["finished_it"] = int(it)
+        rec["n_tokens"] = int(n_tokens)
+        t_submit = rec["events"][0][2]
+        t_finish = rec["events"][-1][2]
+        rec["e2e_ms"] = (t_finish - t_submit) / 1000.0
+        rec["e2e_iters"] = int(it) - rec["arrival"]
+        admits = [e for e in rec["events"] if e[0] == EV_ADMIT]
+        if admits:  # queue delay of the admission that completed (the last)
+            rec["queue_delay_ms"] = (admits[-1][2] - t_submit) / 1000.0
+            rec["queue_delay_iters"] = admits[-1][4]
+        first = [e for e in rec["events"] if e[0] == EV_FIRST_TOKEN]
+        if first and n_tokens > 1:
+            rec["tpot_ms"] = (t_finish - first[-1][2]) / 1000.0 / (n_tokens - 1)
+        for m in LATENCY_METRICS:
+            self.hist[m].add(rec.get(m))
+        rec["slo_violations"] = sorted(
+            m for m, lim in self.slo.items()
+            if rec.get(m) is not None and rec[m] > lim)
+        if self.slo:
+            if rec["slo_violations"]:
+                self.slo_violated += 1
+            else:
+                self.slo_met += 1
+        self.finished += 1
+        self.requests.append(rec)
+        return rec
+
+    # -- iteration timeline ------------------------------------------------
+    def begin_iteration(self, it):
+        self._cur = {"it": int(it), "t_us": self._now_us(),
+                     "prefill": [0, 0],     # [useful, replayed] tokens
+                     "decode": [0, 0]}
+
+    def end_iteration(self, waiting, running, pool):
+        """Close the iteration record with the scheduler's queue depths and
+        the allocator's pool timeline point (``Scheduler.pool_stats``)."""
+        cur, self._cur = self._cur, None
+        if cur is None:
+            return None
+        cur["waiting"] = int(waiting)
+        cur["running"] = int(running)
+        cur["pool"] = pool
+        self.iterations.append(cur)
+        return cur
+
+    # -- read-outs ---------------------------------------------------------
+    def percentiles(self, metric=None, ps=(50, 90, 99)):
+        """p50/p90/p99 (or any ``ps``) of one latency metric, or of all of
+        them when ``metric`` is None — only metrics with data appear."""
+        if metric is not None:
+            return self.hist[metric].percentiles(ps)
+        return {m: self.hist[m].percentiles(ps)
+                for m in LATENCY_METRICS if self.hist[m].count}
+
+    def latency_summary(self, ps=(50, 90, 99)):
+        """Flat ``{metric_pNN: value}`` dict for TelemetrySession.end_step
+        (emitted as ``Serving/Latency/*`` scalars)."""
+        out = {}
+        for m in LATENCY_METRICS:
+            h = self.hist[m]
+            if not h.count:
+                continue
+            for p in ps:
+                out[f"{m}_p{p:g}"] = h.percentile(p)
+        return out
+
+    def waste_summary(self):
+        t = self.totals
+        scheduled = t["prefill_tokens"] + t["decode_tokens"]
+        replayed = t["prefill_replayed"] + t["decode_replayed"]
+        return {
+            "scheduled_tokens": scheduled,
+            "useful_tokens": scheduled - replayed,
+            "replayed_tokens": replayed,
+            "prefill_tokens": t["prefill_tokens"],
+            "prefill_replayed": t["prefill_replayed"],
+            "decode_tokens": t["decode_tokens"],
+            "decode_replayed": t["decode_replayed"],
+            "waste_fraction": (replayed / scheduled) if scheduled else 0.0,
+        }
+
+    def slo_summary(self):
+        classified = self.slo_met + self.slo_violated
+        return {
+            "configured": dict(self.slo),
+            "met": self.slo_met,
+            "violated": self.slo_violated,
+            "attainment": (self.slo_met / classified) if classified else None,
+        }
+
+    # -- bundle / dump -----------------------------------------------------
+    def bundle(self):
+        return {
+            "version": REQUEST_TRACE_VERSION,
+            "kind": SERVE_TRACE_KIND,
+            "host": self.host_id,
+            "slo": dict(self.slo),
+            "requests": list(self.requests),
+            "live": [self.live[k] for k in sorted(self.live)],
+            "iterations": list(self.iterations),
+            "totals": dict(self.totals),
+            "counts": {"finished": self.finished, "refused": self.refused,
+                       "preemptions": self.preemptions},
+        }
+
+    def dump(self, path=None):
+        if path is None:
+            if not self.dump_dir:
+                return None
+            os.makedirs(self.dump_dir, exist_ok=True)
+            path = os.path.join(self.dump_dir,
+                                f"request_trace_host{self.host_id}.json")
+        with open(path, "w") as f:
+            json.dump(self.bundle(), f)
+        return path
+
+    def _atexit_dump(self):
+        if self.dump_dir and (self.requests or self.live):
+            try:
+                self.dump()
+            except OSError:
+                pass  # trace dump failure must never mask the real exit
+
+
+# ------------------------------------------------------------- Perfetto export
+
+# Chrome trace_event reserved color names (same convention as the pipeline
+# exporter): useful work vs replayed-after-preemption work must be visually
+# distinct at a glance
+_CAT_COLORS = {
+    "prefill": "thread_state_running",
+    "decode": "thread_state_runnable",
+    "prefill_replay": "cq_build_failed",
+    "decode_replay": "cq_build_failed",
+    "queued": "rail_idle",
+}
+
+
+def _slice(tid, ts, dur, name, cat, args):
+    ev = {"ph": "X", "pid": 0, "tid": tid, "ts": ts, "dur": max(dur, 1),
+          "cat": cat, "name": name, "args": args}
+    color = _CAT_COLORS.get(cat)
+    if color:
+        ev["cname"] = color
+    return ev
+
+
+def to_serve_trace_events(bundle, us_per_iter=1000):
+    """Convert a request-trace bundle into Chrome/Perfetto ``trace_event``
+    JSON: one thread (track) per request in arrival order, queue / prefill /
+    decode slices (replayed work color-flagged), instant markers for preempt /
+    first-token / finish, and counter tracks for pool occupancy, waiting queue
+    and cumulative waste fraction.
+
+    Timestamps live on the ITERATION timebase (``it * us_per_iter``), which is
+    a pure function of the schedule — the export is byte-deterministic for a
+    deterministic trace (the golden-file contract), unlike the wall-clock
+    ``*_us`` fields the bundle also carries for human inspection."""
+    U = int(us_per_iter)
+    events = [{"ph": "M", "pid": 0, "tid": 0, "name": "process_name",
+               "args": {"name": f"serving host {bundle.get('host', 0)}"}}]
+    records = sorted(list(bundle.get("requests", []))
+                     + list(bundle.get("live", [])),
+                     key=lambda r: (r["arrival"], r["req_id"]))
+
+    def ts_of(it, fallback):
+        return (int(it) if it >= 0 else int(fallback)) * U
+
+    for i, rec in enumerate(records):
+        tid = i + 1
+        events.append({"ph": "M", "pid": 0, "tid": tid, "name": "thread_name",
+                       "args": {"name": rec["req_id"]}})
+        events.append({"ph": "M", "pid": 0, "tid": tid,
+                       "name": "thread_sort_index",
+                       "args": {"sort_index": tid}})
+        queued_since = rec["arrival"]
+        run = None          # open decode run: [start_it, end_it, toks, replay]
+
+        def flush_run():
+            nonlocal run
+            if run is None:
+                return
+            start, end, toks, replayed = run
+            cat = "decode_replay" if replayed else "decode"
+            events.append(_slice(
+                tid, start * U, (end - start + 1) * U,
+                f"decode x{end - start + 1}", cat,
+                {"iters": end - start + 1, "tokens": toks,
+                 "replayed": replayed}))
+            run = None
+
+        for ev in rec["events"]:
+            name, it = ev[0], ev[1]
+            if name != EV_DECODE:
+                flush_run()
+            if name == EV_ADMIT:
+                if it > queued_since:
+                    events.append(_slice(
+                        tid, queued_since * U, (it - queued_since) * U,
+                        "queued", "queued",
+                        {"iters": it - queued_since}))
+            elif name == EV_PREFILL:
+                pos, n, replayed = ev[3], ev[4], ev[5]
+                cat = "prefill_replay" if replayed == n else "prefill"
+                events.append(_slice(
+                    tid, it * U, U, f"prefill[{pos}:{pos + n}]", cat,
+                    {"pos": pos, "tokens": n, "replayed": replayed}))
+            elif name == EV_DECODE:
+                lanes, replayed = ev[3], ev[4]
+                if run is not None and (run[1] + 1 != it
+                                        or bool(run[3]) != bool(replayed)):
+                    flush_run()
+                if run is None:
+                    run = [it, it, 0, 0]
+                run[1] = it
+                run[2] += lanes
+                run[3] += replayed
+            elif name == EV_PREEMPT:
+                events.append({"ph": "i", "pid": 0, "tid": tid, "ts": it * U,
+                               "s": "t", "name": "preempt",
+                               "args": {"evicted_blocks": ev[3]}})
+                queued_since = it
+            elif name == EV_FIRST_TOKEN:
+                events.append({"ph": "i", "pid": 0, "tid": tid, "ts": it * U,
+                               "s": "t", "name": "first_token",
+                               "args": {"ttft_iters": rec.get("ttft_iters")}})
+            elif name == EV_FINISH:
+                events.append({"ph": "i", "pid": 0, "tid": tid, "ts": it * U,
+                               "s": "t", "name": "finish",
+                               "args": {"n_tokens": ev[3]}})
+            elif name == EV_REFUSED:
+                events.append({"ph": "i", "pid": 0, "tid": tid,
+                               "ts": ts_of(it, rec["arrival"]), "s": "t",
+                               "name": "refused", "args": {"reason": ev[3]}})
+        flush_run()
+
+    sched_tokens = 0
+    replayed_tokens = 0
+    for itrec in bundle.get("iterations", []):
+        ts = itrec["it"] * U
+        pool = itrec.get("pool") or {}
+        used, free = pool.get("used", 0), pool.get("free", 0)
+        occ = used / (used + free) if (used + free) else 0.0
+        events.append({"ph": "C", "pid": 0, "tid": 0, "ts": ts,
+                       "name": "pool occupancy",
+                       "args": {"occupancy": round(occ, 6)}})
+        if "frag" in pool:
+            events.append({"ph": "C", "pid": 0, "tid": 0, "ts": ts,
+                           "name": "pool fragmentation",
+                           "args": {"fragmentation": round(pool["frag"], 6)}})
+        events.append({"ph": "C", "pid": 0, "tid": 0, "ts": ts,
+                       "name": "waiting queue",
+                       "args": {"waiting": itrec.get("waiting", 0)}})
+        events.append({"ph": "C", "pid": 0, "tid": 0, "ts": ts,
+                       "name": "free blocks", "args": {"free": free}})
+        sched_tokens += sum(itrec["prefill"]) + sum(itrec["decode"])
+        replayed_tokens += itrec["prefill"][1] + itrec["decode"][1]
+        waste = replayed_tokens / sched_tokens if sched_tokens else 0.0
+        events.append({"ph": "C", "pid": 0, "tid": 0, "ts": ts,
+                       "name": "waste fraction",
+                       "args": {"waste": round(waste, 6)}})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"generator": "ds-tpu serve-timeline",
+                          "requests": len(records),
+                          "us_per_iter": U,
+                          "trace_version": bundle.get("version")}}
+
+
+# --------------------------------------------------------------------- the CLI
+
+
+def _load_bundle(path):
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("kind") == SERVE_TRACE_KIND:
+        return data
+    # flight-recorder dump with an embedded request-trace bundle
+    embedded = data.get(SERVE_TRACE_KIND)
+    if isinstance(embedded, dict) and embedded.get("kind") == SERVE_TRACE_KIND:
+        return embedded
+    return None
+
+
+def serve_timeline_main(argv=None):
+    """``ds-tpu serve-timeline`` entry point: request-trace ledger bundle (or
+    a flight-recorder dump embedding one) -> Perfetto/Chrome trace_event JSON."""
+    from ..utils.pipeline_trace import serialize_trace
+
+    parser = argparse.ArgumentParser(
+        prog="ds-tpu serve-timeline",
+        description="Convert a serving request_trace ledger bundle (or a "
+                    "flight-recorder dump that embeds one) into Perfetto/"
+                    "Chrome trace_event JSON viewable at ui.perfetto.dev or "
+                    "chrome://tracing.")
+    parser.add_argument("bundle", help="path to the ledger bundle / dump JSON")
+    parser.add_argument("-o", "--output", default=None,
+                        help="output path (default: <bundle>.trace.json)")
+    parser.add_argument("--us-per-iter", type=int, default=1000,
+                        help="microseconds per scheduler iteration on the "
+                             "deterministic timebase (default 1000)")
+    args = parser.parse_args(argv)
+
+    try:
+        bundle = _load_bundle(args.bundle)
+    except (OSError, ValueError) as e:
+        print(f"ds-tpu serve-timeline: cannot read {args.bundle}: {e}")
+        return 2
+    if bundle is None:
+        print(f"ds-tpu serve-timeline: {args.bundle} holds no "
+              f"{SERVE_TRACE_KIND} bundle (enable serving.request_trace and "
+              "re-dump)")
+        return 2
+
+    trace = to_serve_trace_events(bundle, us_per_iter=args.us_per_iter)
+    out = args.output
+    if out is None:
+        stem = args.bundle[:-5] if args.bundle.endswith(".json") else args.bundle
+        out = stem + ".trace.json"
+    with open(out, "w") as f:
+        f.write(serialize_trace(trace))
+    n_req = len(bundle.get("requests", [])) + len(bundle.get("live", []))
+    print(f"wrote {len(trace['traceEvents'])} trace events "
+          f"({n_req} requests, {len(bundle.get('iterations', []))} "
+          f"iterations) -> {out}")
+    return 0

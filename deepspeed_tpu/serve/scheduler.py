@@ -93,6 +93,13 @@ class Group:
         self.first_token_it = None
         self.first_token_ms = None
         self.preemptions = 0
+        # replay high-water marks: how far a previous (preempted) attempt got.
+        # Prefill positions below the prefill mark and decode steps below the
+        # decode mark recompute work the pool eviction threw away — the
+        # request-trace ledger classifies exactly those tokens as waste.
+        self.replay_prefill_hwm = getattr(req, "_replay_prefill_hwm", 0)
+        self.replay_decode_hwm = getattr(req, "_replay_decode_hwm", 0)
+        self.evicted_blocks = 0                 # KV pages freed by _preempt
 
     @property
     def lanes(self):
@@ -106,6 +113,16 @@ class Group:
         """Cache position the lane's next decode step writes (= position of
         its newest token, which that step consumes)."""
         return self.prompt_len + len(self.generated[lane]) - 1
+
+    def prefill_replay_tokens(self, pos, n):
+        """Of a prefill chunk covering positions [pos, pos+n), how many were
+        already computed by a preempted attempt (bit-identical recompute)."""
+        return min(max(self.replay_prefill_hwm - pos, 0), n)
+
+    def decode_is_replay(self):
+        """True when the coming decode step regenerates a token a preempted
+        attempt had already produced (call before the step appends)."""
+        return bool(self.generated) and len(self.generated[0]) < self.replay_decode_hwm
 
 
 class Scheduler:
@@ -193,6 +210,7 @@ class Scheduler:
         """Full restart: free everything, requeue at the group's original
         queue position. The fixed-shape programs make the restarted run
         bit-identical, so no generated state needs saving."""
+        g.evicted_blocks = len({b for t in g.tables for b in t})
         for t in g.tables:
             self.allocator.free(t)
         g.tables = []
@@ -202,6 +220,11 @@ class Scheduler:
         g.preemptions += 1
         req = g.req
         req._preemptions_carry = g.preemptions  # survives the restart
+        # the restart recomputes everything up to where this attempt got —
+        # record that frontier so the ledger can bill the replay as waste
+        req._replay_prefill_hwm = max(g.replay_prefill_hwm, g.prefill_done)
+        req._replay_decode_hwm = max(
+            g.replay_decode_hwm, len(g.generated[0]) if g.generated else 0)
         self.waiting.append((req, g.submit_idx))
         self.waiting.sort(key=lambda e: (e[0].arrival, e[1]))
 
@@ -323,3 +346,29 @@ class Scheduler:
     # ------------------------------------------------------------------ misc
     def occupancy(self):
         return 1.0 - len(self.free_slots) / self.num_slots
+
+    def pool_stats(self):
+        """One block-pool timeline point for the request-trace ledger:
+        allocator free/used/shared/CoW counters plus internal fragmentation —
+        the fraction of token slots in used pages holding no token (a page is
+        billed at its fullest lane; prompt pages shared across beam lanes
+        count once)."""
+        st = self.allocator.stats()
+        BS = self.block_size
+        fill = {}
+        for g in self.running:
+            for lane in range(len(g.tables)):
+                if g.phase == "prefill":
+                    n_tok = g.prefill_done
+                else:
+                    # newest token's KV is written by the NEXT decode step
+                    n_tok = g.prompt_len + len(g.generated[lane]) - 1
+                for i, b in enumerate(g.tables[lane]):
+                    f = min(n_tok - i * BS, BS)
+                    if f > 0:
+                        fill[b] = max(fill.get(b, 0), f)
+        capacity = st["used"] * BS
+        frag = (1.0 - sum(fill.values()) / capacity) if capacity else 0.0
+        return {"free": st["free"], "used": st["used"],
+                "shared": st["shared"], "cow_copies": st["cow_copies"],
+                "frag": frag}

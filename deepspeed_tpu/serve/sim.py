@@ -12,9 +12,17 @@ CPU mesh and asserts the three serving invariants:
 3. **Deterministic schedule** — with ``--replay``, the whole trace is run
    twice on fresh engines and the per-iteration schedule logs must be
    byte-identical (json.dumps) and the outputs token-identical.
+4. **Exact waste decomposition** — the request-trace ledger (on by default
+   here) must classify every scheduled token as useful or replayed, summing
+   to the schedule log's own token count exactly.
+5. **SLO attainment** (with ``--slo-ttft-ms`` / ``--slo-tpot-ms``) — any
+   finished request violating a configured SLO fails the run nonzero.
 
 Serving/* scalars (occupancy, TTFT, goodput) land in the TelemetrySession's
-scalars.jsonl. Exit 0 = all invariants held.
+scalars.jsonl. ``--json`` writes a machine-readable report whose
+``deterministic`` subtree is byte-stable across runs (CI diffs it, mirroring
+``ds-tpu lint --json``); ``--dump-ledger`` writes the raw ledger bundle for
+``ds-tpu serve-timeline``. Exit 0 = all invariants held.
 """
 
 import argparse
@@ -65,8 +73,57 @@ def _build(args, telemetry):
         model, params, num_slots=args.slots, block_size=args.block_size,
         num_blocks=args.num_blocks, max_model_len=args.max_model_len,
         prefill_chunk=args.prefill_chunk, use_pallas=args.pallas,
-        telemetry=telemetry, mirror=not args.no_mirror)
+        telemetry=telemetry, mirror=not args.no_mirror,
+        request_trace=None if args.no_trace else {
+            "enabled": True,
+            "capacity": max(args.requests + 1, 256),
+            "slo": {"ttft_ms": args.slo_ttft_ms, "tpot_ms": args.slo_tpot_ms},
+        })
     return engine
+
+
+def _report(args, trace, outputs, logs, tracer, waste, slo, failures):
+    """Machine-readable serve-sim report. The ``deterministic`` subtree is a
+    pure function of the seeded trace (iteration-domain latencies, token
+    counts, waste split — byte-stable across runs on one platform); ``wall``
+    carries the ms-domain percentiles and SLO attainment, which vary run to
+    run. CI diffs the deterministic part."""
+    recs = {}
+    if tracer is not None:
+        recs = {r["req_id"]: r for r in tracer.requests}
+    table = []
+    for o in sorted(outputs, key=lambda o: o.req_id):
+        r = recs.get(o.req_id, {})
+        table.append({
+            "req_id": o.req_id,
+            "status": o.status,
+            "n_tokens": len(o.tokens),
+            "ttft_iters": o.ttft_iters,
+            "queue_delay_iters": r.get("queue_delay_iters"),
+            "e2e_iters": r.get("e2e_iters"),
+            "preemptions": o.preemptions,
+            "slo_violations": r.get("slo_violations", []),
+        })
+    det = {
+        "args": {"requests": args.requests, "seed": args.seed,
+                 "slots": args.slots, "block_size": args.block_size,
+                 "num_blocks": args.num_blocks,
+                 "max_model_len": args.max_model_len,
+                 "prefill_chunk": args.prefill_chunk},
+        "n_finished": sum(1 for o in outputs if o.status == "finished"),
+        "n_refused": sum(1 for o in outputs if o.status == "refused"),
+        "iterations": len(logs),
+        "preemptions": sum(len(l["preempted"]) for l in logs),
+        "requests": table,
+        "waste": waste,
+    }
+    wall = {}
+    if tracer is not None:
+        wall["percentiles"] = tracer.percentiles()
+        wall["slo"] = slo
+    return {"version": 1, "kind": "serve_sim_report",
+            "deterministic": det, "wall": wall,
+            "failures": list(failures)}
 
 
 def main(argv=None):
@@ -98,7 +155,26 @@ def main(argv=None):
                          "admission refusal)")
     ap.add_argument("--output", default="serve_sim_telemetry",
                     help="TelemetrySession output dir for Serving/* scalars")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="disable the request-trace ledger (the engine's "
+                         "tracer gate is None — the HLO-identity mode)")
+    ap.add_argument("--slo-ttft-ms", type=float, default=0.0,
+                    help="TTFT SLO in ms (0 = not gated); any finished "
+                         "request over the limit fails the run")
+    ap.add_argument("--slo-tpot-ms", type=float, default=0.0,
+                    help="per-output-token SLO in ms (0 = not gated)")
+    ap.add_argument("--json", dest="json_out", default=None, metavar="PATH",
+                    help="write the machine-readable report here ('-' = "
+                         "stdout); its 'deterministic' subtree is byte-"
+                         "stable across runs")
+    ap.add_argument("--dump-ledger", default=None, metavar="PATH",
+                    help="write the raw request-trace ledger bundle here "
+                         "(input for `ds-tpu serve-timeline`)")
     args = ap.parse_args(argv)
+    if args.no_trace and (args.slo_ttft_ms or args.slo_tpot_ms
+                          or args.dump_ledger):
+        ap.error("--no-trace is incompatible with --slo-*/--dump-ledger "
+                 "(they need the ledger)")
 
     from ..utils.telemetry import TelemetrySession
 
@@ -149,6 +225,49 @@ def main(argv=None):
         if toks1 != toks2:
             failures.append("replay outputs diverged")
 
+    tracer = engine.tracer
+    waste = slo = None
+    if tracer is not None:
+        # invariant 4: the ledger's useful/replayed split covers every token
+        # the schedule log says was scheduled — exactly, no residue
+        waste = tracer.waste_summary()
+        sched_prefill = sum(l["prefill"][2] for l in logs if l["prefill"])
+        sched_decode = sum(len(l["decode"]) for l in logs)
+        if (waste["prefill_tokens"] != sched_prefill
+                or waste["decode_tokens"] != sched_decode):
+            failures.append(
+                f"waste decomposition does not sum to scheduled tokens: "
+                f"ledger prefill {waste['prefill_tokens']} vs schedule "
+                f"{sched_prefill}, ledger decode {waste['decode_tokens']} "
+                f"vs schedule {sched_decode}")
+        if (waste["useful_tokens"] + waste["replayed_tokens"]
+                != waste["scheduled_tokens"]):
+            failures.append("waste decomposition: useful + replayed != "
+                            "scheduled")
+        # invariant 5: configured SLOs hold for every finished request
+        slo = tracer.slo_summary()
+        if slo["configured"] and slo["violated"]:
+            worst = [r["req_id"] for r in tracer.requests
+                     if r.get("slo_violations")]
+            failures.append(
+                f"SLO violated by {slo['violated']} of "
+                f"{slo['met'] + slo['violated']} finished requests "
+                f"(attainment {slo['attainment']:.3f}): "
+                f"{', '.join(worst[:8])}")
+
+    if args.dump_ledger:
+        tracer.dump(args.dump_ledger)
+
+    if args.json_out:
+        report = _report(args, trace, outputs, logs, tracer, waste, slo,
+                         failures)
+        blob = json.dumps(report, sort_keys=True, separators=(",", ":"))
+        if args.json_out == "-":
+            print(blob)
+        else:
+            with open(args.json_out, "w") as f:
+                f.write(blob)
+
     session.close()
 
     print(f"serve-sim: {len(finished)} finished / {len(refused)} refused "
@@ -165,6 +284,21 @@ def main(argv=None):
               f"all identical")
     if args.replay:
         print("  replay           : byte-identical schedule + outputs")
+    if waste is not None:
+        print(f"  token waste      : {waste['replayed_tokens']} of "
+              f"{waste['scheduled_tokens']} scheduled tokens replayed "
+              f"({waste['waste_fraction']:.1%})")
+        pcts = tracer.percentiles()
+        for m in ("ttft_ms", "tpot_ms"):
+            if m in pcts:
+                p = pcts[m]
+                print(f"  {m:<16} : p50 {p['p50']:.2f} p90 {p['p90']:.2f} "
+                      f"p99 {p['p99']:.2f}")
+    if slo and slo["configured"]:
+        print(f"  SLO              : {slo['met']} met / {slo['violated']} "
+              f"violated (attainment {slo['attainment']:.3f})")
+    if args.dump_ledger:
+        print(f"  ledger           : {args.dump_ledger}")
     print(f"  scalars          : {session.monitor.log_dir}/scalars.jsonl")
 
     if failures:

@@ -188,13 +188,16 @@ class FlightRecorder:
     dumps a JSON post-mortem bundle when triggered."""
 
     def __init__(self, capacity=256, dump_dir=None, telemetry=None, host_id=0,
-                 pipeline_trace=None):
+                 pipeline_trace=None, request_trace=None):
         self.capacity = int(capacity)
         self.dump_dir = dump_dir
         self.telemetry = telemetry
         # optional PipelineTracer: its span bundle rides along in every dump so
         # ``ds-tpu timeline`` can reconstruct the schedule of a dead run
         self.pipeline_trace = pipeline_trace
+        # optional serving RequestTracer (serve/request_trace.py): same deal,
+        # for ``ds-tpu serve-timeline`` on a dead serving host's dump
+        self.request_trace = request_trace
         self.host_id = int(host_id)
         self.steps = deque(maxlen=self.capacity)
         self.events = deque(maxlen=max(self.capacity * 4, 64))
@@ -250,6 +253,8 @@ class FlightRecorder:
         }
         if self.pipeline_trace is not None:
             out["pipeline_trace"] = self.pipeline_trace.bundle()
+        if self.request_trace is not None:
+            out["serving_request_trace"] = self.request_trace.bundle()
         return out
 
     # -- triggering --------------------------------------------------------

@@ -353,7 +353,7 @@ class TelemetrySession:
 
     # ------------------------------------------------------------- step metrics
     def end_step(self, global_step: int, samples_per_step: int, pending=None,
-                 numerics=None, goodput=None):
+                 numerics=None, goodput=None, serving=None):
         """Close one optimizer step's metrics. The ONLY blocking operation is a
         device_get of ``pending``'s last loss scalar (already computed; the
         engine fetches it for its monitor anyway) — the step boundary rides that
@@ -367,7 +367,12 @@ class TelemetrySession:
 
         ``goodput`` (optional) is the pipeline tracer's per-step decomposition
         (utils/pipeline_trace.goodput_decomposition) — already computed from
-        host timestamps, so emitting it here adds scalars only."""
+        host timestamps, so emitting it here adds scalars only.
+
+        ``serving`` (optional) is the serving request tracer's flat latency
+        summary (serve/request_trace.RequestTracer.latency_summary — e.g.
+        ``ttft_ms_p99``); emitted as ``Serving/Latency/*`` scalars, again
+        host-computed so scalars only."""
         numerics_host = None
         try:
             if pending:
@@ -431,6 +436,9 @@ class TelemetrySession:
             if goodput.get("bubble_fraction") is not None:
                 mon.add_scalar("Pipeline/Goodput/bubble_fraction",
                                goodput["bubble_fraction"], samples)
+        if serving:
+            for key in sorted(serving):   # sorted: deterministic scalar order
+                mon.add_scalar(f"Serving/Latency/{key}", serving[key], samples)
         mon.flush()
         if self._trace_active and self.trace_steps is not None \
                 and global_step >= self.trace_steps[1]:

@@ -25,5 +25,22 @@ lint_rc=$?
 # is byte-stable, diff two runs to prove a change is schedule-neutral
 timeout -k 10 300 "$REPO/bin/ds-tpu" comm-sim --out /tmp/_comm_sim.json
 comm_rc=$?
+# serve-sim: seeded 64-request serving replay, SLO-gated (generous wall-clock
+# limits so the gate trips on starvation regressions, not machine speed), with
+# the request-trace ledger dumped and its Perfetto export byte-compared
+# against the committed golden — any schedule or exporter drift fails CI
+timeout -k 10 300 "$REPO/bin/ds-tpu" serve-sim --no-mirror \
+    --slo-ttft-ms 60000 --slo-tpot-ms 60000 \
+    --dump-ledger /tmp/_serve_ledger.json --json /tmp/_serve_sim.json \
+    --output /tmp/_serve_sim_telemetry
+serve_rc=$?
+if [ "$serve_rc" -eq 0 ]; then
+    timeout -k 10 60 "$REPO/bin/ds-tpu" serve-timeline /tmp/_serve_ledger.json \
+        -o /tmp/_serve_timeline.trace.json \
+    && cmp "$REPO/tests/unit/golden/serve_timeline_64.trace.json" \
+           /tmp/_serve_timeline.trace.json
+    serve_rc=$?
+fi
 [ "$lint_rc" -ne 0 ] && exit "$lint_rc"
-exit "$comm_rc"
+[ "$comm_rc" -ne 0 ] && exit "$comm_rc"
+exit "$serve_rc"

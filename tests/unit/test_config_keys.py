@@ -115,6 +115,22 @@ SWEEP = {
         ({"block_size": 0}, ("raise", ValueError)),
         # paged gather bit-matches the oracle only when the tiling is exact
         ({"block_size": 16, "max_model_len": 100}, ("raise", ValueError)),
+        ({"request_trace": {"enabled": True}},
+         ("attr", "serving_request_trace_enabled", True)),
+        ({"request_trace": {"enabled": True, "capacity": 33}},
+         ("attr", "serving_request_trace_capacity", 33)),
+        ({"request_trace": {"iteration_capacity": 99}},
+         ("attr", "serving_request_trace_iteration_capacity", 99)),
+        ({"request_trace": {"dump_dir": "/tmp/rt"}},
+         ("attr", "serving_request_trace_dump_dir", "/tmp/rt")),
+        ({"request_trace": {"slo": {"ttft_ms": 250.0}}},
+         ("attr", "serving_slo_ttft_ms", 250.0)),
+        ({"request_trace": {"slo": {"tpot_ms": 40}}},
+         ("attr", "serving_slo_tpot_ms", 40.0)),
+        ({"request_trace": {"capacity": 0}}, ("raise", ValueError)),
+        ({"request_trace": {"iteration_capacity": 0}}, ("raise", ValueError)),
+        ({"request_trace": {"slo": {"ttft_ms": -1}}}, ("raise", ValueError)),
+        ({"request_trace": {"slo": {"tpot_ms": True}}}, ("raise", ValueError)),
     ),
     "comm": (
         ({"mode": "hierarchical"}, ("attr", "comm_mode", "hierarchical")),
@@ -201,6 +217,19 @@ def test_unknown_serving_key_warns(capture):
     assert "blok_size" in capture.text
 
 
+def test_unknown_request_trace_key_warns(capture):
+    _cfg(serving={"request_trace": {"enabled": True, "capactiy": 7}})
+    assert "unknown serving.request_trace config key" in capture.text
+    assert "capactiy" in capture.text
+
+
+def test_unknown_request_trace_slo_key_warns(capture):
+    _cfg(serving={"request_trace": {"slo": {"ttft": 250.0}}})
+    assert "unknown serving.request_trace.slo config key" in capture.text
+    assert "ttft" in capture.text
+    assert "ttft_ms" in capture.text     # the known-keys hint points at the fix
+
+
 def test_unknown_numerics_key_warns(capture):
     _cfg(numerics={"enabled": True, "ring_sz": 4})
     assert "unknown numerics config key" in capture.text
@@ -210,7 +239,9 @@ def test_unknown_numerics_key_warns(capture):
 def test_known_nested_keys_do_not_warn(capture):
     _cfg(telemetry={"enabled": True, "trace_steps": [2, 5],
                     "pipeline_trace": {"enabled": True, "capacity": 7}},
-         numerics={"enabled": True, "audit_interval": 3})
+         numerics={"enabled": True, "audit_interval": 3},
+         serving={"request_trace": {"enabled": True, "capacity": 64,
+                                    "slo": {"ttft_ms": 250.0, "tpot_ms": 40.0}}})
     assert "unknown" not in capture.text
 
 

@@ -6,8 +6,9 @@ performs. That promise is easy to erode one innocent-looking ``device_get``
 at a time, so this test enforces it STATICALLY — and since PR 6 it is a thin
 wrapper over the lint framework's :class:`HostSyncPass` (the same pass
 ``ds-tpu lint`` runs), pinned to the same shipped allowlist, so the guard and
-the linter cannot drift. Coverage is ALL of ``deepspeed_tpu/utils/``, not the
-original three modules.
+the linter cannot drift. Coverage is ALL of ``deepspeed_tpu/utils/`` plus the
+serving request-trace ledger (``serve/request_trace.py``), matching the lint
+CLI's host-sync surface exactly.
 """
 
 import os
@@ -32,6 +33,7 @@ def _utils_files():
     for dirpath, _dirs, files in os.walk(UTILS):
         out += [os.path.join(dirpath, f) for f in files if f.endswith(".py")]
     assert len(out) >= 8, "utils/ sweep looks truncated"
+    out.append(os.path.join(PKG, "serve", "request_trace.py"))
     return sorted(out)
 
 
@@ -71,5 +73,14 @@ def test_pass_reports_occurrence_counts():
 
 def test_guard_scans_the_real_files():
     files = _utils_files()
-    for name in ("telemetry.py", "numerics.py", "pipeline_trace.py", "hlo.py"):
+    for name in ("telemetry.py", "numerics.py", "pipeline_trace.py", "hlo.py",
+                 os.path.join("serve", "request_trace.py")):
         assert any(f.endswith(name) for f in files), f"{name} missing from sweep"
+
+
+def test_request_trace_ledger_is_sync_free():
+    """The serving request tracer sits INSIDE the decode loop, so unlike
+    end_step it gets no sanctioned fetch at all: zero host-sync primitives."""
+    rt = os.path.join(PKG, "serve", "request_trace.py")
+    vids = {v.vid for v in run_ast_passes([rt], (HostSyncPass(),), root=ROOT)}
+    assert vids == set(), f"host-sync primitive in the request ledger: {vids}"

@@ -1,0 +1,480 @@
+"""Serving request observatory tests: per-request lifecycle ledger coverage,
+TTFT single-sourcing (RequestOutput == ledger record), exact preemption-waste
+decomposition, SLO classification + serve-sim gate, Serving/Latency/* scalars
+through TelemetrySession, the HLO-identity/zero-recompile guarantee when the
+trace block toggles, flight-recorder embedding, and the Perfetto exporter
+(64-request golden byte stability + CLI round trips).
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.serve.engine import InferenceEngine
+from deepspeed_tpu.serve.request_trace import (RequestTracer,
+                                               StreamingHistogram,
+                                               serve_timeline_main,
+                                               to_serve_trace_events)
+from deepspeed_tpu.serve.scheduler import Request
+from deepspeed_tpu.serve.sim import main as sim_main
+from deepspeed_tpu.utils.hlo import instruction_count, optimized_hlo
+from deepspeed_tpu.utils.pipeline_trace import serialize_trace
+from deepspeed_tpu.utils.telemetry import TelemetrySession
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden",
+                      "serve_timeline_64.trace.json")
+
+ML = 32
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = GPT2Config(vocab_size=64, n_positions=ML, n_embd=16, n_layer=2,
+                     n_head=2, compute_dtype=jnp.float32, loss_chunk=0)
+    model = GPT2Model(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _engine(model_and_params, trace=True, **kw):
+    model, params = model_and_params
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 33)
+    kw.setdefault("max_model_len", ML)
+    kw.setdefault("prefill_chunk", 8)
+    if trace is True:
+        trace = {"enabled": True}
+    return InferenceEngine(model, params, request_trace=trace, **kw)
+
+
+def _prompt(seed, n):
+    return np.random.RandomState(seed).randint(0, 64, size=n).astype(np.int32).tolist()
+
+
+def _starved(model_and_params, **kw):
+    """Short prompts, long generations, a 12-page pool: every group reaches
+    decode and then the pool starves -> decode-phase preempt-by-recompute."""
+    reqs = [Request(f"r{i}", _prompt(10 + i, 8), 20) for i in range(4)]
+    eng = _engine(model_and_params, num_blocks=13, **kw)
+    return eng, reqs
+
+
+# ------------------------------------------------------------------ histogram
+
+
+def test_streaming_histogram_percentiles():
+    h = StreamingHistogram()
+    assert h.percentile(50) is None and h.mean is None
+    values = [float(v) for v in range(1, 1001)]
+    for v in values:
+        h.add(v)
+    h.add(None)                         # ignored, not counted
+    assert h.count == 1000
+    for p in (50, 90, 95, 99):
+        exact = values[int(p / 100 * len(values)) - 1]
+        got = h.percentile(p)
+        assert got >= exact, (p, got, exact)      # never understates a tail
+        assert got <= exact * 1.07, (p, got, exact)
+    assert h.mean == pytest.approx(sum(values) / len(values))
+    assert set(h.percentiles((50, 99))) == {"p50", "p99"}
+
+
+# ----------------------------------------------------------- ledger lifecycle
+
+
+def test_tracer_disabled_by_default(model_and_params):
+    eng = _engine(model_and_params, trace=None)
+    assert eng.tracer is None
+    outs, _ = eng.run([Request("r0", _prompt(0, 11), 5)])
+    assert outs[0].status == "finished"         # untraced path still serves
+
+
+def test_ledger_covers_the_lifecycle(model_and_params):
+    reqs = [Request("g0", _prompt(1, 11), 5),
+            Request("g1", _prompt(2, 6), 4, arrival=2),
+            Request("b0", _prompt(3, 9), 4, num_beams=4)]
+    eng = _engine(model_and_params)
+    outs, _ = eng.run(reqs)
+    tr = eng.tracer
+    assert not tr.live and tr.finished == 3
+    recs = {r["req_id"]: r for r in tr.requests}
+    for req in reqs:
+        rec = recs[req.req_id]
+        names = [e[0] for e in rec["events"]]
+        assert names[0] == "submit" and names[-1] == "finish"
+        assert "admit" in names and "first_token" in names
+        # prefill chunks tile the prompt exactly, in order
+        chunks = [(e[3], e[4]) for e in rec["events"] if e[0] == "prefill"]
+        covered = 0
+        for pos, n in chunks:
+            assert pos == covered
+            covered += n
+        assert covered == len(req.prompt)
+        # one decode membership event per generated token after the first
+        n_decodes = sum(1 for e in rec["events"] if e[0] == "decode")
+        assert n_decodes == req.max_new_tokens - 1
+        assert rec["n_tokens"] == req.max_new_tokens
+        assert rec["e2e_iters"] == rec["finished_it"] - req.arrival
+        assert rec["queue_delay_iters"] >= 0
+        assert rec["ttft_ms"] > 0 and rec["e2e_ms"] >= rec["ttft_ms"]
+        assert rec["tpot_ms"] > 0
+    # the beam group records its CoW table fork with its lane count
+    forks = [e for e in recs["b0"]["events"] if e[0] == "fork"]
+    assert [e[3] for e in forks] == [4]
+    assert not [e for e in recs["g0"]["events"] if e[0] == "fork"]
+    # latency percentile API exposes every populated metric
+    pcts = tr.percentiles(ps=(50, 95, 99))
+    for metric in ("ttft_ms", "tpot_ms", "queue_delay_ms", "e2e_ms"):
+        assert set(pcts[metric]) == {"p50", "p95", "p99"}, metric
+
+
+def test_capacity_bounds_the_rings(model_and_params):
+    reqs = [Request(f"r{i}", _prompt(i, 5), 2) for i in range(5)]
+    eng = _engine(model_and_params, trace={"enabled": True, "capacity": 2,
+                                           "iteration_capacity": 3})
+    eng.run(reqs)
+    tr = eng.tracer
+    assert len(tr.requests) == 2 and tr.finished == 5   # ring bounded, counts not
+    assert len(tr.iterations) == 3
+
+
+def test_refusal_recorded(model_and_params):
+    eng = _engine(model_and_params)
+    out = eng.submit(Request("huge", _prompt(0, ML), ML))
+    assert out.status == "refused"
+    rec = eng.tracer.requests[-1]
+    assert rec["req_id"] == "huge" and rec["status"] == "refused"
+    ev = [e for e in rec["events"] if e[0] == "refused"]
+    assert len(ev) == 1 and "max_model_len" in ev[0][3]
+    assert eng.tracer.refused == 1
+
+
+# ------------------------------------------------------- TTFT single-sourcing
+
+
+def test_ttft_single_source_regression(model_and_params):
+    """Satellite: RequestOutput's ttft fields and the ledger record must be
+    THE SAME numbers (both read one on_first_token computation), and the
+    iteration-domain values must match an untraced engine's independent
+    bookkeeping on the same seeded trace."""
+    def mk():
+        return [Request(f"r{i}", _prompt(20 + i, 7 + i), 4 + i,
+                        arrival=i) for i in range(4)]
+    eng = _engine(model_and_params)
+    outs, _ = eng.run(mk())
+    recs = {r["req_id"]: r for r in eng.tracer.requests}
+    for o in outs:
+        rec = recs[o.req_id]
+        assert o.ttft_ms == rec["ttft_ms"]
+        assert o.ttft_iters == rec["ttft_iters"]
+        assert o.finished_it == rec["finished_it"]
+        assert o.preemptions == rec["preemptions"]
+    eng_off = _engine(model_and_params, trace=None)
+    outs_off, _ = eng_off.run(mk())
+    assert [o.ttft_iters for o in outs] == [o.ttft_iters for o in outs_off]
+    assert [o.finished_it for o in outs] == [o.finished_it for o in outs_off]
+
+
+# ------------------------------------------------------------ waste accounting
+
+
+def test_preemption_waste_sums_exactly(model_and_params):
+    """Acceptance: the useful/replayed split covers every scheduled token with
+    no residue, decode-phase preemptions bill their recompute as replay, and
+    the evicted-block counts ride the preempt events."""
+    eng, reqs = _starved(model_and_params)
+    outs, logs = eng.run(reqs)
+    tr = eng.tracer
+    assert sum(o.preemptions for o in outs) > 0
+    ws = tr.waste_summary()
+    sched_prefill = sum(l["prefill"][2] for l in logs if l["prefill"])
+    sched_decode = sum(len(l["decode"]) for l in logs)
+    assert ws["prefill_tokens"] == sched_prefill
+    assert ws["decode_tokens"] == sched_decode
+    assert ws["useful_tokens"] + ws["replayed_tokens"] == ws["scheduled_tokens"]
+    assert ws["scheduled_tokens"] == sched_prefill + sched_decode
+    assert ws["replayed_tokens"] > 0 and 0.0 < ws["waste_fraction"] < 1.0
+    # useful decode work = every kept token except the prefill-sampled first
+    assert (ws["decode_tokens"] - ws["decode_replayed"]
+            == sum(len(o.tokens) - 1 for o in outs))
+    # useful prefill work = each prompt exactly once
+    assert (ws["prefill_tokens"] - ws["prefill_replayed"]
+            == sum(len(r.prompt) for r in reqs))
+    evicted = [e[3] for r in tr.requests for e in r["events"]
+               if e[0] == "preempt"]
+    assert evicted and all(n > 0 for n in evicted)
+    # per-iteration timeline agrees with the global totals
+    its = list(tr.iterations)
+    assert sum(i["prefill"][0] + i["prefill"][1] for i in its) == sched_prefill
+    assert sum(i["decode"][0] + i["decode"][1] for i in its) == sched_decode
+    for i in its:
+        pool = i["pool"]
+        assert pool["free"] + pool["used"] == eng.num_blocks - 1
+        assert 0.0 <= pool["frag"] <= 1.0
+
+
+def test_pool_timeline_tracks_allocator_counters(model_and_params):
+    eng = _engine(model_and_params)
+    eng.run([Request("b0", _prompt(5, 9), 6, num_beams=4)])
+    alloc = eng.scheduler.allocator
+    assert alloc.fork_count > 0                 # beam table forks happened
+    assert alloc.alloc_count >= alloc.free_count
+    st = alloc.stats()
+    assert st["cow_copies"] == alloc.cow_copies
+    last_pool = list(eng.tracer.iterations)[-1]["pool"]
+    assert last_pool["cow_copies"] == alloc.cow_copies
+
+
+# -------------------------------------------------------------------- the SLO
+
+
+def test_slo_classification(model_and_params):
+    reqs = [Request(f"r{i}", _prompt(i, 6), 3) for i in range(3)]
+    eng = _engine(model_and_params,
+                  trace={"enabled": True, "slo": {"ttft_ms": 1e-6}})
+    eng.run(reqs)
+    s = eng.tracer.slo_summary()
+    assert s["configured"] == {"ttft_ms": 1e-6}
+    assert s["violated"] == 3 and s["met"] == 0 and s["attainment"] == 0.0
+    assert all(r["slo_violations"] == ["ttft_ms"]
+               for r in eng.tracer.requests)
+
+    eng2 = _engine(model_and_params,
+                   trace={"enabled": True, "slo": {"ttft_ms": 1e9,
+                                                   "tpot_ms": 1e9}})
+    eng2.run([Request(f"r{i}", _prompt(i, 6), 3) for i in range(3)])
+    s2 = eng2.tracer.slo_summary()
+    assert s2["met"] == 3 and s2["violated"] == 0 and s2["attainment"] == 1.0
+
+    # 0-valued thresholds mean "not gated", not "always violated"
+    eng3 = _engine(model_and_params,
+                   trace={"enabled": True, "slo": {"ttft_ms": 0.0}})
+    eng3.run([Request("r0", _prompt(0, 6), 3)])
+    assert eng3.tracer.slo_summary()["configured"] == {}
+    assert eng3.tracer.slo_summary()["attainment"] is None
+
+
+# -------------------------------------------------------- telemetry + scalars
+
+
+def test_latency_scalars_flow_through_telemetry(tmp_path, model_and_params):
+    session = TelemetrySession(output_path=str(tmp_path), job_name="rt_test")
+    model, params = model_and_params
+    eng = InferenceEngine(model, params, num_slots=4, block_size=4,
+                          num_blocks=33, max_model_len=ML, prefill_chunk=8,
+                          telemetry=session, request_trace={"enabled": True})
+    eng.run([Request(f"r{i}", _prompt(i, 7), 4) for i in range(3)])
+    session.close()
+    scalars = open(os.path.join(str(tmp_path), "rt_test",
+                                "scalars.jsonl")).read()
+    for name in ("Serving/Latency/ttft_ms_p50", "Serving/Latency/ttft_ms_p99",
+                 "Serving/Latency/tpot_ms_p90",
+                 "Serving/Latency/queue_delay_ms_p50",
+                 "Serving/Latency/e2e_ms_p50",
+                 "Serving/Waste/replayed_tokens", "Serving/Waste/fraction",
+                 "Serving/Pool/fragmentation"):
+        assert name in scalars, name
+
+
+# --------------------------------------------------------------- HLO identity
+
+
+def test_hlo_identical_and_zero_recompiles_when_toggled(tmp_path,
+                                                        model_and_params):
+    """Acceptance: the trace block changes NOTHING on device — decode/prefill/
+    beam programs of a traced engine are instruction-identical to an untraced
+    one, and a traced run recompiles nothing after warmup (watchdog)."""
+    model, params = model_and_params
+    eng_off = _engine(model_and_params, trace=None)
+    eng_on = _engine(model_and_params)
+    S, MB, C = eng_off.num_slots, eng_off.max_blocks, eng_off.prefill_chunk
+    zs = jnp.zeros(S, jnp.int32)
+    decode_args = (params, zs, zs, jnp.zeros((S, MB), jnp.int32),
+                   jnp.zeros(S, bool), eng_off.k_pool, eng_off.v_pool)
+    prefill_args = (params, jnp.zeros((1, C), jnp.int32), jnp.int32(0),
+                    jnp.int32(1), jnp.zeros(MB, jnp.int32),
+                    eng_off.k_pool, eng_off.v_pool)
+    for name, a_fn, b_fn, fargs in (
+            ("decode", eng_off._raw["decode_step"],
+             eng_on._raw["decode_step"], decode_args),
+            ("prefill", eng_off._raw["prefill_chunk"],
+             eng_on._raw["prefill_chunk"], prefill_args)):
+        h_off = optimized_hlo(a_fn, *fargs)
+        h_on = optimized_hlo(b_fn, *fargs)
+        assert instruction_count(h_off) > 0
+        assert instruction_count(h_off) == instruction_count(h_on), name
+    beam_off = eng_off._raw["beam_init"](4, -1)
+    beam_on = eng_on._raw["beam_init"](4, -1)
+    logits = jnp.zeros((1, model.config.vocab_size), jnp.float32)
+    assert (instruction_count(optimized_hlo(beam_off, logits))
+            == instruction_count(optimized_hlo(beam_on, logits))), "beam"
+
+    session = TelemetrySession(output_path=str(tmp_path), job_name="rt_watch")
+    eng_w = _engine(model_and_params, telemetry=session)
+    eng_w.run([Request(f"r{i}", _prompt(i, 9), 5) for i in range(4)]
+              + [Request("b0", _prompt(9, 9), 4, num_beams=2)])
+    for prog in session.watchdog.records:
+        if prog.startswith("serve:"):
+            assert session.watchdog.recompiles(prog) == 0, prog
+    session.close()
+
+
+def test_request_trace_module_is_stdlib_pure():
+    """The ledger must never be able to block the device: no numpy, no jax —
+    only stdlib — so the HostSyncPass sweep (test_no_sync_guard) covers every
+    primitive it could possibly call."""
+    path = os.path.join(REPO, "deepspeed_tpu", "serve", "request_trace.py")
+    tree = ast.parse(open(path).read())
+    mods = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            mods.update(a.name.split(".")[0] for a in node.names)
+        elif isinstance(node, ast.ImportFrom) and node.level == 0:
+            mods.add((node.module or "").split(".")[0])
+    assert "numpy" not in mods and "jax" not in mods, sorted(mods)
+
+
+# ------------------------------------------------- flight recorder embedding
+
+
+def test_flight_recorder_embeds_ledger(tmp_path, model_and_params):
+    from deepspeed_tpu.utils.numerics import FlightRecorder
+
+    eng = _engine(model_and_params)
+    eng.run([Request("r0", _prompt(0, 9), 4)])
+    rec = FlightRecorder(dump_dir=str(tmp_path), request_trace=eng.tracer)
+    path = rec.trigger("manual_test")
+    bundle = json.load(open(path))
+    embedded = bundle["serving_request_trace"]
+    assert embedded["kind"] == "serving_request_trace"
+    assert embedded["counts"]["finished"] == 1
+    # serve-timeline resolves the flight-recorder dump directly
+    out = os.path.join(str(tmp_path), "dump.trace.json")
+    assert serve_timeline_main([path, "-o", out]) == 0
+    trace = json.load(open(out))
+    assert any(e["ph"] == "X" for e in trace["traceEvents"])
+
+
+# ------------------------------------------------------------ Perfetto export
+
+
+@pytest.fixture(scope="module")
+def seeded_64_artifacts(tmp_path_factory):
+    """One seeded default 64-request serve-sim run shared by the golden and
+    report tests (the acceptance trace; ~10 s with the oracle off)."""
+    d = tmp_path_factory.mktemp("serve64")
+    ledger = os.path.join(str(d), "ledger.json")
+    report = os.path.join(str(d), "report.json")
+    rc = sim_main(["--no-mirror", "--dump-ledger", ledger,
+                   "--json", report, "--output", os.path.join(str(d), "tel")])
+    assert rc == 0
+    return ledger, report
+
+
+def test_perfetto_export_matches_golden(seeded_64_artifacts):
+    """Acceptance: the seeded 64-request serve-sim trace exports to Perfetto
+    JSON byte-for-byte equal to the committed golden file."""
+    ledger, _ = seeded_64_artifacts
+    bundle = json.load(open(ledger))
+    data = serialize_trace(to_serve_trace_events(bundle))
+    assert data == serialize_trace(to_serve_trace_events(bundle))  # stable
+    golden = open(GOLDEN).read()
+    assert data == golden
+    trace = json.loads(data)
+    tids = {e["tid"] for e in trace["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert len(tids) == 64                       # one track per request
+    counters = {e["name"] for e in trace["traceEvents"] if e["ph"] == "C"}
+    assert {"pool occupancy", "waiting queue", "waste fraction",
+            "free blocks", "pool fragmentation"} <= counters
+    cats = {e.get("cat") for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert {"queued", "prefill", "decode"} <= cats
+
+
+def test_serve_sim_json_report(seeded_64_artifacts):
+    ledger, report = seeded_64_artifacts
+    rep = json.load(open(report))
+    assert rep["kind"] == "serve_sim_report" and not rep["failures"]
+    det = rep["deterministic"]
+    assert det["n_finished"] == 64 and len(det["requests"]) == 64
+    w = det["waste"]
+    assert w["useful_tokens"] + w["replayed_tokens"] == w["scheduled_tokens"]
+    for row in det["requests"]:
+        assert row["status"] == "finished"
+        assert row["ttft_iters"] >= 0 and row["e2e_iters"] >= row["ttft_iters"]
+    assert "percentiles" in rep["wall"] and "slo" in rep["wall"]
+
+
+def test_serve_sim_json_deterministic_subtree(tmp_path):
+    """The report's `deterministic` subtree is byte-stable across fresh runs
+    (CI diffs it, mirroring `ds-tpu lint --json`)."""
+    blobs = []
+    for i in range(2):
+        p = os.path.join(str(tmp_path), f"rep{i}.json")
+        rc = sim_main(["--requests", "12", "--max-model-len", "64",
+                       "--block-size", "8", "--num-blocks", "33",
+                       "--slots", "4", "--prefill-chunk", "16", "--no-mirror",
+                       "--json", p,
+                       "--output", os.path.join(str(tmp_path), f"tel{i}")])
+        assert rc == 0
+        blobs.append(json.dumps(json.load(open(p))["deterministic"],
+                                sort_keys=True))
+    assert blobs[0] == blobs[1]
+
+
+def test_serve_sim_slo_gate_fails_nonzero(tmp_path, capsys):
+    """Acceptance: a configured-but-violated SLO exits serve-sim nonzero."""
+    rc = sim_main(["--requests", "6", "--max-model-len", "64",
+                   "--block-size", "8", "--num-blocks", "33", "--slots", "4",
+                   "--prefill-chunk", "16", "--no-mirror",
+                   "--slo-ttft-ms", "1e-6",
+                   "--output", os.path.join(str(tmp_path), "tel")])
+    assert rc == 1
+    assert "SLO violated" in capsys.readouterr().err
+
+
+def test_serve_timeline_cli_subprocess(tmp_path, model_and_params):
+    """The shipped `ds-tpu serve-timeline` entry converts a dumped ledger end
+    to end (pure-host dispatch — no accelerator pinning needed)."""
+    eng = _engine(model_and_params)
+    eng.run([Request(f"r{i}", _prompt(i, 7), 4) for i in range(3)])
+    path = os.path.join(str(tmp_path), "ledger.json")
+    eng.tracer.dump(path)
+    env = dict(os.environ,
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds-tpu"),
+         "serve-timeline", path],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "trace events" in proc.stdout
+    trace = json.load(open(path[:-5] + ".trace.json"))
+    assert trace["otherData"]["generator"] == "ds-tpu serve-timeline"
+    assert trace["traceEvents"]
+
+
+def test_serve_timeline_rejects_traceless_input(tmp_path, capsys):
+    path = os.path.join(str(tmp_path), "not_a_bundle.json")
+    json.dump({"steps": [], "kind": "something_else"}, open(path, "w"))
+    assert serve_timeline_main([path]) == 2
+    assert "no serving_request_trace bundle" in capsys.readouterr().out
+
+
+def test_dump_and_atexit_path(tmp_path, model_and_params):
+    eng = _engine(model_and_params,
+                  trace={"enabled": True, "dump_dir": str(tmp_path)})
+    eng.run([Request("r0", _prompt(0, 9), 4)])
+    path = eng.tracer.dump()
+    assert path == os.path.join(str(tmp_path), "request_trace_host0.json")
+    bundle = json.load(open(path))
+    assert bundle["kind"] == "serving_request_trace"
+    assert len(bundle["requests"]) == 1

@@ -46,6 +46,22 @@ def test_serving_perf_driver_stays_out_of_tier1():
         "on the CPU mesh")
 
 
+def test_request_trace_suite_is_collectable_and_golden_pinned():
+    """The serving observatory's acceptance tests live INSIDE tier-1 (CPU-only,
+    seconds of wall clock), so the suite file must match a collectable name and
+    its byte-for-byte golden must ship next to the pipeline-trace goldens."""
+    unit = REPO / "tests" / "unit"
+    assert (unit / "test_request_trace.py").exists()
+    golden = unit / "golden" / "serve_timeline_64.trace.json"
+    assert golden.exists(), "serve-timeline golden missing — regenerate with " \
+        "`ds-tpu serve-sim --no-mirror --dump-ledger L.json && " \
+        "ds-tpu serve-timeline L.json -o <golden>`"
+    import json
+    trace = json.loads(golden.read_text())
+    assert trace["otherData"]["generator"] == "ds-tpu serve-timeline"
+    assert len(trace["traceEvents"]) > 1000    # a real 64-request timeline
+
+
 def test_perf_directory_has_no_conftest_collection_override():
     """A conftest.py in tests/perf/ could re-add collection via collect_ignore
     tricks or python_files overrides; keep the directory plugin-free."""
