@@ -11,6 +11,8 @@ measured MFU / 0.40 (the north-star >=40% MFU). v5e-lite peak ~197 TFLOP/s bf16.
 extra:
 - gpt2_420m_*: the round-1 flagship config (real DeepSpeedEngine, ZeRO-2, dp=1) for
   round-over-round continuity.
+- regression_vs_previous_round: this run's tok/s numbers vs the newest parseable
+  BENCH_r*.json, >5% drops flagged by name (advisory).
 - max_trainable_params_per_chip_zero_offload: largest GPT-2 (1600 wide, deepening
   n_layer) whose ZeRO-Offload HBM footprint — bf16 params + bf16 grads + remat
   activations; master/moments live in host DRAM — completes fwd+bwd on the chip
@@ -36,6 +38,76 @@ PEAK_TFLOPS = 197.0
 def _fence(x):
     import jax
     return float(jax.device_get(x))
+
+
+def _previous_round():
+    """(round_file, bench_json) from the newest BENCH_r*.json whose driver tail
+    still contains a parseable bench line — a truncated tail (r05) falls back to
+    the next-newest round rather than killing the comparison."""
+    import glob
+    here = os.path.dirname(os.path.abspath(__file__))
+    for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json")),
+                       reverse=True):
+        try:
+            with open(path) as f:
+                tail = json.load(f).get("tail", "")
+        except (OSError, ValueError):
+            continue
+        for line in tail.splitlines():
+            s = line.strip()
+            if s.startswith('{"metric"'):
+                try:
+                    return os.path.basename(path), json.loads(s)
+                except ValueError:
+                    pass
+    return None, None
+
+
+def _dig(d, dotted):
+    for k in dotted.split("."):
+        if not isinstance(d, dict) or k not in d:
+            return None
+        d = d[k]
+    return d if isinstance(d, (int, float)) and not isinstance(d, bool) else None
+
+
+# round-over-round throughput ledger: headline + the per-block tok/s numbers
+# a round may silently regress while the headline holds
+REGRESSION_KEYS = (
+    "value",
+    "extra.gpt2_420m_tokens_per_sec_per_chip",
+    "extra.gpt2_1p5b_engine_tokens_per_sec",
+    "extra.decode_420m.greedy_tok_s",
+    "extra.serving_420m.tok_s",
+    "extra.serving_420m.goodput_tok_s",
+)
+
+
+def regression_vs_previous_round(current, threshold_pct=5.0):
+    """Compare this run's throughput numbers against the newest prior BENCH
+    round; any metric more than ``threshold_pct`` below its predecessor is
+    flagged by name. Purely advisory (the bench never fails on it) — the flags
+    ride the JSON so the driver and PERF.md see the drop next to the number."""
+    rnd, prev = _previous_round()
+    if prev is None:
+        return {"baseline_round": None,
+                "note": "no parseable prior BENCH_r*.json"}
+    if prev.get("metric") != current.get("metric"):
+        return {"baseline_round": rnd, "note": "metric changed "
+                f"({prev.get('metric')} -> {current.get('metric')}); skipped"}
+    out = {"baseline_round": rnd, "threshold_pct": threshold_pct,
+           "metrics": {}, "regressed": []}
+    for key in REGRESSION_KEYS:
+        was, now = _dig(prev, key), _dig(current, key)
+        if was is None or now is None or was <= 0:
+            continue
+        delta = 100.0 * (now - was) / was
+        row = {"prev": was, "cur": now, "delta_pct": round(delta, 2)}
+        if delta < -threshold_pct:
+            row["regressed"] = True
+            out["regressed"].append(key)
+        out["metrics"][key] = row
+    return out
 
 
 def bench_420m():
@@ -124,7 +196,12 @@ def _telemetry_probe_420m(model, cfg, mesh, batch, tokens, labels, steps=8):
                                 "telemetry": {"enabled": True,
                                               "peak_tflops": PEAK_TFLOPS,
                                               "mfu_window": steps,
-                                              "output_path": tel_dir},
+                                              "output_path": tel_dir,
+                                              # chip auto-detected from device_kind;
+                                              # summary()["anatomy"] then carries the
+                                              # roofline floor + MFU ceiling beside
+                                              # the measured MFU (docs/anatomy.md)
+                                              "anatomy": {"enabled": True}},
                                 "numerics": {"enabled": True,
                                              "audit_interval": 4},
                             })
@@ -894,7 +971,14 @@ def main():
                                                 "telemetry": {"enabled": True,
                                                               "peak_tflops": PEAK_TFLOPS,
                                                               "output_path": tempfile.mkdtemp(
-                                                                  prefix="ds_bench_telemetry_")},
+                                                                  prefix="ds_bench_telemetry_"),
+                                                              # anatomy prices the same
+                                                              # PEAK_TFLOPS so the MFU
+                                                              # ceiling is comparable to
+                                                              # the measured MFU below
+                                                              "anatomy": {"enabled": True,
+                                                                          "chip": "cpu-test",
+                                                                          "peak_tflops": PEAK_TFLOPS}},
                                                 "numerics": {"enabled": True,
                                                              "audit_interval": 2}})
         rng = np.random.default_rng(0)
@@ -906,6 +990,15 @@ def main():
             engine.step()
         _fence(loss)
         tps = B * 64 * 3 / (time.time() - t0)
+        # two post-window steps: the timed window above pays the compiles
+        # (warmup + donated-layout recompile + the audit program), so the
+        # rolling MFU and the anatomy attribution — both of which only record
+        # compile-free steps — need clean steps to have anything to report
+        for _ in range(2):
+            loss = engine(tokens, np.roll(tokens, -1, axis=1))
+            engine.backward(loss)
+            engine.step()
+        _fence(loss)
         telemetry = engine.telemetry.summary()
         numerics = engine._numerics.summary() if engine._numerics is not None else None
         engine.telemetry.close()
@@ -917,11 +1010,21 @@ def main():
             serving = bench_serving_smoke()
         except Exception as e:
             serving = {"error": f"{type(e).__name__}: {e}"}
-        print(json.dumps({"metric": "gpt2_tokens_per_sec_per_chip_cpu_smoke",
-                          "value": round(tps, 1), "unit": "tokens/s", "vs_baseline": 0.0,
-                          "extra": {"telemetry": telemetry, "numerics": numerics,
-                                    "pipeline_goodput": pipeline_goodput,
-                                    "serving": serving}}))
+        anatomy = telemetry.get("anatomy") or {}
+        result = {"metric": "gpt2_tokens_per_sec_per_chip_cpu_smoke",
+                  "value": round(tps, 1), "unit": "tokens/s", "vs_baseline": 0.0,
+                  "extra": {"telemetry": telemetry, "numerics": numerics,
+                            # measured MFU and its roofline ceiling side by side
+                            # (both priced at PEAK_TFLOPS; docs/anatomy.md)
+                            "mfu_measured": telemetry.get("mfu"),
+                            "mfu_ceiling": anatomy.get("mfu_ceiling"),
+                            "anatomy_predicted_floor_ms":
+                                anatomy.get("predicted_floor_ms"),
+                            "pipeline_goodput": pipeline_goodput,
+                            "serving": serving}}
+        result["extra"]["regression_vs_previous_round"] = \
+            regression_vs_previous_round(result)
+        print(json.dumps(result))
         return
 
     extra = bench_420m()
@@ -972,10 +1075,14 @@ def main():
     extra["max_trainable_params_per_chip_zero_offload"] = int(mp)
     if os.environ.get("DS_BENCH_SKIP_WORKLOADS", "0") != "1":
         extra["workloads"] = collect_workload_evidence()
-    print(json.dumps({"metric": "gpt2_1p5b_zero2_tokens_per_sec_per_chip",
-                      "value": round(tps, 1), "unit": "tokens/s",
-                      "vs_baseline": round(mfu / 0.40, 4),
-                      "extra": extra}))
+    result = {"metric": "gpt2_1p5b_zero2_tokens_per_sec_per_chip",
+              "value": round(tps, 1), "unit": "tokens/s",
+              "vs_baseline": round(mfu / 0.40, 4),
+              "extra": extra}
+    # round-over-round tok/s ledger vs the newest parseable BENCH_r*.json;
+    # >5% drops are flagged by metric name (advisory — see the JSON block)
+    extra["regression_vs_previous_round"] = regression_vs_previous_round(result)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -56,7 +56,7 @@ class ProgramArtifact:
     """Static capture of one jitted program: HLO + arg metadata + warnings."""
 
     def __init__(self, name, hlo_text, args_info, compile_warnings, memory_stats,
-                 manifest, lowered_text=None, platform=None):
+                 manifest, lowered_text=None, platform=None, cost_stats=None):
         self.name = name
         self.hlo_text = hlo_text            # optimized (post-backend) HLO
         self.lowered_text = lowered_text or hlo_text  # pre-backend HLO
@@ -64,6 +64,7 @@ class ProgramArtifact:
         self.args_info = args_info          # [(donated, shape, dtype_str)] flat
         self.compile_warnings = compile_warnings
         self.memory_stats = memory_stats    # dict or {}
+        self.cost_stats = dict(cost_stats or {})  # cost_analysis flops/bytes
         self.manifest = dict(manifest or {})
 
     @classmethod
@@ -93,9 +94,22 @@ class ProgramArtifact:
                     mem[field] = int(val)
         except Exception:
             pass
+        cost = {}
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            for key, field in (("flops", "flops"),
+                               ("bytes accessed", "bytes_accessed")):
+                val = (ca or {}).get(key)
+                if val is not None:
+                    cost[field] = float(val)
+        except Exception:
+            pass
         return cls(name, compiled.as_text(),
                    info, [str(w.message) for w in caught], mem, manifest,
-                   lowered_text=lowered_text, platform=jax.default_backend())
+                   lowered_text=lowered_text, platform=jax.default_backend(),
+                   cost_stats=cost)
 
 
 # jnp dtype name -> HLO element type string

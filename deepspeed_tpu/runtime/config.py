@@ -272,6 +272,26 @@ class DeepSpeedConfig:
                 f"int >= 1, got {cap!r}")
         self.pipeline_trace_dump_dir = get_scalar_param(pt_dict, PIPELINE_TRACE_DUMP_DIR,
                                                         PIPELINE_TRACE_DUMP_DIR_DEFAULT)
+        an_dict = tel_dict.get(TELEMETRY_ANATOMY, {}) or {}
+        self._warn_unknown_nested(f"{TELEMETRY}.{TELEMETRY_ANATOMY}",
+                                  an_dict, ANATOMY_CONFIG_KEYS)
+        self.telemetry_anatomy_enabled = get_scalar_param(an_dict, ANATOMY_ENABLED,
+                                                          ANATOMY_ENABLED_DEFAULT)
+        self.telemetry_anatomy_chip = get_scalar_param(an_dict, ANATOMY_CHIP, ANATOMY_CHIP_DEFAULT)
+        for attr, key, default in (("telemetry_anatomy_peak_tflops", ANATOMY_PEAK_TFLOPS,
+                                    ANATOMY_PEAK_TFLOPS_DEFAULT),
+                                   ("telemetry_anatomy_hbm_gbps", ANATOMY_HBM_GBPS,
+                                    ANATOMY_HBM_GBPS_DEFAULT),
+                                   ("telemetry_anatomy_ici_gbps", ANATOMY_ICI_GBPS,
+                                    ANATOMY_ICI_GBPS_DEFAULT),
+                                   ("telemetry_anatomy_dcn_gbps", ANATOMY_DCN_GBPS,
+                                    ANATOMY_DCN_GBPS_DEFAULT)):
+            val = get_scalar_param(an_dict, key, default)
+            if isinstance(val, bool) or not isinstance(val, (int, float)) or val < 0:
+                raise ValueError(
+                    f"DeepSpeedConfig: telemetry.anatomy.{key} must be a "
+                    f"number >= 0 (0 = use the chip table value), got {val!r}")
+            setattr(self, attr, float(val))
 
         num_dict = param_dict.get(NUMERICS, {})
         self._warn_unknown_nested(NUMERICS, num_dict, NUMERICS_CONFIG_KEYS)

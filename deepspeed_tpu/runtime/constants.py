@@ -187,6 +187,25 @@ TELEMETRY_OUTPUT_PATH_DEFAULT = ""
 TELEMETRY_JOB_NAME = "job_name"
 TELEMETRY_JOB_NAME_DEFAULT = "DeepSpeedTelemetry"
 
+# telemetry.anatomy sub-block: the step-time anatomy — per-program roofline
+# ledger + async-overlap analysis over the watchdog's AOT artifacts, emitted
+# as Anatomy/* scalars (docs/anatomy.md). chip "" auto-detects; the rate
+# overrides (0 = keep the chip table value) let one machine be priced as
+# another.
+TELEMETRY_ANATOMY = "anatomy"
+ANATOMY_ENABLED = "enabled"
+ANATOMY_ENABLED_DEFAULT = False
+ANATOMY_CHIP = "chip"
+ANATOMY_CHIP_DEFAULT = ""
+ANATOMY_PEAK_TFLOPS = "peak_tflops"
+ANATOMY_PEAK_TFLOPS_DEFAULT = 0.0
+ANATOMY_HBM_GBPS = "hbm_gbps"
+ANATOMY_HBM_GBPS_DEFAULT = 0.0
+ANATOMY_ICI_GBPS = "ici_gbps"
+ANATOMY_ICI_GBPS_DEFAULT = 0.0
+ANATOMY_DCN_GBPS = "dcn_gbps"
+ANATOMY_DCN_GBPS_DEFAULT = 0.0
+
 # telemetry.pipeline_trace sub-block: per-instruction span timeline for the
 # pipeline instruction executor (docs/pipeline-trace.md)
 TELEMETRY_PIPELINE_TRACE = "pipeline_trace"
@@ -426,6 +445,16 @@ TELEMETRY_CONFIG_KEYS = frozenset({
     TELEMETRY_OUTPUT_PATH,
     TELEMETRY_JOB_NAME,
     TELEMETRY_PIPELINE_TRACE,
+    TELEMETRY_ANATOMY,
+})
+
+ANATOMY_CONFIG_KEYS = frozenset({
+    ANATOMY_ENABLED,
+    ANATOMY_CHIP,
+    ANATOMY_PEAK_TFLOPS,
+    ANATOMY_HBM_GBPS,
+    ANATOMY_ICI_GBPS,
+    ANATOMY_DCN_GBPS,
 })
 
 PIPELINE_TRACE_CONFIG_KEYS = frozenset({

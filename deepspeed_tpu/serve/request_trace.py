@@ -42,6 +42,11 @@ import os
 import time
 from collections import deque
 
+from ..utils.trace_event import (complete_slice, counter_event, instant_event,
+                                 load_bundle, process_name_event,
+                                 serialize_trace, thread_meta_events,
+                                 trace_envelope)
+
 REQUEST_TRACE_VERSION = 1
 SERVE_TRACE_KIND = "serving_request_trace"
 
@@ -370,12 +375,8 @@ _CAT_COLORS = {
 
 
 def _slice(tid, ts, dur, name, cat, args):
-    ev = {"ph": "X", "pid": 0, "tid": tid, "ts": ts, "dur": max(dur, 1),
-          "cat": cat, "name": name, "args": args}
-    color = _CAT_COLORS.get(cat)
-    if color:
-        ev["cname"] = color
-    return ev
+    return complete_slice(0, tid, ts, dur, name, cat, args,
+                          cname=_CAT_COLORS.get(cat))
 
 
 def to_serve_trace_events(bundle, us_per_iter=1000):
@@ -390,8 +391,7 @@ def to_serve_trace_events(bundle, us_per_iter=1000):
     deterministic trace (the golden-file contract), unlike the wall-clock
     ``*_us`` fields the bundle also carries for human inspection."""
     U = int(us_per_iter)
-    events = [{"ph": "M", "pid": 0, "tid": 0, "name": "process_name",
-               "args": {"name": f"serving host {bundle.get('host', 0)}"}}]
+    events = [process_name_event(0, f"serving host {bundle.get('host', 0)}")]
     records = sorted(list(bundle.get("requests", []))
                      + list(bundle.get("live", [])),
                      key=lambda r: (r["arrival"], r["req_id"]))
@@ -401,11 +401,7 @@ def to_serve_trace_events(bundle, us_per_iter=1000):
 
     for i, rec in enumerate(records):
         tid = i + 1
-        events.append({"ph": "M", "pid": 0, "tid": tid, "name": "thread_name",
-                       "args": {"name": rec["req_id"]}})
-        events.append({"ph": "M", "pid": 0, "tid": tid,
-                       "name": "thread_sort_index",
-                       "args": {"sort_index": tid}})
+        events += thread_meta_events(0, tid, rec["req_id"], sort_index=tid)
         queued_since = rec["arrival"]
         run = None          # open decode run: [start_it, end_it, toks, replay]
 
@@ -449,22 +445,19 @@ def to_serve_trace_events(bundle, us_per_iter=1000):
                 run[2] += lanes
                 run[3] += replayed
             elif name == EV_PREEMPT:
-                events.append({"ph": "i", "pid": 0, "tid": tid, "ts": it * U,
-                               "s": "t", "name": "preempt",
-                               "args": {"evicted_blocks": ev[3]}})
+                events.append(instant_event(0, tid, it * U, "preempt",
+                                            {"evicted_blocks": ev[3]}))
                 queued_since = it
             elif name == EV_FIRST_TOKEN:
-                events.append({"ph": "i", "pid": 0, "tid": tid, "ts": it * U,
-                               "s": "t", "name": "first_token",
-                               "args": {"ttft_iters": rec.get("ttft_iters")}})
+                events.append(instant_event(
+                    0, tid, it * U, "first_token",
+                    {"ttft_iters": rec.get("ttft_iters")}))
             elif name == EV_FINISH:
-                events.append({"ph": "i", "pid": 0, "tid": tid, "ts": it * U,
-                               "s": "t", "name": "finish",
-                               "args": {"n_tokens": ev[3]}})
+                events.append(instant_event(0, tid, it * U, "finish",
+                                            {"n_tokens": ev[3]}))
             elif name == EV_REFUSED:
-                events.append({"ph": "i", "pid": 0, "tid": tid,
-                               "ts": ts_of(it, rec["arrival"]), "s": "t",
-                               "name": "refused", "args": {"reason": ev[3]}})
+                events.append(instant_event(0, tid, ts_of(it, rec["arrival"]),
+                                            "refused", {"reason": ev[3]}))
         flush_run()
 
     sched_tokens = 0
@@ -474,51 +467,35 @@ def to_serve_trace_events(bundle, us_per_iter=1000):
         pool = itrec.get("pool") or {}
         used, free = pool.get("used", 0), pool.get("free", 0)
         occ = used / (used + free) if (used + free) else 0.0
-        events.append({"ph": "C", "pid": 0, "tid": 0, "ts": ts,
-                       "name": "pool occupancy",
-                       "args": {"occupancy": round(occ, 6)}})
+        events.append(counter_event(0, 0, ts, "pool occupancy",
+                                    {"occupancy": round(occ, 6)}))
         if "frag" in pool:
-            events.append({"ph": "C", "pid": 0, "tid": 0, "ts": ts,
-                           "name": "pool fragmentation",
-                           "args": {"fragmentation": round(pool["frag"], 6)}})
-        events.append({"ph": "C", "pid": 0, "tid": 0, "ts": ts,
-                       "name": "waiting queue",
-                       "args": {"waiting": itrec.get("waiting", 0)}})
-        events.append({"ph": "C", "pid": 0, "tid": 0, "ts": ts,
-                       "name": "free blocks", "args": {"free": free}})
+            events.append(counter_event(0, 0, ts, "pool fragmentation",
+                                        {"fragmentation": round(pool["frag"], 6)}))
+        events.append(counter_event(0, 0, ts, "waiting queue",
+                                    {"waiting": itrec.get("waiting", 0)}))
+        events.append(counter_event(0, 0, ts, "free blocks", {"free": free}))
         sched_tokens += sum(itrec["prefill"]) + sum(itrec["decode"])
         replayed_tokens += itrec["prefill"][1] + itrec["decode"][1]
         waste = replayed_tokens / sched_tokens if sched_tokens else 0.0
-        events.append({"ph": "C", "pid": 0, "tid": 0, "ts": ts,
-                       "name": "waste fraction",
-                       "args": {"waste": round(waste, 6)}})
-    return {"traceEvents": events, "displayTimeUnit": "ms",
-            "otherData": {"generator": "ds-tpu serve-timeline",
-                          "requests": len(records),
-                          "us_per_iter": U,
-                          "trace_version": bundle.get("version")}}
+        events.append(counter_event(0, 0, ts, "waste fraction",
+                                    {"waste": round(waste, 6)}))
+    return trace_envelope(events, "ds-tpu serve-timeline",
+                          requests=len(records), us_per_iter=U,
+                          trace_version=bundle.get("version"))
 
 
 # --------------------------------------------------------------------- the CLI
 
 
 def _load_bundle(path):
-    with open(path) as f:
-        data = json.load(f)
-    if data.get("kind") == SERVE_TRACE_KIND:
-        return data
-    # flight-recorder dump with an embedded request-trace bundle
-    embedded = data.get(SERVE_TRACE_KIND)
-    if isinstance(embedded, dict) and embedded.get("kind") == SERVE_TRACE_KIND:
-        return embedded
-    return None
+    # flight-recorder dumps embed the request-trace bundle under its kind key
+    return load_bundle(path, SERVE_TRACE_KIND)
 
 
 def serve_timeline_main(argv=None):
     """``ds-tpu serve-timeline`` entry point: request-trace ledger bundle (or
     a flight-recorder dump embedding one) -> Perfetto/Chrome trace_event JSON."""
-    from ..utils.pipeline_trace import serialize_trace
-
     parser = argparse.ArgumentParser(
         prog="ds-tpu serve-timeline",
         description="Convert a serving request_trace ledger bundle (or a "

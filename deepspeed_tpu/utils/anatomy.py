@@ -1,0 +1,404 @@
+"""Step-time anatomy: roofline ledger + async-overlap analysis per program.
+
+Everything here is static analysis over artifacts the compile watchdog (or the
+lint registry) already captures — optimized HLO text, ``cost_analysis`` flops,
+``bytes accessed`` — so the analyzer adds zero device syncs and runs identically
+on a laptop reading a saved artifact or inside ``TelemetrySession.end_step``.
+
+Per program the analysis has two halves:
+
+* **Overlap**: post-scheduling HLO splits each overlappable collective into a
+  ``-start``/``-done`` pair (``hlo.parse_async_pairs``); every instruction the
+  scheduler placed inside the window runs concurrently with the wire. The
+  window's hiding capacity is priced as ``max(window flops / peak, window
+  bytes / HBM bw)`` and whatever the wire time exceeds it by is **exposed**.
+  A synchronous collective (the only kind the CPU backend emits) hides
+  nothing — fully exposed, flagged ``zero_overlap``.
+* **Roofline** (utils/roofline.py): compute and HBM floors from the cost
+  analysis, plus the exposed-comm seconds split ICI/DCN by the same
+  slice-membership rule as ``hlo.collective_axis_bytes`` — together the
+  predicted step floor and the MFU ceiling the program structure permits.
+
+``ds-tpu anatomy`` runs the analysis over the full lint registry on the
+8-virtual-device CPU mesh, emits a deterministic ``--json`` report, an
+optional predicted-schedule Perfetto timeline, named zero-overlap
+optimization opportunities, and the golden-pinned flat-vs-hierarchical
+comm comparison (exposed-DCN must drop under the two-level exchange).
+"""
+
+import argparse
+import json
+import sys
+
+from . import hlo
+from .roofline import resolve_spec, roofline
+from .trace_event import (complete_slice, process_name_event, serialize_trace,
+                          thread_meta_events, trace_envelope)
+
+ANATOMY_REPORT_VERSION = 1
+ANATOMY_REPORT_KIND = "anatomy_report"
+
+# zero-overlap collectives below this wire size are noise (scalar loss pmeans,
+# norm all-reduces), not optimization opportunities
+DEFAULT_OPPORTUNITY_MIN_BYTES = 1024
+
+
+def _us(seconds):
+    """Deterministic microsecond rounding for report/timeline fields."""
+    return round(seconds * 1e6, 3)
+
+
+def _level(groups, slice_sets):
+    """"ici" iff every replica group stays inside one slice set — the same
+    membership rule as ``hlo.collective_axis_bytes``."""
+    sets = slice_sets or []
+    if len(sets) <= 1:
+        return "ici"  # single-slice (or unset) factorization: no DCN exists
+    if groups is None:
+        return "dcn"  # every device participates, spanning the slices
+    return ("ici" if all(any(set(g) <= set(ss) for ss in sets) for g in groups)
+            else "dcn")
+
+
+def _window_hiding_seconds(lines, start_line, done_line, spec):
+    """Seconds of wire time the compute scheduled inside one ``-start`` →
+    ``-done`` window can hide: max(window dot flops / peak, window result
+    bytes / HBM bw) over the strictly-between instruction lines. Other
+    collective lines in the window contribute no hiding credit — their own
+    wire time is accounted on their own ledger rows."""
+    win_flops = 0
+    win_bytes = 0
+    for k in range(start_line + 1, done_line):
+        line = lines[k]
+        if hlo._OP_RE.search(line):
+            continue
+        win_flops += hlo.dot_flops_estimate(line)
+        win_bytes += hlo.result_bytes(line)
+    return max(win_flops / spec.peak_flops,
+               win_bytes / (spec.hbm_gbps * 1e9))
+
+
+def analyze_program(hlo_text, flops, hbm_bytes, spec, slice_sets=None,
+                    name=""):
+    """The full anatomy of one compiled program.
+
+    Returns ``{"name", "flops", "hbm_bytes", "collectives": [...],
+    "wire_bytes": {"ici", "dcn"}, "exposed_s": {"ici", "dcn"},
+    "roofline": {...}}`` where each collective row carries ``{"instruction",
+    "op", "line", "level", "bytes", "async", "zero_overlap", "comm_s",
+    "overlap_s", "exposed_s"}``. Raises ``ValueError`` on malformed async
+    pairing (propagated from ``hlo.parse_async_pairs``) — an unparseable
+    exposed-comm report must fail loudly.
+    """
+    lines = hlo_text.splitlines()
+    pairs = hlo.parse_async_pairs(hlo_text)
+    paired_start_lines = {p["start_line"] for p in pairs}
+    inner_lines = {p["inner_line"] for p in pairs
+                   if p["inner_line"] is not None}
+    rows = []
+    for pair in pairs:
+        comm_s = pair["bytes"] / (spec.link_gbps(
+            _level(pair["groups"], slice_sets)) * 1e9)
+        hide_s = _window_hiding_seconds(lines, pair["start_line"],
+                                        pair["done_line"], spec)
+        overlap_s = min(comm_s, hide_s)
+        rows.append({
+            "instruction": pair["name"], "op": pair["op"],
+            "line": pair["start_line"],
+            "level": _level(pair["groups"], slice_sets),
+            "bytes": pair["bytes"], "async": True,
+            "zero_overlap": overlap_s <= 0.0,
+            "comm_s": comm_s, "overlap_s": overlap_s,
+            "exposed_s": comm_s - overlap_s,
+        })
+    for line_no, iname, op, _is_start, b, groups in hlo.collective_lines(
+            hlo_text):
+        if line_no in paired_start_lines or line_no in inner_lines:
+            continue
+        # synchronous (or unpaired-start, conservatively): fully exposed
+        level = _level(groups, slice_sets)
+        comm_s = b / (spec.link_gbps(level) * 1e9)
+        rows.append({
+            "instruction": iname, "op": op, "line": line_no, "level": level,
+            "bytes": b, "async": False, "zero_overlap": True,
+            "comm_s": comm_s, "overlap_s": 0.0, "exposed_s": comm_s,
+        })
+    rows.sort(key=lambda r: r["line"])
+    wire = {"ici": 0, "dcn": 0}
+    exposed = {"ici": 0.0, "dcn": 0.0}
+    for r in rows:
+        wire[r["level"]] += r["bytes"]
+        exposed[r["level"]] += r["exposed_s"]
+    return {
+        "name": name,
+        "flops": float(flops),
+        "hbm_bytes": float(hbm_bytes),
+        "collectives": rows,
+        "wire_bytes": wire,
+        "exposed_s": exposed,
+        "roofline": roofline(flops, hbm_bytes, exposed["ici"], exposed["dcn"],
+                             spec),
+    }
+
+
+def analyze_artifact(artifact, spec, slice_sets=None):
+    """``analyze_program`` over one lint ``ProgramArtifact`` (optimized HLO +
+    cost_analysis stats)."""
+    cost = getattr(artifact, "cost_stats", {}) or {}
+    return analyze_program(artifact.hlo_text, cost.get("flops", 0.0),
+                           cost.get("bytes_accessed", 0.0), spec,
+                           slice_sets=slice_sets, name=artifact.name)
+
+
+def opportunities(reports, min_bytes=DEFAULT_OPPORTUNITY_MIN_BYTES):
+    """Named optimization opportunities: every zero-overlap collective moving
+    at least ``min_bytes``, sorted largest wire first. Each row names the
+    program and instruction so the reader can find the site in the HLO."""
+    out = []
+    for report in reports:
+        for r in report["collectives"]:
+            if not r["zero_overlap"] or r["bytes"] < min_bytes:
+                continue
+            hint = ("synchronous collective — no -start/-done window exists; "
+                    "restructure so independent compute can overlap the wire"
+                    if not r["async"] else
+                    "async window hides nothing — schedule independent "
+                    "compute between -start and -done")
+            out.append({
+                "program": report["name"], "instruction": r["instruction"],
+                "op": r["op"], "level": r["level"], "bytes": r["bytes"],
+                "exposed_us": _us(r["exposed_s"]), "hint": hint,
+            })
+    out.sort(key=lambda o: (-o["bytes"], o["program"], o["instruction"]))
+    return out
+
+
+def _program_json(report):
+    """The deterministic per-program report block (seconds -> rounded µs)."""
+    rf = report["roofline"]
+    return {
+        "name": report["name"],
+        "flops": report["flops"],
+        "hbm_bytes": report["hbm_bytes"],
+        "wire_bytes": dict(report["wire_bytes"]),
+        "collectives": [{
+            "instruction": r["instruction"], "op": r["op"],
+            "level": r["level"], "bytes": r["bytes"], "async": r["async"],
+            "zero_overlap": r["zero_overlap"], "comm_us": _us(r["comm_s"]),
+            "overlap_us": _us(r["overlap_s"]),
+            "exposed_us": _us(r["exposed_s"]),
+        } for r in report["collectives"]],
+        "roofline": {
+            "compute_floor_us": _us(rf["compute_floor_s"]),
+            "hbm_floor_us": _us(rf["hbm_floor_s"]),
+            "exposed_ici_us": _us(rf["exposed_ici_s"]),
+            "exposed_dcn_us": _us(rf["exposed_dcn_s"]),
+            "predicted_floor_us": _us(rf["predicted_floor_s"]),
+            "mfu_ceiling": round(rf["mfu_ceiling"], 4),
+        },
+    }
+
+
+def to_anatomy_trace_events(reports):
+    """Predicted-schedule Perfetto timeline: one process per program (sorted),
+    thread 0 carrying the binding compute/HBM floor slice, thread 1 the
+    exposed collectives laid end to end after it — the picture of where the
+    model says the step time must go. Zero-overlap collectives render in the
+    alert color."""
+    events = []
+    for pid, report in enumerate(sorted(reports, key=lambda r: r["name"])):
+        rf = report["roofline"]
+        events.append(process_name_event(pid, report["name"]))
+        events += thread_meta_events(pid, 0, "roofline floor", sort_index=0)
+        events += thread_meta_events(pid, 1, "exposed comm", sort_index=1)
+        bound_s = max(rf["compute_floor_s"], rf["hbm_floor_s"])
+        binding = ("compute floor"
+                   if rf["compute_floor_s"] >= rf["hbm_floor_s"]
+                   else "hbm floor")
+        events.append(complete_slice(
+            pid, 0, 0, _us(bound_s), binding, "roofline",
+            {"compute_floor_us": _us(rf["compute_floor_s"]),
+             "hbm_floor_us": _us(rf["hbm_floor_s"]),
+             "mfu_ceiling": round(rf["mfu_ceiling"], 4)}))
+        ts = _us(bound_s)
+        for r in report["collectives"]:
+            if r["exposed_s"] <= 0:
+                continue
+            dur = _us(r["exposed_s"])
+            events.append(complete_slice(
+                pid, 1, ts, dur, f"{r['op']} ({r['level']})", "exposed-comm",
+                {"instruction": r["instruction"], "bytes": r["bytes"],
+                 "zero_overlap": r["zero_overlap"],
+                 "overlap_us": _us(r["overlap_s"])},
+                cname="terrible" if r["zero_overlap"] else "bad"))
+            ts += dur
+    return trace_envelope(events, "ds-tpu anatomy",
+                          programs=len(reports),
+                          trace_version=ANATOMY_REPORT_VERSION)
+
+
+def comm_compare(entry_reports):
+    """The flat-vs-hierarchical-vs-compressed exchange comparison: summed
+    exposed-DCN and wire bytes per registry entry, plus the reduction each
+    two-level mode achieves over the flat exchange. ``ok`` iff both
+    hierarchical and compressed expose strictly less DCN time than flat."""
+    modes = {"flat": "standard", "hierarchical": "comm_hierarchical",
+             "compressed": "comm_compressed"}
+    if not all(entry in entry_reports for entry in modes.values()):
+        return None
+    out = {}
+    for mode, entry in modes.items():
+        reports = entry_reports[entry]
+        out[mode] = {
+            "entry": entry,
+            "exposed_dcn_us": _us(sum(r["exposed_s"]["dcn"] for r in reports)),
+            "exposed_ici_us": _us(sum(r["exposed_s"]["ici"] for r in reports)),
+            "wire_dcn_bytes": sum(r["wire_bytes"]["dcn"] for r in reports),
+            "wire_ici_bytes": sum(r["wire_bytes"]["ici"] for r in reports),
+        }
+    flat_dcn = out["flat"]["exposed_dcn_us"]
+    reductions = {}
+    for mode in ("hierarchical", "compressed"):
+        reductions[mode] = (round(1.0 - out[mode]["exposed_dcn_us"] / flat_dcn,
+                                  4) if flat_dcn > 0 else 0.0)
+    out["exposed_dcn_reduction_vs_flat"] = reductions
+    out["ok"] = (flat_dcn > out["hierarchical"]["exposed_dcn_us"]
+                 and flat_dcn > out["compressed"]["exposed_dcn_us"])
+    return out
+
+
+def _registry_slice_sets():
+    """Device-id slice sets of the CLI mesh: the same 2-slice factorization
+    the comm_hierarchical registry entry trains on (``dcn_slices: 2``)."""
+    import jax
+
+    from ..comm.topology import CommTopology, derive_num_slices
+    n = jax.device_count()
+    topo = CommTopology(n, derive_num_slices(n))
+    return [frozenset(g) for g in topo.ici_groups]
+
+
+def anatomy_main(argv=None):
+    """``ds-tpu anatomy`` — the step-time anatomy report over the lint
+    registry's AOT artifacts. Deterministic ``--json``, optional Perfetto
+    timeline of the predicted schedule, optional golden-pinnable comm
+    comparison file. Exit 1 when any entry fails to capture or a program's
+    exposed-comm report is unparseable."""
+    parser = argparse.ArgumentParser(
+        prog="ds-tpu anatomy",
+        description="roofline ledger + async-overlap analysis over the lint "
+                    "registry's AOT-compiled programs")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the full report as JSON on stdout")
+    parser.add_argument("--out", metavar="PATH",
+                        help="also write the JSON report to PATH")
+    parser.add_argument("--entry", action="append", metavar="NAME",
+                        help="limit to a lint-registry entry (repeatable; "
+                             "default: every entry)")
+    parser.add_argument("--chip", default="cpu-test",
+                        help="chip spec to price against (default: cpu-test, "
+                             "the CI mesh bound; '' auto-detects)")
+    parser.add_argument("--peak-tflops", type=float, default=0.0,
+                        help="override the spec's dense peak TFLOP/s")
+    parser.add_argument("--hbm-gbps", type=float, default=0.0,
+                        help="override the spec's HBM GB/s")
+    parser.add_argument("--ici-gbps", type=float, default=0.0,
+                        help="override the spec's ICI GB/s")
+    parser.add_argument("--dcn-gbps", type=float, default=0.0,
+                        help="override the spec's DCN GB/s")
+    parser.add_argument("--timeline", metavar="PATH",
+                        help="write the predicted-schedule Perfetto trace")
+    parser.add_argument("--comm-compare-out", metavar="PATH",
+                        help="write the flat-vs-hierarchical comparison JSON "
+                             "(the golden-pinned file)")
+    parser.add_argument("--opportunity-min-bytes", type=int,
+                        default=DEFAULT_OPPORTUNITY_MIN_BYTES,
+                        help="ignore zero-overlap collectives below this wire "
+                             "size (default: %(default)s)")
+    args = parser.parse_args(argv)
+
+    # stdout belongs to the report (same contract as ds-tpu lint)
+    import logging
+    for h in logging.getLogger("DeepSpeedTPU").handlers:
+        if isinstance(h, logging.StreamHandler) and h.stream is sys.stdout:
+            h.stream = sys.stderr
+
+    from ..lint import registry
+    spec = resolve_spec(args.chip, args.peak_tflops, args.hbm_gbps,
+                        args.ici_gbps, args.dcn_gbps)
+    slice_sets = _registry_slice_sets()
+    entries = sorted(registry.BUILDERS) if not args.entry else list(args.entry)
+    entry_reports = {}
+    errors = []
+    for entry in entries:
+        try:
+            artifacts = registry.capture_entry(entry)
+        except Exception as e:
+            errors.append(f"{entry}: capture failed: {e}")
+            continue
+        reports = []
+        for artifact in artifacts:
+            try:
+                reports.append(analyze_artifact(artifact, spec,
+                                                slice_sets=slice_sets))
+            except ValueError as e:
+                errors.append(f"{artifact.name}: exposed-comm report "
+                              f"unparseable: {e}")
+        entry_reports[entry] = reports
+
+    all_reports = sorted((r for reports in entry_reports.values()
+                          for r in reports), key=lambda r: r["name"])
+    compare = comm_compare(entry_reports)
+    report = {
+        "version": ANATOMY_REPORT_VERSION,
+        "kind": ANATOMY_REPORT_KIND,
+        "chip": spec.to_dict(),
+        "slice_sets": [sorted(s) for s in slice_sets],
+        "programs": [_program_json(r) for r in all_reports],
+        "opportunities": opportunities(all_reports,
+                                       min_bytes=args.opportunity_min_bytes),
+        "comm_compare": compare,
+        "errors": sorted(errors),
+        "ok": not errors and (compare is None or compare["ok"]),
+    }
+    text = json.dumps(report, indent=2, sort_keys=True) + "\n"
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    if args.comm_compare_out:
+        with open(args.comm_compare_out, "w") as f:
+            f.write(json.dumps(compare, indent=2, sort_keys=True) + "\n")
+    if args.timeline:
+        with open(args.timeline, "w") as f:
+            f.write(serialize_trace(to_anatomy_trace_events(all_reports)))
+    if args.json:
+        sys.stdout.write(text)
+    else:
+        for r in report["programs"]:
+            rf = r["roofline"]
+            print(f"{r['name']}: floor {rf['predicted_floor_us']}us "
+                  f"(compute {rf['compute_floor_us']}us, hbm "
+                  f"{rf['hbm_floor_us']}us, exposed ici "
+                  f"{rf['exposed_ici_us']}us / dcn {rf['exposed_dcn_us']}us) "
+                  f"mfu ceiling {rf['mfu_ceiling']}")
+        for o in report["opportunities"]:
+            print(f"OPPORTUNITY {o['program']}#{o['instruction']}: {o['op']} "
+                  f"({o['level']}, {o['bytes']} B, {o['exposed_us']}us "
+                  f"exposed) — {o['hint']}")
+        if compare is not None:
+            red = compare["exposed_dcn_reduction_vs_flat"]
+            print(f"comm compare: flat {compare['flat']['exposed_dcn_us']}us "
+                  f"exposed DCN; hierarchical "
+                  f"-{round(red['hierarchical'] * 100, 2)}%, compressed "
+                  f"-{round(red['compressed'] * 100, 2)}%"
+                  + ("" if compare["ok"] else "  [NOT LOWER — FAIL]"))
+        for e in report["errors"]:
+            print(f"ERROR {e}")
+        print(f"{len(report['programs'])} program(s), "
+              f"{len(report['opportunities'])} opportunity(ies), "
+              f"{len(report['errors'])} error(s)")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(anatomy_main())

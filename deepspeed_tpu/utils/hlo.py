@@ -231,6 +231,194 @@ def collective_axis_breakdown(hlo_text, slice_sets):
     return out
 
 
+# ------------------------------------------------------- async start/done pairs
+# Post-scheduling HLO splits an overlappable collective into a `-start` that
+# launches the transfer and a `-done` that blocks on it; every instruction the
+# scheduler placed between the two runs concurrently with the wire. The step-
+# anatomy analyzer (utils/anatomy.py) prices that window to split each
+# collective into overlapped vs exposed time. Two syntactic forms exist:
+# dedicated start/done ops (`all-reduce-start` / `all-reduce-done`) and the
+# generic wrapper (`async-start(...), calls=%comp` holding the collective
+# inside the called computation, optionally chained through `async-update`).
+
+_DEF_NAME_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.-]+) = ")
+_ASYNC_DONE_RE = re.compile(
+    r"= .*?(" + "|".join(COLLECTIVE_OPS) + r"|async)-done\(([^)]*)\)")
+_ASYNC_UPDATE_RE = re.compile(r"= .*?async-update\(([^)]*)\)")
+_ASYNC_WRAPPER_RE = re.compile(r"= .*? async-start\(")
+_CALLS_RE = re.compile(r"calls=%?([\w.-]+)")
+_COMP_HEADER_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.-]+)\s+(?:\([^{]*\))?\s*"
+                             r"(?:->\s*[^{]*)?\{\s*$")
+
+
+def _operand_name(operand_text):
+    """Instruction name from a (possibly type-annotated) operand: both
+    ``f32[1024]{0} %ars`` and ``%ars``/``ars`` yield ``ars``."""
+    toks = operand_text.strip().split()
+    return toks[-1].lstrip("%") if toks else ""
+
+
+def _called_computation_window(lines, comp_name):
+    """Line-index range (start, stop) of computation ``comp_name``'s body."""
+    for i, line in enumerate(lines):
+        m = _COMP_HEADER_RE.match(line)
+        if m and m.group(1) == comp_name:
+            for j in range(i + 1, len(lines)):
+                if lines[j].strip().startswith("}"):
+                    return i + 1, j
+            return i + 1, len(lines)
+    return None
+
+
+def parse_async_pairs(hlo_text):
+    """Pair every async collective ``-start`` with its ``-done`` across the
+    program text. Returns one dict per pair, in done order::
+
+        {"op": base op, "name": start instruction name, "done": done name,
+         "start_line": int, "done_line": int,   # indices into splitlines()
+         "bytes": per-device transfer bytes, "groups": replica groups or None}
+
+    Dedicated forms (``all-reduce-start`` ...) read bytes/groups off the start
+    line with the same tuple conventions as ``collective_results``; generic
+    ``async-start`` wrappers resolve ``calls=`` to the inner collective, and
+    ``async-update`` chains forward to the original start. A ``-done`` whose
+    operand resolves to no known start raises ``ValueError`` — a malformed
+    program must fail loudly, not silently drop a collective from the ledger.
+    """
+    lines = hlo_text.splitlines()
+    starts = {}   # start name -> pair dict (without done fields yet)
+    alias = {}    # async-update result name -> upstream operand name
+    pairs = []
+    for i, line in enumerate(lines):
+        m_op = _OP_RE.search(line)
+        if m_op and m_op.group(3):  # dedicated `<op>-start`
+            name_m = _DEF_NAME_RE.match(line)
+            if not name_m:
+                continue
+            ty, op, _ = m_op.groups()
+            b = sum(_elements(dims) * _DTYPE_BYTES[dt]
+                    for dt, dims in _result_shapes(ty, op, True)
+                    if dt in _DTYPE_BYTES)
+            starts[name_m.group(1)] = {
+                "op": op, "name": name_m.group(1), "start_line": i,
+                "bytes": b, "groups": parse_replica_groups(line),
+                "inner_line": None}
+            continue
+        if _ASYNC_WRAPPER_RE.search(line):  # generic wrapper form
+            name_m = _DEF_NAME_RE.match(line)
+            calls_m = _CALLS_RE.search(line)
+            if not name_m:
+                continue
+            op, b, groups, inner_line = None, 0, None, None
+            if calls_m:
+                window = _called_computation_window(lines, calls_m.group(1))
+                if window:
+                    for k in range(window[0], window[1]):
+                        m_in = _OP_RE.search(lines[k])
+                        if m_in:
+                            ty, op, is_start = m_in.groups()
+                            b = sum(_elements(dims) * _DTYPE_BYTES[dt]
+                                    for dt, dims in
+                                    _result_shapes(ty, op, bool(is_start))
+                                    if dt in _DTYPE_BYTES)
+                            groups = parse_replica_groups(lines[k])
+                            inner_line = k
+                            break
+            if op is not None:
+                starts[name_m.group(1)] = {
+                    "op": op, "name": name_m.group(1), "start_line": i,
+                    "bytes": b, "groups": groups, "inner_line": inner_line}
+            continue
+        m_upd = _ASYNC_UPDATE_RE.search(line)
+        if m_upd:
+            name_m = _DEF_NAME_RE.match(line)
+            if name_m:
+                alias[name_m.group(1)] = _operand_name(m_upd.group(1))
+            continue
+        m_done = _ASYNC_DONE_RE.search(line)
+        if m_done:
+            done_m = _DEF_NAME_RE.match(line)
+            operand = _operand_name(m_done.group(2))
+            seen = set()
+            while operand in alias and operand not in seen:  # update chains
+                seen.add(operand)
+                operand = alias[operand]
+            pair = starts.pop(operand, None)
+            if pair is None:
+                raise ValueError(
+                    f"async {m_done.group(1)}-done "
+                    f"{done_m.group(1) if done_m else '<unnamed>'!r} has no "
+                    f"matching -start for operand {operand!r}")
+            pair["done"] = done_m.group(1) if done_m else ""
+            pair["done_line"] = i
+            pairs.append(pair)
+    return pairs
+
+
+def collective_lines(hlo_text):
+    """[(line index, instruction name, base op, is_start, produced bytes,
+    groups-or-None)] per collective instruction, in program order — the
+    line-indexed refinement of ``collective_instructions`` the anatomy
+    analyzer needs to tell paired async starts from synchronous collectives."""
+    out = []
+    for i, line in enumerate(hlo_text.splitlines()):
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        ty, op, start = m.groups()
+        name_m = _DEF_NAME_RE.match(line)
+        b = sum(_elements(dims) * _DTYPE_BYTES[dt]
+                for dt, dims in _result_shapes(ty, op, bool(start))
+                if dt in _DTYPE_BYTES)
+        out.append((i, name_m.group(1) if name_m else "", op, bool(start), b,
+                    parse_replica_groups(line)))
+    return out
+
+
+# per-instruction cost estimates for the overlap-window pricing: a window's
+# compute capacity is what the scheduler placed between -start and -done,
+# priced as max(dot flops / peak, result bytes / HBM bandwidth)
+_DOT_LINE_RE = re.compile(r"= (\S+) dot\(([^)]*)\)")
+_LHS_CDIMS_RE = re.compile(r"lhs_contracting_dims=\{([\d,]*)\}")
+_RESULT_TY_RE = re.compile(r"^\s*(?:ROOT\s+)?%?[\w.-]+ = (\([^)]*\)|\S+) ")
+
+
+def dot_flops_estimate(line):
+    """2 * result_elements * contraction_size for one ``dot`` instruction line,
+    reading the contraction off the lhs operand's inline type annotation
+    (optimized HLO always annotates). 0 when the line is not an annotated dot
+    — the overlap estimate stays conservative (no phantom compute credit)."""
+    m = _DOT_LINE_RE.search(line)
+    if not m:
+        return 0
+    result = _shaped_types(m.group(1))
+    cd = _LHS_CDIMS_RE.search(line)
+    if not result or not cd:
+        return 0
+    operands = _split_top_level(m.group(2))
+    lhs = _shaped_types(operands[0]) if operands else []
+    if not lhs:
+        return 0
+    cdims = [int(d) for d in cd.group(1).split(",") if d]
+    contraction = 1
+    for d in cdims:
+        if d >= len(lhs[0][1]):
+            return 0
+        contraction *= lhs[0][1][d]
+    return 2 * _elements(result[0][1]) * contraction
+
+
+def result_bytes(line):
+    """Bytes of one instruction line's produced result(s) — the HBM-write
+    proxy the overlap-window pricing charges per scheduled instruction."""
+    m = _RESULT_TY_RE.match(line)
+    if not m:
+        return 0
+    return sum(_elements(dims) * _DTYPE_BYTES[dt]
+               for dt, dims in _shaped_types(m.group(1))
+               if dt in _DTYPE_BYTES)
+
+
 # --------------------------------------------------------------------- lint surface
 # The module header of an optimized program names which donations XLA actually
 # honored: `input_output_alias={ {out_idx}: (param_number, {param_idx}, kind) }`.

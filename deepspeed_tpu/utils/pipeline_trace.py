@@ -38,8 +38,12 @@ import time
 from collections import deque
 
 from .logging import logger
+from .trace_event import (complete_slice, counter_event, load_bundle,
+                          process_name_event, serialize_trace,  # noqa: F401
+                          thread_meta_events, trace_envelope)
 
 PIPELINE_TRACE_VERSION = 1
+PIPELINE_TRACE_KIND = "pipeline_trace"
 
 # instruction name -> goodput category
 CATEGORY = {
@@ -549,32 +553,28 @@ def to_trace_events(bundle):
     counter ("C") tracks for per-stage buffer occupancy and per-step bubble
     fraction. Deterministic for a given bundle."""
     stages = int(bundle["stages"])
-    events = [{"ph": "M", "pid": 0, "tid": 0, "name": "process_name",
-               "args": {"name": f"pipeline host {bundle.get('host', 0)}"}}]
+    events = [process_name_event(0, f"pipeline host {bundle.get('host', 0)}")]
     for s in range(stages):
-        events.append({"ph": "M", "pid": 0, "tid": s, "name": "thread_name",
-                       "args": {"name": f"stage {s}"}})
-        events.append({"ph": "M", "pid": 0, "tid": s, "name": "thread_sort_index",
-                       "args": {"sort_index": s}})
+        events += thread_meta_events(0, s, f"stage {s}", sort_index=s)
     for rec in bundle.get("steps", []):
         base = int(rec.get("t0_us", 0))
         train = rec.get("schedule") != "InferenceSchedule"
         occupancy = [0] * stages
         goodput = rec.get("goodput") or {}
         if goodput.get("bubble_fraction") is not None:
-            events.append({"ph": "C", "pid": 0, "tid": 0, "ts": base,
-                           "name": "bubble_fraction",
-                           "args": {"bubble": round(goodput["bubble_fraction"], 6)}})
+            events.append(counter_event(
+                0, 0, base, "bubble_fraction",
+                {"bubble": round(goodput["bubble_fraction"], 6)}))
         for sp in rec["spans"]:
             s, k, name, mb, buf, rel, dur = sp
-            ev = {"ph": "X", "pid": 0, "tid": s, "ts": base + rel,
-                  "dur": max(dur, 1), "cat": CATEGORY.get(name, "other"),
-                  "name": name if mb is None else f"{name} mb{mb}",
-                  "args": {"sched_step": k, "micro_batch": mb, "buffer": buf,
-                           "step": rec.get("step")}}
-            if mb is not None and name in _COMPUTE:
-                ev["cname"] = _MB_COLORS[mb % len(_MB_COLORS)]
-            events.append(ev)
+            cname = (_MB_COLORS[mb % len(_MB_COLORS)]
+                     if mb is not None and name in _COMPUTE else None)
+            events.append(complete_slice(
+                0, s, base + rel, dur,
+                name if mb is None else f"{name} mb{mb}",
+                CATEGORY.get(name, "other"),
+                {"sched_step": k, "micro_batch": mb, "buffer": buf,
+                 "step": rec.get("step")}, cname=cname))
             delta = 0
             if name == "RecvActivation" or (name == "LoadMicroBatch" and s == 0):
                 delta = 1
@@ -584,34 +584,23 @@ def to_trace_events(bundle):
                 delta = -1
             if delta:
                 occupancy[s] += delta
-                events.append({"ph": "C", "pid": 0, "tid": s, "ts": base + rel + dur,
-                               "name": f"stage {s} buffers",
-                               "args": {"buffers": occupancy[s]}})
-    return {"traceEvents": events, "displayTimeUnit": "ms",
-            "otherData": {"generator": "ds-tpu timeline",
-                          "stages": stages,
-                          "trace_version": bundle.get("version")}}
+                events.append(counter_event(
+                    0, s, base + rel + dur, f"stage {s} buffers",
+                    {"buffers": occupancy[s]}))
+    return trace_envelope(events, "ds-tpu timeline", stages=stages,
+                          trace_version=bundle.get("version"))
 
 
-def serialize_trace(trace):
-    """Byte-stable serialization (sorted keys, no whitespace) — the golden-file
-    contract of tests/unit/test_pipeline_trace.py."""
-    return json.dumps(trace, sort_keys=True, separators=(",", ":"))
+# serialize_trace lives in utils/trace_event.py (shared with the serve and
+# anatomy exporters) and stays re-exported here for its historical importers.
 
 
 # --------------------------------------------------------------------- the CLI
 
 
 def _load_bundle(path):
-    with open(path) as f:
-        data = json.load(f)
-    if data.get("kind") == "pipeline_trace":
-        return data
-    # flight-recorder dump with an embedded span bundle (numerics.FlightRecorder)
-    embedded = data.get("pipeline_trace")
-    if isinstance(embedded, dict) and embedded.get("kind") == "pipeline_trace":
-        return embedded
-    return None
+    # flight-recorder dumps (numerics.FlightRecorder) embed the span bundle
+    return load_bundle(path, PIPELINE_TRACE_KIND)
 
 
 def timeline_main(argv=None):

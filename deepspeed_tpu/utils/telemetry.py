@@ -55,17 +55,22 @@ def _abstract_signature(args) -> tuple:
     return tuple(sig)
 
 
-def _analyze_compiled(compiled, slice_sets=None):
+def _analyze_compiled(compiled, slice_sets=None, anatomy_spec=None):
     """(flops, argument/output/temp bytes, collective wire bytes, wire bytes
-    split (ici, dcn)) of a compiled executable, each 0 when the backend doesn't
-    report it. With no slice factorization every wire byte accounts as ICI."""
-    flops = 0.0
+    split (ici, dcn), HBM bytes accessed, anatomy report) of a compiled
+    executable, each 0/None when the backend doesn't report it. With no slice
+    factorization every wire byte accounts as ICI. The anatomy report
+    (utils/anatomy.analyze_program) is computed only when ``anatomy_spec``
+    names a chip spec — pure host-side text analysis of the same artifact."""
+    flops = hbm_b = 0.0
     arg_b = out_b = tmp_b = wire = wire_ici = wire_dcn = 0
+    anatomy = None
     try:
         ca = compiled.cost_analysis()
         if not isinstance(ca, dict):  # older jax returned [dict]
             ca = ca[0] if ca else {}
         flops = max(float(ca.get("flops", 0.0)), 0.0)
+        hbm_b = max(float(ca.get("bytes accessed", 0.0)), 0.0)
     except Exception:
         pass
     try:
@@ -83,9 +88,14 @@ def _analyze_compiled(compiled, slice_sets=None):
         if slice_sets and len(slice_sets) > 1:
             split = collective_axis_bytes(text, slice_sets)
             wire_ici, wire_dcn = split["ici"], split["dcn"]
+        if anatomy_spec is not None:
+            from .anatomy import analyze_program
+            anatomy = analyze_program(text, flops, hbm_b, anatomy_spec,
+                                      slice_sets=slice_sets)
     except Exception:
         pass
-    return flops, arg_b, out_b, tmp_b, wire, wire_ici, wire_dcn
+    return (flops, arg_b, out_b, tmp_b, wire, wire_ici, wire_dcn, hbm_b,
+            anatomy)
 
 
 class CompileRecord:
@@ -93,11 +103,11 @@ class CompileRecord:
 
     __slots__ = ("signature", "compile_seconds", "flops", "argument_bytes",
                  "output_bytes", "temp_bytes", "wire_bytes", "wire_bytes_ici",
-                 "wire_bytes_dcn", "count")
+                 "wire_bytes_dcn", "hbm_bytes", "anatomy", "count")
 
     def __init__(self, signature, compile_seconds, flops=0.0, argument_bytes=0,
                  output_bytes=0, temp_bytes=0, wire_bytes=0, wire_bytes_ici=0,
-                 wire_bytes_dcn=0):
+                 wire_bytes_dcn=0, hbm_bytes=0.0, anatomy=None):
         self.signature = signature
         self.compile_seconds = compile_seconds
         self.flops = flops
@@ -107,6 +117,8 @@ class CompileRecord:
         self.wire_bytes = wire_bytes
         self.wire_bytes_ici = wire_bytes_ici
         self.wire_bytes_dcn = wire_bytes_dcn
+        self.hbm_bytes = hbm_bytes          # cost_analysis "bytes accessed"
+        self.anatomy = anatomy              # utils/anatomy report or None
         self.count = 1
 
 
@@ -123,6 +135,9 @@ class CompileWatchdog:
         # slice factorization for the per-axis (ICI vs DCN) wire-byte split;
         # None means single-slice — every collective byte accounts as ICI
         self.slice_sets = None
+        # roofline ChipSpec: when set, every analyzed compile also gets the
+        # step-anatomy report (utils/anatomy) — still pure host text analysis
+        self.anatomy_spec = None
 
     def record(self, name: str, sig, seconds: float, compiled=None) -> CompileRecord:
         per = self.records.setdefault(name, {})
@@ -132,12 +147,15 @@ class CompileWatchdog:
             rec.compile_seconds += seconds
         else:
             if compiled is not None:
-                (flops, arg_b, out_b, tmp_b, wire, wire_ici,
-                 wire_dcn) = _analyze_compiled(compiled, self.slice_sets)
+                (flops, arg_b, out_b, tmp_b, wire, wire_ici, wire_dcn,
+                 hbm_b, anatomy) = _analyze_compiled(compiled, self.slice_sets,
+                                                     self.anatomy_spec)
             else:
                 flops = arg_b = out_b = tmp_b = wire = wire_ici = wire_dcn = 0
+                hbm_b, anatomy = 0.0, None
             rec = per[sig] = CompileRecord(sig, seconds, flops, arg_b, out_b,
-                                           tmp_b, wire, wire_ici, wire_dcn)
+                                           tmp_b, wire, wire_ici, wire_dcn,
+                                           hbm_b, anatomy)
         n = sum(r.count for r in per.values())
         if len(per) >= self.recompile_warn and name not in self._storm_warned:
             self._storm_warned.add(name)
@@ -215,9 +233,15 @@ class _WatchedJit:
                 return self._call_fallback(sig, *args)
             rec = self._session.watchdog.record(
                 self._name, sig, time.perf_counter() - t0, compiled)
+            anat = rec.anatomy or {}
+            exposed = anat.get("exposed_s", {})
             entry = self._cache[sig] = (compiled, rec.flops, rec.wire_bytes,
-                                        rec.wire_bytes_ici, rec.wire_bytes_dcn)
-        compiled, flops, wire, wire_ici, wire_dcn = entry
+                                        rec.wire_bytes_ici, rec.wire_bytes_dcn,
+                                        rec.hbm_bytes,
+                                        exposed.get("ici", 0.0),
+                                        exposed.get("dcn", 0.0))
+        (compiled, flops, wire, wire_ici, wire_dcn, hbm_b, exp_ici,
+         exp_dcn) = entry
         try:
             out = compiled(*args)
         except Exception as e:
@@ -227,7 +251,9 @@ class _WatchedJit:
                            f"program {self._name!r} ({e!r}); falling back to the "
                            "raw jit (signature tracking only)")
             return self._jit(*args)
-        self._session.note_execution(flops, wire, wire_ici, wire_dcn)
+        self._session.note_execution(flops, wire, wire_ici, wire_dcn,
+                                     hbm_bytes=hbm_b, exposed_ici_s=exp_ici,
+                                     exposed_dcn_s=exp_dcn)
         return out
 
 
@@ -252,8 +278,15 @@ class TelemetrySession:
     def __init__(self, monitor=None, peak_tflops: Optional[float] = None,
                  trace_dir: Optional[str] = None, trace_steps=None,
                  mfu_window: int = 20, recompile_warn: int = 3,
-                 output_path: Optional[str] = None, job_name: Optional[str] = None):
+                 output_path: Optional[str] = None, job_name: Optional[str] = None,
+                 anatomy_spec=None):
         self.watchdog = CompileWatchdog(recompile_warn=recompile_warn)
+        # step-anatomy: a roofline ChipSpec (utils/roofline.resolve_spec)
+        # switches on the per-compile overlap/roofline analysis and the
+        # Anatomy/* end_step scalars; None keeps the analyzer fully off
+        self.watchdog.anatomy_spec = anatomy_spec
+        self.anatomy_spec = anatomy_spec
+        self.last_anatomy = None
         self.peak_tflops = float(peak_tflops) if peak_tflops else None
         self.trace_dir = trace_dir or "deepspeed_telemetry_trace"
         self.trace_steps = tuple(trace_steps) if trace_steps is not None else None
@@ -270,6 +303,9 @@ class TelemetrySession:
         self.wire_bytes_executed = 0
         self.wire_ici_executed = 0
         self.wire_dcn_executed = 0
+        self.hbm_bytes_executed = 0.0
+        self.exposed_ici_executed = 0.0
+        self.exposed_dcn_executed = 0.0
         self.steps_recorded = 0
         self.last_mfu = None
         self.last_step_ms = None
@@ -282,6 +318,9 @@ class TelemetrySession:
         self._last_wire = 0
         self._last_wire_ici = 0
         self._last_wire_dcn = 0
+        self._last_hbm = 0.0
+        self._last_exp_ici = 0.0
+        self._last_exp_dcn = 0.0
         self._last_compiles = 0
 
         self._trace_active = False
@@ -300,11 +339,16 @@ class TelemetrySession:
         return _WatchedJit(name, jitted, self)
 
     def note_execution(self, flops: float, wire_bytes: int,
-                       wire_ici: int = 0, wire_dcn: int = 0):
+                       wire_ici: int = 0, wire_dcn: int = 0,
+                       hbm_bytes: float = 0.0, exposed_ici_s: float = 0.0,
+                       exposed_dcn_s: float = 0.0):
         self.flops_executed += flops
         self.wire_bytes_executed += wire_bytes
         self.wire_ici_executed += wire_ici
         self.wire_dcn_executed += wire_dcn
+        self.hbm_bytes_executed += hbm_bytes
+        self.exposed_ici_executed += exposed_ici_s
+        self.exposed_dcn_executed += exposed_dcn_s
 
     def set_comm_topology(self, slice_sets):
         """Install the slice factorization (list of per-slice device-id sets,
@@ -388,12 +432,18 @@ class TelemetrySession:
         wire_d = self.wire_bytes_executed - self._last_wire
         wire_ici_d = self.wire_ici_executed - self._last_wire_ici
         wire_dcn_d = self.wire_dcn_executed - self._last_wire_dcn
+        hbm_d = self.hbm_bytes_executed - self._last_hbm
+        exp_ici_d = self.exposed_ici_executed - self._last_exp_ici
+        exp_dcn_d = self.exposed_dcn_executed - self._last_exp_dcn
         had_compile = compiles != self._last_compiles
         self._last_end = now
         self._last_flops = self.flops_executed
         self._last_wire = self.wire_bytes_executed
         self._last_wire_ici = self.wire_ici_executed
         self._last_wire_dcn = self.wire_dcn_executed
+        self._last_hbm = self.hbm_bytes_executed
+        self._last_exp_ici = self.exposed_ici_executed
+        self._last_exp_dcn = self.exposed_dcn_executed
         self._last_compiles = compiles
 
         samples = global_step * samples_per_step
@@ -427,6 +477,28 @@ class TelemetrySession:
             mon.add_scalar("Telemetry/Samples/hbm_peak_bytes",
                            stats.get("peak_bytes_in_use", 0), samples)
         mon.add_scalar("Telemetry/Samples/compile_count", compiles, samples)
+        # step anatomy: the roofline attribution of this step's measured wall
+        # time. Pure arithmetic over counters the proxies already fed — the
+        # scalars appear or disappear with telemetry.anatomy, nothing else
+        # about the step path changes (asserted HLO-identical in tests).
+        if self.anatomy_spec is not None and dt > 0 and not had_compile:
+            from .roofline import roofline
+            rf = roofline(flops_d, hbm_d, exp_ici_d, exp_dcn_d,
+                          self.anatomy_spec, measured_seconds=dt)
+            self.last_anatomy = rf
+            mon.add_scalar("Anatomy/compute_ms",
+                           rf["compute_s"] * 1000.0, samples)
+            mon.add_scalar("Anatomy/hbm_bound_ms",
+                           rf["hbm_bound_s"] * 1000.0, samples)
+            mon.add_scalar("Anatomy/exposed_ici_ms",
+                           rf["exposed_ici_s"] * 1000.0, samples)
+            mon.add_scalar("Anatomy/exposed_dcn_ms",
+                           rf["exposed_dcn_s"] * 1000.0, samples)
+            mon.add_scalar("Anatomy/host_gap_ms",
+                           rf["host_gap_s"] * 1000.0, samples)
+            mon.add_scalar("Anatomy/predicted_floor_ms",
+                           rf["predicted_floor_s"] * 1000.0, samples)
+            mon.add_scalar("Anatomy/mfu_ceiling", rf["mfu_ceiling"], samples)
         if goodput:
             for key in ("fwd_seconds", "bwd_seconds", "p2p_seconds", "load_seconds",
                         "reduce_seconds", "opt_seconds", "bubble_seconds",
@@ -470,10 +542,23 @@ class TelemetrySession:
         """One-shot digest for benches/reports: rolling MFU, HBM watermarks,
         wire bytes of the last step, and the watchdog's compile accounting."""
         stats = hbm_stats() or {}
+        anatomy = None
+        if self.last_anatomy is not None:
+            rf = self.last_anatomy
+            anatomy = {
+                "predicted_floor_ms": round(rf["predicted_floor_s"] * 1e3, 6),
+                "compute_ms": round(rf["compute_s"] * 1e3, 6),
+                "hbm_bound_ms": round(rf["hbm_bound_s"] * 1e3, 6),
+                "exposed_ici_ms": round(rf["exposed_ici_s"] * 1e3, 6),
+                "exposed_dcn_ms": round(rf["exposed_dcn_s"] * 1e3, 6),
+                "host_gap_ms": round(rf["host_gap_s"] * 1e3, 6),
+                "mfu_ceiling": round(rf["mfu_ceiling"], 4),
+            }
         return {
             "mfu": self.last_mfu,
             "step_time_ms": self.last_step_ms,
             "steps_recorded": self.steps_recorded,
+            "anatomy": anatomy,
             "wire_bytes_per_step": self.last_wire_bytes,
             "wire_bytes_per_step_ici": self.last_wire_bytes_ici,
             "wire_bytes_per_step_dcn": self.last_wire_bytes_dcn,

@@ -41,6 +41,20 @@ if [ "$serve_rc" -eq 0 ]; then
            /tmp/_serve_timeline.trace.json
     serve_rc=$?
 fi
+# anatomy: roofline ledger + overlap analysis over the comm-mode registry
+# entries, with the flat-vs-hierarchical exposed-DCN comparison byte-compared
+# against the committed golden — any pricing or exchange drift fails CI.
+# (`ds-tpu anatomy` itself exits nonzero when the two-level modes stop
+# strictly beating flat.) Full report in /tmp/_anatomy.json (deterministic
+# bytes); /tmp/_anatomy.trace.json is the predicted-schedule Perfetto view.
+timeout -k 10 300 "$REPO/bin/ds-tpu" anatomy --json --out /tmp/_anatomy.json \
+    --entry standard --entry comm_hierarchical --entry comm_compressed \
+    --timeline /tmp/_anatomy.trace.json \
+    --comm-compare-out /tmp/_anatomy_comm.json \
+&& cmp "$REPO/tests/unit/golden/anatomy_comm_compare.json" \
+       /tmp/_anatomy_comm.json
+anatomy_rc=$?
 [ "$lint_rc" -ne 0 ] && exit "$lint_rc"
 [ "$comm_rc" -ne 0 ] && exit "$comm_rc"
-exit "$serve_rc"
+[ "$serve_rc" -ne 0 ] && exit "$serve_rc"
+exit "$anatomy_rc"

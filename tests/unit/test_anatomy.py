@@ -1,0 +1,344 @@
+"""Step-time anatomy tests (docs/anatomy.md).
+
+Three layers, mirroring the subsystem's own structure:
+
+* **roofline.py** — the chip-spec table and floor arithmetic, pure math.
+* **anatomy.analyze_program** — overlap windows, exposure, level split, and
+  the named zero-overlap opportunities on hand-written HLO fixtures (the CPU
+  backend emits only synchronous collectives, so the async forms are
+  exercised on fixtures exactly like test_hlo_parsers.py).
+* **Engine scale** — the anatomy rides the telemetry watchdog without
+  changing a single HLO instruction; the flat-vs-hierarchical comparison
+  shows strictly less exposed DCN for both two-level modes (golden-pinned,
+  the byte-stable file scripts/lint.sh diffs); ZeRO grad collectives are
+  flagged zero-overlap; and the roofline invariant holds against measured
+  step time (floor <= measured, ceiling >= measured MFU).
+
+Regenerate the golden with:
+    ds-tpu anatomy --entry standard --entry comm_hierarchical \
+        --entry comm_compressed \
+        --comm-compare-out tests/unit/golden/anatomy_comm_compare.json
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.utils import anatomy
+from deepspeed_tpu.utils.hlo import instruction_count, optimized_hlo
+from deepspeed_tpu.utils.roofline import (CHIP_SPECS, ChipSpec, resolve_spec,
+                                          roofline)
+from simple_model import SimpleModel, random_dataset, simple_config
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "anatomy_comm_compare.json")
+
+SLICE_SETS = [frozenset(range(0, 4)), frozenset(range(4, 8))]
+SPEC = resolve_spec("cpu-test")
+
+
+# ----------------------------------------------------------------- roofline
+def test_resolve_spec_table_and_overrides():
+    spec = resolve_spec("tpu-v5e")
+    assert spec.peak_tflops == CHIP_SPECS["tpu-v5e"].peak_tflops
+    over = resolve_spec("tpu-v5e", hbm_gbps=1000.0)
+    assert over.hbm_gbps == 1000.0
+    assert over.peak_tflops == spec.peak_tflops  # 0 keeps the table value
+    with pytest.raises(ValueError, match="unknown chip"):
+        resolve_spec("tpu-v9000")
+
+
+def test_roofline_floor_and_ceiling_arithmetic():
+    spec = ChipSpec("t", peak_tflops=1.0, hbm_gbps=1.0, ici_gbps=1.0,
+                    dcn_gbps=1.0)
+    # 1e12 flops at 1 TFLOP/s = 1 s compute; 5e8 bytes at 1 GB/s = 0.5 s HBM
+    rf = roofline(1e12, 5e8, exposed_ici_s=0.25, exposed_dcn_s=0.25, spec=spec)
+    assert rf["compute_floor_s"] == pytest.approx(1.0)
+    assert rf["hbm_floor_s"] == pytest.approx(0.5)
+    # floor = binding bound (compute) + exposed comm
+    assert rf["predicted_floor_s"] == pytest.approx(1.5)
+    assert rf["mfu_ceiling"] == pytest.approx(1.0 / 1.5)
+    # attribution against a measured time
+    rf = roofline(1e12, 5e8, 0.25, 0.25, spec, measured_seconds=2.0)
+    assert rf["hbm_bound_s"] == pytest.approx(0.0)   # compute binds, not HBM
+    assert rf["host_gap_s"] == pytest.approx(0.5)
+
+
+def test_roofline_hbm_bound_program():
+    spec = ChipSpec("t", peak_tflops=1.0, hbm_gbps=1.0, ici_gbps=1.0,
+                    dcn_gbps=1.0)
+    rf = roofline(1e10, 2e9, 0.0, 0.0, spec, measured_seconds=3.0)
+    assert rf["hbm_floor_s"] == pytest.approx(2.0)
+    assert rf["compute_s"] == pytest.approx(0.01)
+    assert rf["hbm_bound_s"] == pytest.approx(2.0 - 0.01)
+    assert rf["host_gap_s"] == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------- analyze_program
+# async all-reduce with a fat annotated dot inside the window: the window
+# hides part (not all) of the wire time
+PARTIAL_OVERLAP = """
+HloModule m
+
+ENTRY main {
+  p0 = f32[262144]{0} parameter(0)
+  a = f32[64,64]{1,0} parameter(1)
+  b = f32[64,64]{1,0} parameter(2)
+  ars = f32[262144]{0} all-reduce-start(p0), replica_groups={{0,1,2,3},{4,5,6,7}}, to_apply=add
+  d = f32[64,64]{1,0} dot(f32[64,64]{1,0} a, f32[64,64]{1,0} b), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ard = f32[262144]{0} all-reduce-done(f32[262144]{0} ars)
+  ROOT out = f32[64,64]{1,0} add(d, d)
+}
+"""
+
+# same collective, nothing scheduled in the window: async but zero overlap
+EMPTY_WINDOW = """
+HloModule m
+
+ENTRY main {
+  p0 = f32[262144]{0} parameter(0)
+  ars = f32[262144]{0} all-reduce-start(p0), replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=add
+  ROOT ard = f32[262144]{0} all-reduce-done(f32[262144]{0} ars)
+}
+"""
+
+SYNC_ONLY = """
+HloModule m
+
+ENTRY main {
+  p0 = f32[1024]{0} parameter(0)
+  ar = f32[1024]{0} all-reduce(p0), replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=add
+  ROOT out = f32[1024]{0} add(ar, ar)
+}
+"""
+
+
+def test_async_window_partially_hides_the_wire():
+    r = anatomy.analyze_program(PARTIAL_OVERLAP, 1e6, 1e5, SPEC,
+                                slice_sets=SLICE_SETS, name="p")
+    (row,) = r["collectives"]
+    assert row["async"] and not row["zero_overlap"]
+    assert row["level"] == "ici"  # both groups stay inside one slice
+    assert 0 < row["overlap_s"] < row["comm_s"]
+    assert row["exposed_s"] == pytest.approx(row["comm_s"] - row["overlap_s"])
+    assert r["exposed_s"]["ici"] == pytest.approx(row["exposed_s"])
+    assert r["exposed_s"]["dcn"] == 0.0
+
+
+def test_empty_async_window_is_zero_overlap_and_cross_slice():
+    r = anatomy.analyze_program(EMPTY_WINDOW, 0, 0, SPEC,
+                                slice_sets=SLICE_SETS, name="e")
+    (row,) = r["collectives"]
+    assert row["async"] and row["zero_overlap"]
+    assert row["level"] == "dcn"  # the one group spans both slices
+    assert row["overlap_s"] == 0.0
+    assert row["exposed_s"] == pytest.approx(row["comm_s"])
+
+
+def test_sync_collective_is_fully_exposed():
+    r = anatomy.analyze_program(SYNC_ONLY, 0, 0, SPEC,
+                                slice_sets=SLICE_SETS, name="s")
+    (row,) = r["collectives"]
+    assert not row["async"] and row["zero_overlap"]
+    assert row["exposed_s"] == pytest.approx(row["comm_s"]) and row["comm_s"] > 0
+
+
+def test_no_slice_factorization_means_no_dcn():
+    r = anatomy.analyze_program(SYNC_ONLY, 0, 0, SPEC, slice_sets=None,
+                                name="s")
+    assert r["exposed_s"]["dcn"] == 0.0
+    assert r["exposed_s"]["ici"] > 0.0
+
+
+def test_opportunities_threshold_and_order():
+    big = anatomy.analyze_program(EMPTY_WINDOW, 0, 0, SPEC, SLICE_SETS, "big")
+    small = anatomy.analyze_program(SYNC_ONLY, 0, 0, SPEC, SLICE_SETS, "small")
+    opps = anatomy.opportunities([small, big], min_bytes=1024)
+    assert [o["program"] for o in opps] == ["big", "small"]  # bytes-descending
+    assert "start" in opps[0]["hint"]          # async phrasing
+    assert "synchronous" in opps[1]["hint"]    # sync phrasing
+    # threshold drops the 4 KB sync all-reduce
+    assert anatomy.opportunities([small], min_bytes=1 << 20) == []
+
+
+def test_trace_events_lay_exposed_comm_after_the_floor():
+    r = anatomy.analyze_program(PARTIAL_OVERLAP, 1e6, 1e5, SPEC,
+                                SLICE_SETS, "p")
+    trace = anatomy.to_anatomy_trace_events([r])
+    slices = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    floor = [e for e in slices if e["cat"] == "roofline"]
+    comm = [e for e in slices if e["cat"] == "exposed-comm"]
+    assert len(floor) == 1 and len(comm) == 1
+    assert floor[0]["tid"] == 0 and comm[0]["tid"] == 1
+    # comm track starts where the binding floor ends (dur itself carries the
+    # 1 us Perfetto visibility clamp, so compare against the floor args)
+    bound_us = max(floor[0]["args"]["compute_floor_us"],
+                   floor[0]["args"]["hbm_floor_us"])
+    assert comm[0]["ts"] == pytest.approx(bound_us)
+    assert trace["otherData"]["generator"] == "ds-tpu anatomy"
+
+
+# ------------------------------------------------------------- engine scale
+HIDDEN = 16
+
+
+def _build(**overrides):
+    model = SimpleModel(HIDDEN)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+        config_params=simple_config(**overrides))
+    return eng
+
+
+def _batch(n=8, seed=0):
+    data = random_dataset(n, HIDDEN, seed=seed)
+    return (np.stack([d[0] for d in data]), np.stack([d[1] for d in data]))
+
+
+# the engine step-path matrix: the same four training paths the lint registry
+# captures (standard two-jit, fused external-master single-jit, the unfused
+# accumulation window, and ZeRO-Offload's host-tier split)
+def _external_master_pair(n):
+    from deepspeed_tpu.lint.registry import _external_master_pair as pair
+    return pair(n)
+
+
+STEP_PATHS = {
+    "standard": dict(zero_optimization={"stage": 2}),
+    "external_master_fused": dict(zero_optimization={"stage": 2},
+                                  zero_allow_untested_optimizer=True),
+    "external_master_accum": dict(train_batch_size=16,
+                                  gradient_accumulation_steps=2,
+                                  zero_optimization={"stage": 2},
+                                  zero_allow_untested_optimizer=True),
+    "zero_offload": dict(zero_optimization={"stage": 2, "cpu_offload": True}),
+}
+
+
+@pytest.mark.parametrize("path", sorted(STEP_PATHS))
+def test_anatomy_keeps_every_step_path_hlo_identical(path, tmp_path):
+    """THE non-perturbation gate: telemetry.anatomy prices artifacts the
+    watchdog already holds — with it on, every program on all four engine
+    step paths compiles to the instruction-identical HLO."""
+    overrides = STEP_PATHS[path]
+    kwargs = {}
+    if "external_master" in path:
+        kwargs["optimizer"] = _external_master_pair(4)
+    model = SimpleModel(HIDDEN)
+    engines = []
+    for tel in (None, {"enabled": True, "output_path": str(tmp_path),
+                       "anatomy": {"enabled": True}}):
+        over = dict(overrides)
+        if tel:
+            over["telemetry"] = tel
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+            config_params=simple_config(**over), **kwargs)
+        engines.append(eng)
+    eng_off, eng_on = engines
+    assert eng_on.telemetry.anatomy_spec is not None
+    batch = _batch()
+    progs_off = {n: (j, a) for n, j, a, _m in eng_off.lint_programs(batch)}
+    progs_on = {n: (j, a) for n, j, a, _m in eng_on.lint_programs(batch)}
+    assert sorted(progs_off) == sorted(progs_on)
+    for name in sorted(progs_off):
+        j_off, a_off = progs_off[name]
+        j_on, a_on = progs_on[name]
+        h_off = optimized_hlo(j_off, *a_off)
+        h_on = optimized_hlo(j_on, *a_on)
+        assert instruction_count(h_off) > 0, name
+        assert instruction_count(h_off) == instruction_count(h_on), name
+
+
+@pytest.fixture(scope="module")
+def comm_entry_reports():
+    """Anatomy reports for the flat/hierarchical/compressed registry entries,
+    captured once per module (three engine builds)."""
+    from deepspeed_tpu.lint import registry
+    out = {}
+    for entry in ("standard", "comm_hierarchical", "comm_compressed"):
+        artifacts = registry.capture_entry(entry)
+        out[entry] = [anatomy.analyze_artifact(a, SPEC, slice_sets=SLICE_SETS)
+                      for a in artifacts]
+    return out
+
+
+def test_hierarchical_and_compressed_expose_less_dcn(comm_entry_reports):
+    """The headline claim of the two-level exchange, stated in anatomy terms:
+    both hierarchical modes strictly reduce estimated exposed-DCN time."""
+    def dcn(entry):
+        return sum(r["exposed_s"]["dcn"] for r in comm_entry_reports[entry])
+    flat = dcn("standard")
+    assert flat > 0
+    assert dcn("comm_hierarchical") < flat
+    assert dcn("comm_compressed") < flat
+
+
+def test_zero_grad_collective_is_flagged_zero_overlap(comm_entry_reports):
+    """>= 1 ZeRO gradient collective surfaces as a named opportunity: the CPU
+    backend schedules collectives synchronously, so the grad exchange in
+    loss_and_grad is fully exposed and crosses the opportunity threshold."""
+    reports = comm_entry_reports["standard"]
+    opps = anatomy.opportunities(reports)
+    grad = [o for o in opps if "loss_and_grad" in o["program"]
+            and o["op"] in ("all-reduce", "reduce-scatter")]
+    assert grad, f"no zero-overlap grad collective in {opps}"
+    assert all(o["exposed_us"] > 0 for o in grad)
+
+
+def test_comm_compare_matches_golden_bytes(comm_entry_reports):
+    """The flat-vs-hierarchical comparison, byte-for-byte against the pinned
+    golden (the same file scripts/lint.sh regenerates and diffs in CI)."""
+    compare = anatomy.comm_compare(comm_entry_reports)
+    assert compare is not None and compare["ok"]
+    text = json.dumps(compare, indent=2, sort_keys=True) + "\n"
+    with open(GOLDEN) as f:
+        golden = f.read()
+    assert text == golden, "comm compare drifted from golden (regen via " \
+                           "ds-tpu anatomy --comm-compare-out, see module doc)"
+
+
+def test_roofline_sanity_against_measured_step(tmp_path):
+    """floor <= measured and ceiling >= measured MFU: the cpu-test spec is an
+    upper bound on any CI machine, so the prediction brackets reality."""
+    eng = _build(zero_optimization={"stage": 2},
+                 telemetry={"enabled": True, "output_path": str(tmp_path),
+                            "anatomy": {"enabled": True}})
+    xs, ys = _batch()
+    for _ in range(4):
+        loss = eng(xs, ys)
+        eng.backward(loss)
+        eng.step()
+    summary = eng.telemetry.summary()
+    rf = summary["anatomy"]
+    assert rf is not None
+    assert rf["predicted_floor_ms"] <= summary["step_time_ms"]
+    assert rf["mfu_ceiling"] >= (summary["mfu"] or 0.0)
+    assert rf["host_gap_ms"] >= 0.0
+    # the Anatomy/* scalars landed in the ledger
+    eng.telemetry.close()
+    path = os.path.join(str(tmp_path), "DeepSpeedTelemetry", "scalars.jsonl")
+    tags = {json.loads(l)["tag"] for l in open(path)}
+    assert {"Anatomy/predicted_floor_ms", "Anatomy/mfu_ceiling",
+            "Anatomy/host_gap_ms", "Anatomy/compute_ms",
+            "Anatomy/hbm_bound_ms", "Anatomy/exposed_ici_ms",
+            "Anatomy/exposed_dcn_ms"} <= tags
+
+
+def test_anatomy_off_emits_no_anatomy_scalars(tmp_path):
+    eng = _build(telemetry={"enabled": True, "output_path": str(tmp_path)})
+    assert eng.telemetry.anatomy_spec is None
+    xs, ys = _batch()
+    for _ in range(2):
+        loss = eng(xs, ys)
+        eng.backward(loss)
+        eng.step()
+    assert eng.telemetry.summary()["anatomy"] is None
+    eng.telemetry.close()
+    path = os.path.join(str(tmp_path), "DeepSpeedTelemetry", "scalars.jsonl")
+    tags = {json.loads(l)["tag"] for l in open(path)}
+    assert not any(t.startswith("Anatomy/") for t in tags)

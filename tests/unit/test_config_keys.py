@@ -98,6 +98,20 @@ SWEEP = {
         ({"pipeline_trace": {"enabled": True, "dump_dir": "/tmp/pt"}},
          ("attr", "pipeline_trace_dump_dir", "/tmp/pt")),
         ({"pipeline_trace": {"enabled": True, "capacity": 0}}, ("raise", ValueError)),
+        ({"anatomy": {"enabled": True}},
+         ("attr", "telemetry_anatomy_enabled", True)),
+        ({"anatomy": {"enabled": True, "chip": "tpu-v5e"}},
+         ("attr", "telemetry_anatomy_chip", "tpu-v5e")),
+        ({"anatomy": {"enabled": True, "peak_tflops": 275}},
+         ("attr", "telemetry_anatomy_peak_tflops", 275.0)),
+        ({"anatomy": {"enabled": True, "hbm_gbps": 819}},
+         ("attr", "telemetry_anatomy_hbm_gbps", 819.0)),
+        ({"anatomy": {"enabled": True, "ici_gbps": 200}},
+         ("attr", "telemetry_anatomy_ici_gbps", 200.0)),
+        ({"anatomy": {"enabled": True, "dcn_gbps": 25}},
+         ("attr", "telemetry_anatomy_dcn_gbps", 25.0)),
+        ({"anatomy": {"enabled": True, "peak_tflops": -1}}, ("raise", ValueError)),
+        ({"anatomy": {"enabled": True, "hbm_gbps": True}}, ("raise", ValueError)),
     ),
     "numerics": (
         ({"enabled": True, "audit_interval": 7}, ("attr", "numerics_audit_interval", 7)),
@@ -211,6 +225,13 @@ def test_unknown_pipeline_trace_key_warns(capture):
     assert "capactiy" in capture.text
 
 
+def test_unknown_anatomy_key_warns(capture):
+    _cfg(telemetry={"anatomy": {"enabled": True, "chipp": "tpu-v4"}})
+    assert "unknown telemetry.anatomy config key" in capture.text
+    assert "chipp" in capture.text
+    assert "chip" in capture.text    # the known-keys hint points at the fix
+
+
 def test_unknown_serving_key_warns(capture):
     _cfg(serving={"enabled": True, "blok_size": 8})
     assert "unknown serving config key" in capture.text
@@ -238,7 +259,9 @@ def test_unknown_numerics_key_warns(capture):
 
 def test_known_nested_keys_do_not_warn(capture):
     _cfg(telemetry={"enabled": True, "trace_steps": [2, 5],
-                    "pipeline_trace": {"enabled": True, "capacity": 7}},
+                    "pipeline_trace": {"enabled": True, "capacity": 7},
+                    "anatomy": {"enabled": True, "chip": "tpu-v4",
+                                "dcn_gbps": 25.0}},
          numerics={"enabled": True, "audit_interval": 3},
          serving={"request_trace": {"enabled": True, "capacity": 64,
                                     "slo": {"ttft_ms": 250.0, "tpot_ms": 40.0}}})
