@@ -43,7 +43,17 @@ __all__ = [
     "two_level_sum", "two_level_compressed",
     "two_level_allreduce", "two_level_compressed_allreduce",
     "error_state_shapes", "padded_size",
+    "bucket_partition", "bucket_plan", "bucketed_error_state_shapes",
+    "bucketed_two_level_mean", "bucketed_two_level_compressed",
+    "GRAD_BUCKET_SCOPE",
 ]
+
+# named_scope prefix stamped on every bucketed exchange: it survives into the
+# optimized HLO as instruction metadata (op_name), which is how the anatomy
+# pass recognizes an eagerly-issued bucket collective and prices its real
+# issue-to-use window instead of treating the sync instruction as fully
+# exposed (utils/anatomy.py, docs/overlap.md)
+GRAD_BUCKET_SCOPE = "ds_grad_bucket"
 
 
 # ---------------------------------------------------------------- tree plumbing
@@ -86,6 +96,128 @@ def error_state_shapes(n_pad: int, topo: CommTopology):
     dp = topo.dp
     assert n_pad % dp == 0
     return (dp, n_pad // topo.slice_size), (dp, n_pad // dp)
+
+
+# --------------------------------------------------------------- bucketing
+def bucket_partition(tree, bucket_bytes: int):
+    """Greedy deterministic partition of the tree's leaves (tree order) into
+    contiguous size-bounded buckets: a leaf opens a new bucket when appending
+    it would push the current bucket past ``bucket_bytes``. Sizes are priced
+    at 4 bytes/element (the fp32 wire width) so the partition depends only on
+    the parameter SHAPES and ``bucket_bytes`` — never on dtype or data. A
+    single leaf larger than the bound gets its own (oversized) bucket.
+    Returns a list of leaf-index lists covering every leaf exactly once."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    buckets, cur, cur_bytes = [], [], 0
+    for i, leaf in enumerate(leaves):
+        nbytes = int(np.prod(leaf.shape)) * 4
+        if cur and cur_bytes + nbytes > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def bucket_plan(tree, bucket_bytes: int, dp: int):
+    """``bucket_partition`` plus the static per-bucket exchange geometry:
+    ``[{"leaf_indices", "sizes", "n", "n_pad"}]`` where ``n_pad`` rounds each
+    bucket up to a multiple of ``dp`` (the two-level schedule's scatter
+    granularity). Deterministic for a given tree / bucket_bytes / dp."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    plan = []
+    for idxs in bucket_partition(tree, bucket_bytes):
+        sizes = tuple(int(np.prod(leaves[i].shape)) for i in idxs)
+        n = sum(sizes)
+        plan.append({"leaf_indices": tuple(idxs), "sizes": sizes,
+                     "n": n, "n_pad": padded_size(n, dp)})
+    return plan
+
+
+def bucketed_error_state_shapes(plan, topo: CommTopology):
+    """((dp, worker_cols), (dp, server_cols)) for the bucketed compressed
+    exchange's persistent error-feedback buffers: the per-bucket chunks laid
+    out back to back in plan order. The total exceeds the monolithic
+    ``error_state_shapes`` by the per-bucket padding — bucketed EF state is a
+    different (per-bucket) layout, not a re-slicing of the monolithic one."""
+    dp = topo.dp
+    we_cols = sum(b["n_pad"] // topo.slice_size for b in plan)
+    se_cols = sum(b["n_pad"] // dp for b in plan)
+    return (dp, we_cols), (dp, se_cols)
+
+
+def _bucket_vec(leaves, bucket):
+    """One bucket's padded flat vector (in the leaves' own dtype)."""
+    parts = [leaves[i].reshape(-1) for i in bucket["leaf_indices"]]
+    vec = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    return jnp.pad(vec, (0, bucket["n_pad"] - bucket["n"]))
+
+
+def _bucket_unpack(mean, bucket, leaves, out):
+    """Scatter one bucket's exchanged vector back onto its leaves."""
+    off = 0
+    for i, sz in zip(bucket["leaf_indices"], bucket["sizes"]):
+        out[i] = mean[off:off + sz].reshape(leaves[i].shape) \
+            .astype(leaves[i].dtype)
+        off += sz
+
+
+def bucketed_two_level_mean(leaves, plan, topo: CommTopology,
+                            axis_name: str = DATA_AXIS):
+    """Per-bucket exact two-level MEAN of a flat leaf list (inside shard_map).
+
+    Each bucket runs the same reduce-scatter -> DCN psum -> all-gather
+    schedule as the monolithic ``two_level_sum`` (plain psum on a flat
+    topology), under its own ``ds_grad_bucket{k}`` named_scope, and depends
+    only on its OWN leaves — so the compiler is free to issue bucket k's
+    exchange while the backward producing bucket k-1's leaves is still
+    running, and the DCN hop of bucket k runs concurrently with the ICI
+    phase of bucket k+1. Per element the reduction tree is identical to the
+    monolithic exchange, so the result is bit-equal to it for any fixed
+    bucket assignment (bucketing reorders issue, not math)."""
+    dp = topo.dp
+    out = [None] * len(leaves)
+    for k, bucket in enumerate(plan):
+        with jax.named_scope(f"{GRAD_BUCKET_SCOPE}{k}"):
+            mean = two_level_sum(_bucket_vec(leaves, bucket), topo,
+                                 axis_name) / dp
+            _bucket_unpack(mean, bucket, leaves, out)
+    return out
+
+
+def bucketed_two_level_compressed(leaves, we_local, se_local, plan,
+                                  topo: CommTopology, seg_consts, n_segs,
+                                  axis_name: str = DATA_AXIS):
+    """Per-bucket error-feedback compressed MEAN of a flat leaf list (inside
+    shard_map): ``two_level_compressed`` over each bucket's padded vector,
+    with the persistent worker/server error buffers laid out per bucket
+    (``bucketed_error_state_shapes``). ``seg_consts``/``n_segs`` are the
+    static per-bucket scale-segment maps (one per plan entry). NOT bit-equal
+    to the monolithic compressed exchange — per-segment RMS scales are
+    chunked per bucket — but the EF telescoping contract holds per bucket.
+    Returns (out leaves, new_we, new_se)."""
+    L, dp = topo.slice_size, topo.dp
+    out = [None] * len(leaves)
+    new_we, new_se = [], []
+    we_off = se_off = 0
+    for k, bucket in enumerate(plan):
+        n_pad = bucket["n_pad"]
+        wcols, scols = n_pad // L, n_pad // dp
+        with jax.named_scope(f"{GRAD_BUCKET_SCOPE}{k}"):
+            vec = _bucket_vec(leaves, bucket).astype(jnp.float32)
+            mean, we_k, se_k = two_level_compressed(
+                vec, we_local[we_off:we_off + wcols],
+                se_local[se_off:se_off + scols], topo, seg_consts[k],
+                n_segs[k], axis_name)
+            _bucket_unpack(mean, bucket, leaves, out)
+        new_we.append(we_k)
+        new_se.append(se_k)
+        we_off += wcols
+        se_off += scols
+    return (out, jnp.concatenate(new_we) if len(new_we) > 1 else new_we[0],
+            jnp.concatenate(new_se) if len(new_se) > 1 else new_se[0])
 
 
 # ------------------------------------------------------------ in-context bodies

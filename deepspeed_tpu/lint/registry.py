@@ -142,6 +142,42 @@ def _build_comm_compressed():
     return eng, _sample_batch()
 
 
+def _build_comm_overlap():
+    # bucketed overlapped exchange over the two-level topology: 0.004 MB
+    # buckets split the LintModel into three EQUAL padded buckets
+    # ((b1, b2) / (w1) / (w2), 1024 elements each), so the backward issues
+    # three independent reduce-scatter/psum/all-gather chains and every
+    # bucket's ICI phases fit under the other buckets' in-flight DCN wire —
+    # the exposed-ICI == 0 shape the anatomy golden pins (docs/overlap.md)
+    import deepspeed_tpu
+    model = LintModel()
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+        config_params=_config(
+            zero_optimization={"stage": 2},
+            comm={"mode": "hierarchical", "dcn_slices": 2,
+                  "overlap": {"mode": "bucketed", "bucket_mb": 0.004}}))
+    if len(eng._overlap_plan) != 3:
+        raise RuntimeError("lint registry: comm_overlap entry expects the "
+                           f"equal 3-bucket plan, got {eng._overlap_plan}")
+    return eng, _sample_batch()
+
+
+def _build_comm_overlap_compressed():
+    # bucketed compressed exchange: per-bucket 1-bit DCN phases with the
+    # bucketed error-feedback layout — bucket k's all-to-all can overlap
+    # bucket k+1's ICI reduce-scatter
+    import deepspeed_tpu
+    model = LintModel()
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+        config_params=_config(
+            zero_optimization={"stage": 2},
+            comm={"mode": "hierarchical_compressed", "dcn_slices": 2,
+                  "overlap": {"mode": "bucketed", "bucket_mb": 0.004}}))
+    return eng, _sample_batch()
+
+
 def _build_zero_offload():
     import deepspeed_tpu
     model = LintModel()
@@ -232,6 +268,8 @@ BUILDERS = {
     "external_master_accum": _build_external_master_accum,
     "comm_hierarchical": _build_comm_hierarchical,
     "comm_compressed": _build_comm_compressed,
+    "comm_overlap": _build_comm_overlap,
+    "comm_overlap_compressed": _build_comm_overlap_compressed,
     "zero_offload": _build_zero_offload,
     "pipeline": _build_pipeline,
     "gpt2_decode": _build_gpt2_decode,

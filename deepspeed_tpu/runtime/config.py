@@ -403,6 +403,23 @@ class DeepSpeedConfig:
                 raise ValueError(
                     f"DeepSpeedConfig: comm.{attr[len('comm_'):]} must be an "
                     f"int >= 0, got {val!r}")
+        ov_dict = cm_dict.get(COMM_OVERLAP, {}) or {}
+        self._warn_unknown_nested(f"{COMM}.{COMM_OVERLAP}", ov_dict,
+                                  COMM_OVERLAP_CONFIG_KEYS)
+        self.comm_overlap_mode = get_scalar_param(
+            ov_dict, COMM_OVERLAP_MODE, COMM_OVERLAP_MODE_DEFAULT)
+        self.comm_overlap_bucket_mb = get_scalar_param(
+            ov_dict, COMM_OVERLAP_BUCKET_MB, COMM_OVERLAP_BUCKET_MB_DEFAULT)
+        if self.comm_overlap_mode not in COMM_OVERLAP_MODES:
+            raise ValueError(
+                f"DeepSpeedConfig: comm.overlap.mode must be one of "
+                f"{COMM_OVERLAP_MODES}, got {self.comm_overlap_mode!r}")
+        bmb = self.comm_overlap_bucket_mb
+        if isinstance(bmb, bool) or not isinstance(bmb, (int, float)) or bmb <= 0:
+            raise ValueError(
+                "DeepSpeedConfig: comm.overlap.bucket_mb must be a number > 0, "
+                f"got {bmb!r}")
+        self.comm_overlap_bucket_mb = float(bmb)
 
         self.sparse_attention = None
         if SPARSE_ATTENTION in param_dict:

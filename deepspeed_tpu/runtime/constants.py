@@ -307,6 +307,25 @@ COMM_DCN_SLICES_DEFAULT = 0
 COMM_COMPRESS_START_STEP = "compress_start_step"
 COMM_COMPRESS_START_STEP_DEFAULT = 0
 
+# comm.overlap: bucketed overlapped gradient exchange (docs/overlap.md).
+# "mode" selects off (monolithic post-backward exchange, the historical
+# behaviour — programs stay HLO-instruction-identical) or "bucketed"
+# (partition the parameter tree into size-bounded per-subtree buckets and
+# issue each bucket's exchange as soon as its backward subtree completes, so
+# the collective of bucket k overlaps the remaining backward — and, under a
+# hierarchical comm.mode, the DCN hop of bucket k overlaps the ICI phase of
+# bucket k+1). "bucket_mb" bounds each bucket's fp32 wire footprint; the
+# partition is deterministic for a given parameter tree and bucket_mb
+# (DeepSpeed's allreduce_bucket_size, restated for eager issue).
+COMM_OVERLAP = "overlap"
+COMM_OVERLAP_MODE = "mode"
+COMM_OVERLAP_MODE_DEFAULT = "off"
+COMM_OVERLAP_OFF = "off"
+COMM_OVERLAP_BUCKETED = "bucketed"
+COMM_OVERLAP_MODES = (COMM_OVERLAP_OFF, COMM_OVERLAP_BUCKETED)
+COMM_OVERLAP_BUCKET_MB = "bucket_mb"
+COMM_OVERLAP_BUCKET_MB_DEFAULT = 25.0
+
 #############################################
 # Gradient accumulation fp32 buffer
 #############################################
@@ -502,4 +521,10 @@ COMM_CONFIG_KEYS = frozenset({
     COMM_MODE,
     COMM_DCN_SLICES,
     COMM_COMPRESS_START_STEP,
+    COMM_OVERLAP,
+})
+
+COMM_OVERLAP_CONFIG_KEYS = frozenset({
+    COMM_OVERLAP_MODE,
+    COMM_OVERLAP_BUCKET_MB,
 })

@@ -37,7 +37,8 @@ from ..utils import ThroughputTimer, SynchronizedWallClockTimer, log_dist, logge
 from .config import DeepSpeedConfig
 from .constants import (ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER,
                         SGD_OPTIMIZER, ROUTE_TRAIN,
-                        COMM_MODE_FLAT, COMM_MODE_COMPRESSED)
+                        COMM_MODE_FLAT, COMM_MODE_COMPRESSED,
+                        COMM_OVERLAP_BUCKETED)
 from .dataloader import DeepSpeedDataLoader
 from .fp16 import loss_scaler as ls
 from .lr_schedules import get_scheduler
@@ -211,6 +212,25 @@ class DeepSpeedEngine:
                     "gradient_accumulation_steps == 1: error-feedback compression "
                     "of per-micro-batch partial gradients would accumulate "
                     "compression error across the window")
+        if self.config.comm_overlap_mode == COMM_OVERLAP_BUCKETED:
+            # bucketed overlapped grad exchange (docs/overlap.md) runs the same
+            # shard_map scaffold as hierarchical comm, so it inherits the same
+            # composition limits even under comm.mode=flat
+            if self.zero_optimization() and self.zero_cpu_offload():
+                raise ValueError(
+                    "comm.overlap.mode='bucketed' does not compose with "
+                    "ZeRO-Offload (the host-tier step owns the grad layout)")
+            if self.zero_optimization_stage() >= 3:
+                raise ValueError(
+                    "comm.overlap.mode='bucketed' requires ZeRO stage <= 2: the "
+                    "bucketed exchange runs in a shard_map with replicated "
+                    "parameter in_specs, which would re-gather stage-3 sharded "
+                    "parameters every step")
+            if self.config.sparse_gradients_enabled:
+                raise ValueError(
+                    "comm.overlap.mode='bucketed' does not compose with "
+                    "sparse_gradients (the row-sparse reduction owns the grad "
+                    "exchange); pick one")
 
         # ---- persistent compilation cache (opt-in; see constants.py) ----
         if self.config.compilation_cache_dir:
@@ -812,6 +832,7 @@ class DeepSpeedEngine:
         self._run_fused_step = None   # set on the fused gas==1 paths below
         self._fused_pending = None
         self._jit_fused = None        # the fused jit object, for flops_profile
+        self._overlap_plan = None     # set when comm.overlap=bucketed is live
         grad_acc_steps = self.gradient_accumulation_steps()
         fp16 = self.fp16_enabled()
         clip = float(self.gradient_clipping() or 0.0)
@@ -885,6 +906,22 @@ class DeepSpeedEngine:
 
             return loss_and_grad
 
+        # comm.overlap=bucketed (docs/overlap.md): issue the grad exchange per
+        # size-bounded bucket instead of as one monolithic post-backward vector,
+        # so each bucket's collectives depend only on its own backward subtree
+        # and can overlap the remaining backward compute (and, hierarchically,
+        # each other's DCN phase). Inert when another subsystem owns the
+        # exchange or there is nothing to exchange (dp == 1).
+        overlap_requested = self.config.comm_overlap_mode == COMM_OVERLAP_BUCKETED
+        overlap_active = (overlap_requested and not use_stacked
+                          and self._sparse_grad_flags is None
+                          and self.dp_size > 1 and self._offload is None)
+        if overlap_requested and not overlap_active and self.dp_size > 1:
+            logger.warning(
+                "[deepspeed_tpu] comm.overlap.mode='bucketed' requested but the "
+                "gradient exchange is owned elsewhere (1-bit Adam stacked grads "
+                "or sparse-gradient reduction); overlap is inert")
+
         if self._use_stacked_grads:
             # 1-bit Adam path: keep per-worker grads stacked over a leading dp axis
             # instead of letting XLA psum them — the compressed allreduce in the optimizer
@@ -937,6 +974,32 @@ class DeepSpeedEngine:
 
             loss_and_grad = shard_mapped_loss_and_grad(
                 reduce_sparse, jax.tree_util.tree_map(lambda _: P(), self.params))
+        elif overlap_active:
+            # bucketed overlapped exchange (docs/overlap.md): the same two-level
+            # schedule as the hierarchical branch below, issued once per bucket
+            # under a ds_grad_bucket{k} named_scope. Per element the reduction
+            # tree is unchanged, so the result is bit-equal to the monolithic
+            # exchange given the same topology (and, under comm.mode=flat, each
+            # bucket degenerates to a plain psum — the flat exchange up to an
+            # exact power-of-two rescale). Under hierarchical_compressed this
+            # is also the full-precision warmup phase.
+            from ..comm.hierarchical import bucket_plan, bucketed_two_level_mean
+            from ..comm.topology import CommTopology
+            topo = (self._comm_topo if self._comm_mode != COMM_MODE_FLAT
+                    else CommTopology(self.dp_size, 1))
+            bucket_bytes = int(self.config.comm_overlap_bucket_mb * (1 << 20))
+            plan = bucket_plan(self.params, bucket_bytes, self.dp_size)
+            self._overlap_plan = plan
+            self._overlap_topo = topo
+
+            def reduce_overlap(grads, batch):
+                del batch
+                leaves, treedef = jax.tree_util.tree_flatten(grads)
+                out = bucketed_two_level_mean(leaves, plan, topo)
+                return jax.tree_util.tree_unflatten(treedef, out)
+
+            loss_and_grad = shard_mapped_loss_and_grad(
+                reduce_overlap, jax.tree_util.tree_map(lambda _: P(), self.params))
         elif self._comm_mode != COMM_MODE_FLAT and self.dp_size > 1:
             # hierarchical comm (docs/multislice.md): the gradient exchange runs
             # the explicit two-level schedule — reduce-scatter within each slice
@@ -966,17 +1029,28 @@ class DeepSpeedEngine:
         else:
             loss_and_grad = local_loss_and_grad
 
+        # The fused single-jit paths inline `loss_and_grad` directly. That
+        # historically required the plain local grad path; the bucketed overlap
+        # exchange is the one shard_mapped reduction that composes (its
+        # value_and_grad runs INSIDE the shard_map body, so nothing
+        # differentiates through the shard_map) — except under
+        # hierarchical_compressed, whose warmup->compressed program switch in
+        # forward() needs the two-jit step.
+        fused_grad_ok = (loss_and_grad is local_loss_and_grad
+                         or (overlap_active
+                             and self._comm_mode != COMM_MODE_COMPRESSED))
         if self.config.fused_step and not (
-                grad_acc_steps == 1 and loss_and_grad is local_loss_and_grad
+                grad_acc_steps == 1 and fused_grad_ok
                 and self._offload is None and not self._cpu_checkpointing_active()):
             # warn HERE (the offload path returns early below and would otherwise
             # swallow the flag silently): the user must not believe the fused
             # step's HBM saving is active when it is not
             logger.warning(
                 "[deepspeed_tpu] fused_step requested but ineligible (it needs "
-                "gradient_accumulation_steps == 1 and the plain local grad path — "
-                "no 1-bit Adam stacked grads, sparse-gradient reduction, "
-                "hierarchical comm, ZeRO-Offload, or cpu activation "
+                "gradient_accumulation_steps == 1 and the plain local grad path "
+                "or the bucketed overlap exchange — no 1-bit Adam stacked "
+                "grads, sparse-gradient reduction, non-overlapped hierarchical "
+                "comm, compressed comm, ZeRO-Offload, or cpu activation "
                 "checkpointing); using the two-jit step")
 
         # Inputs carry their shardings (params/batch were device_put with the right
@@ -1007,15 +1081,33 @@ class DeepSpeedEngine:
             from ..comm.hierarchical import (flatten_tree, unflatten_tree,
                                              tree_size, grad_segment_ids,
                                              two_level_compressed,
+                                             bucketed_error_state_shapes,
+                                             bucketed_two_level_compressed,
                                              error_state_shapes, padded_size)
             from ..parallel.mesh import shard_map
             topo = self._comm_topo
-            n_total = tree_size(self.params)
-            n_pad = padded_size(n_total, self.dp_size)
-            seg_np = grad_segment_ids(self.params, n_pad)
-            n_segs = int(seg_np.max()) + 1
-            seg_const = jnp.asarray(seg_np)
-            we_shape, se_shape = error_state_shapes(n_pad, topo)
+            if overlap_active:
+                # bucketed EF layout (docs/overlap.md): the persistent error
+                # buffers hold the per-bucket chunks back to back, and each
+                # bucket compresses with its OWN per-tensor scale segments —
+                # same telescoping contract per bucket, different (chunked)
+                # scale boundaries than the monolithic exchange.
+                plan = self._overlap_plan
+                param_leaves = jax.tree_util.tree_leaves(self.params)
+                seg_consts, n_segs_list = [], []
+                for b in plan:
+                    sn = grad_segment_ids(
+                        [param_leaves[i] for i in b["leaf_indices"]], b["n_pad"])
+                    seg_consts.append(jnp.asarray(sn))
+                    n_segs_list.append(int(sn.max()) + 1)
+                we_shape, se_shape = bucketed_error_state_shapes(plan, topo)
+            else:
+                n_total = tree_size(self.params)
+                n_pad = padded_size(n_total, self.dp_size)
+                seg_np = grad_segment_ids(self.params, n_pad)
+                n_segs = int(seg_np.max()) + 1
+                seg_const = jnp.asarray(seg_np)
+                we_shape, se_shape = error_state_shapes(n_pad, topo)
             ef_sharding = NamedSharding(self.mesh, P(DATA_AXIS, None))
             self._comm_we = jax.device_put(jnp.zeros(we_shape, jnp.float32),
                                            ef_sharding)
@@ -1027,14 +1119,22 @@ class DeepSpeedEngine:
             def loss_and_grad_comm(params, scale, we, se, *batch):
                 def local(params, scale, we_row, se_row, *local_batch):
                     loss, grads = local_loss_and_grad(params, scale, *local_batch)
-                    vec, recipe = flatten_tree(grads)
-                    # compression runs in fp32: the sign + per-segment scale IS
-                    # the wire format, whatever grad_dtype is
-                    vec = jnp.pad(vec.astype(jnp.float32), (0, n_pad - n_total))
-                    out, new_we, new_se = two_level_compressed(
-                        vec, we_row[0], se_row[0], topo, seg_const, n_segs)
-                    grads_out = unflatten_tree(
-                        out[:n_total].astype(grad_dtype), recipe)
+                    if overlap_active:
+                        leaves, treedef = jax.tree_util.tree_flatten(grads)
+                        out, new_we, new_se = bucketed_two_level_compressed(
+                            leaves, we_row[0], se_row[0], plan, topo,
+                            seg_consts, n_segs_list)
+                        grads_out = jax.tree_util.tree_unflatten(treedef, out)
+                    else:
+                        vec, recipe = flatten_tree(grads)
+                        # compression runs in fp32: the sign + per-segment scale
+                        # IS the wire format, whatever grad_dtype is
+                        vec = jnp.pad(vec.astype(jnp.float32),
+                                      (0, n_pad - n_total))
+                        out, new_we, new_se = two_level_compressed(
+                            vec, we_row[0], se_row[0], topo, seg_const, n_segs)
+                        grads_out = unflatten_tree(
+                            out[:n_total].astype(grad_dtype), recipe)
                     return (jax.lax.pmean(loss, DATA_AXIS), grads_out,
                             new_we[None], new_se[None])
 
@@ -1229,10 +1329,10 @@ class DeepSpeedEngine:
             # fits fused — the same structure as a hand-rolled one-jit rank step).
             # Semantics: the update runs at forward() and is COMMITTED at step();
             # forward/backward/step must rotate strictly (enforced in forward()).
-            if grad_acc_steps == 1 and loss_and_grad is local_loss_and_grad:
+            if grad_acc_steps == 1 and fused_grad_ok:
                 def fused_step(opt_state, scaler_state, params, step, hyper, *batch):
-                    loss, grads = local_loss_and_grad(params, scaler_state.cur_scale,
-                                                      *batch)
+                    loss, grads = loss_and_grad(params, scaler_state.cur_scale,
+                                                *batch)
                     grads, overflow, norm, sent = prep_grads(grads, scaler_state)
 
                     def do_update(_):
@@ -1293,14 +1393,14 @@ class DeepSpeedEngine:
         # immediately (their buffers are donated); step() commits bookkeeping, and
         # strict forward/backward/step rotation is enforced in forward().
         if (self.config.fused_step and grad_acc_steps == 1
-                and loss_and_grad is local_loss_and_grad
+                and fused_grad_ok
                 and not self._cpu_checkpointing_active()):
             def fused_step_std(master, opt_state, scaler_state, params, step, hyper,
                                *batch):
                 # the whole two-jit pipeline inlined: value_and_grad feeds the
                 # SAME apply_update body (overflow skip, scaler, param re-cast)
-                loss, grads = local_loss_and_grad(params, scaler_state.cur_scale,
-                                                  *batch)
+                loss, grads = loss_and_grad(params, scaler_state.cur_scale,
+                                            *batch)
                 return (loss,) + apply_update(master, opt_state, scaler_state,
                                               grads, params, step, hyper)
 
@@ -1367,8 +1467,13 @@ class DeepSpeedEngine:
                 lambda p, s: jax.ShapeDtypeStruct(p.shape, dt, sharding=s),
                 self.params, shardings)
 
-        # the backward's cross-data reduction rides in exactly grad_dtype
-        red = ({"min": 1, "dtypes": [grad_dt]} if dp > 1 else {"max": 0})
+        # the backward's cross-data reduction rides in exactly grad_dtype; with
+        # the bucketed overlap exchange live there is one reduction PER BUCKET
+        # (the per-bucket count is the structural claim — a re-fused monolithic
+        # exchange would fail this floor)
+        n_buckets = len(self._overlap_plan) if self._overlap_plan else 0
+        red = ({"min": max(1, n_buckets), "dtypes": [grad_dt]} if dp > 1
+               else {"max": 0})
         gather_gate = {"all-gather": {"min": 1, "dtypes": [compute, "f32"]}}
         comm_hier = (self._comm_mode != COMM_MODE_FLAT
                      and not self._use_stacked_grads
@@ -1379,14 +1484,21 @@ class DeepSpeedEngine:
             # ZeRO-3 re-gathers params in forward; below stage 3 any large
             # all-gather in the backward is an undeclared-collective violation.
             # Hierarchical comm's intra-slice all-gather (level 3 of the
-            # two-level schedule) is a declared exception.
+            # two-level schedule, one per bucket when overlapped) is a
+            # declared exception.
             "collectives": (dict(gather_gate) if zstage >= 3 else
-                            ({"all-gather": {"min": 1,
+                            ({"all-gather": {"min": max(1, n_buckets),
                                              "dtypes": sorted({grad_dt, "f32"})}}
                              if comm_hier else {})),
             "donation": {"check_unusable": True},
             "strict": True,
         }
+        if n_buckets:
+            # bucketing scatters each bucket's chunk over the mesh; the
+            # smallest per-bucket shard must still cross the large-collective
+            # floor or the per-bucket reduction count could not be enforced
+            lg_man["small_element_threshold"] = max(
+                8, min(b["n_pad"] for b in self._overlap_plan) // dp - 1)
         local_man = {"compute_dtype": compute, "strict": True,
                      "donation": {"check_unusable": True}}
         progs = []
@@ -1413,6 +1525,17 @@ class DeepSpeedEngine:
             f_man = {"compute_dtype": compute, "any_reduction": red,
                      "collectives": dict(gather_gate) if scattered_master else {},
                      "donation": {"check_unusable": True}, "strict": True}
+            if n_buckets:
+                f_man["small_element_threshold"] = \
+                    lg_man["small_element_threshold"]
+                if comm_hier:
+                    # the bucketed two-level exchange's intra-slice gathers
+                    # appear inside the fused step too
+                    f_man["collectives"] = dict(
+                        f_man["collectives"],
+                        **{"all-gather": {"min": max(1, n_buckets),
+                                          "dtypes": sorted({grad_dt, "f32",
+                                                            compute})}})
             if self._external_master:
                 args = (self.opt_state, self.scaler_state, self.params, step,
                         hyper) + batch
@@ -1433,15 +1556,18 @@ class DeepSpeedEngine:
                 "compute_dtype": compute,
                 "any_reduction": {"min": 1, "dtypes": ["f32"]},
                 "collectives": {
-                    "all-gather": {"min": 1,
+                    "all-gather": {"min": max(1, n_buckets),
                                    "dtypes": sorted({"f32", "u8", "s8", grad_dt})},
-                    "all-to-all": {"min": 1, "dtypes": ["s8", "u8"]},
+                    "all-to-all": {"min": max(1, n_buckets),
+                                   "dtypes": ["s8", "u8"]},
                 },
                 # the 1-bit phases ship PACKED signs: n/8 u8 elements, far below
                 # the default large-collective floor at test scale — lower it so
                 # the sign exchange is linted, while per-segment scale gathers
-                # (~n_segs elements) still ride free
-                "small_element_threshold": 16,
+                # (~n_segs elements) still ride free. Bucketing splits the sign
+                # payload per bucket, so the overlapped program needs the floor
+                # one notch lower for the smallest bucket's 16-element piece.
+                "small_element_threshold": 8 if n_buckets else 16,
                 "donation": {"check_unusable": True},
                 "strict": True,
             }

@@ -13,7 +13,10 @@ Per program the analysis has two halves:
   window's hiding capacity is priced as ``max(window flops / peak, window
   bytes / HBM bw)`` and whatever the wire time exceeds it by is **exposed**.
   A synchronous collective (the only kind the CPU backend emits) hides
-  nothing — fully exposed, flagged ``zero_overlap``.
+  nothing — fully exposed, flagged ``zero_overlap`` — with one exception:
+  collectives tagged with the bucketed-exchange scope
+  (``comm.hierarchical.GRAD_BUCKET_SCOPE``, ``ds_grad_bucket{k}``) are priced
+  by the bucket-pipeline model below even when the backend serialized them.
 * **Roofline** (utils/roofline.py): compute and HBM floors from the cost
   analysis, plus the exposed-comm seconds split ICI/DCN by the same
   slice-membership rule as ``hlo.collective_axis_bytes`` — together the
@@ -28,6 +31,7 @@ comm comparison (exposed-DCN must drop under the two-level exchange).
 
 import argparse
 import json
+import re
 import sys
 
 from . import hlo
@@ -41,6 +45,84 @@ ANATOMY_REPORT_KIND = "anatomy_report"
 # zero-overlap collectives below this wire size are noise (scalar loss pmeans,
 # norm all-reduces), not optimization opportunities
 DEFAULT_OPPORTUNITY_MIN_BYTES = 1024
+
+# the named_scope the bucketed grad exchange wraps each bucket's chain in
+# (kept textually in sync with comm.hierarchical.GRAD_BUCKET_SCOPE — pinned by
+# tests/unit/test_anatomy.py — so parsing HLO text never imports jax)
+_BUCKET_RE = re.compile(r"ds_grad_bucket(\d+)/")
+
+
+def _bucket_windows(lines):
+    """Per-bucket issue windows of a bucketed grad exchange, from the
+    scheduled entry computation: bucket ``k``'s window runs from the first
+    entry line carrying its ``ds_grad_bucket{k}/`` scope (its producer
+    fusion — the backward compute that completes the bucket's subtree) to the
+    next bucket's first tagged line, and the last bucket's to the entry ROOT
+    (bucketed grad outputs feed nothing but the ROOT tuple, so the wire may
+    stay in flight until the step's end). Returns ``{bucket: (start, end)}``
+    line-index pairs, empty when no bucket scope appears."""
+    entry_start = 0
+    for i in range(len(lines) - 1, -1, -1):
+        if lines[i].startswith("ENTRY "):
+            entry_start = i
+            break
+    firsts = {}
+    root = len(lines) - 1
+    for i in range(entry_start, len(lines)):
+        m = _BUCKET_RE.search(lines[i])
+        if m:
+            firsts.setdefault(int(m.group(1)), i)
+        if lines[i].lstrip().startswith("ROOT "):
+            root = i
+            break
+    order = sorted(firsts.items(), key=lambda kv: kv[1])
+    windows = {}
+    for idx, (k, start) in enumerate(order):
+        end = order[idx + 1][1] if idx + 1 < len(order) else root
+        windows[k] = (start, end)
+    return windows
+
+
+def _price_bucketed(rows, lines, spec):
+    """Overlap pricing for bucket-tagged synchronous collectives — the
+    eager-issue model of the bucketed exchange (docs/overlap.md).
+
+    Buckets are mutually independent chains (bucket k's reduce-scatter
+    consumes only bucket k's producer fusion; its all-gather feeds only the
+    ROOT tuple), so even though the linearized schedule serializes them, an
+    async runtime keeps every bucket's phases in flight simultaneously.
+    Per row the hiding credit is:
+
+    * compute scheduled in the bucket's own issue window (disjoint windows,
+      ``_bucket_windows`` — no compute is double-counted across buckets), and
+    * for **ICI** rows only: the DCN wire seconds of every *other* bucket —
+      the cross-level overlap the split two-level exchange exists to create
+      (bucket k's reduce-scatter/all-gather ride under bucket j's in-flight
+      cross-slice psum on the independent, slower DCN link).
+
+    DCN rows are never credited with ICI wire: the slow link is the
+    exchange's drain and hides only behind real compute. Like the async
+    window model above, this is per-row ceiling accounting — rows do not
+    contend for shared hiding capacity."""
+    tagged = [r for r in rows if not r["async"] and r["bucket"] is not None]
+    if not tagged:
+        return
+    windows = _bucket_windows(lines)
+    dcn_wire = {}
+    for r in tagged:
+        if r["level"] == "dcn":
+            dcn_wire[r["bucket"]] = dcn_wire.get(r["bucket"], 0.0) + r["comm_s"]
+    for r in tagged:
+        win = windows.get(r["bucket"])
+        if win is None:
+            continue
+        hide = _window_hiding_seconds(lines, win[0], win[1], spec)
+        if r["level"] == "ici":
+            hide += sum(s for j, s in dcn_wire.items() if j != r["bucket"])
+        overlap_s = min(r["comm_s"], hide)
+        r["zero_overlap"] = overlap_s <= 0.0
+        r["overlap_s"] = overlap_s
+        r["exposed_s"] = r["comm_s"] - overlap_s
 
 
 def _us(seconds):
@@ -85,10 +167,13 @@ def analyze_program(hlo_text, flops, hbm_bytes, spec, slice_sets=None,
     Returns ``{"name", "flops", "hbm_bytes", "collectives": [...],
     "wire_bytes": {"ici", "dcn"}, "exposed_s": {"ici", "dcn"},
     "roofline": {...}}`` where each collective row carries ``{"instruction",
-    "op", "line", "level", "bytes", "async", "zero_overlap", "comm_s",
-    "overlap_s", "exposed_s"}``. Raises ``ValueError`` on malformed async
-    pairing (propagated from ``hlo.parse_async_pairs``) — an unparseable
-    exposed-comm report must fail loudly.
+    "op", "line", "level", "bytes", "async", "zero_overlap", "bucket",
+    "comm_s", "overlap_s", "exposed_s"}`` (``bucket`` is the
+    ``ds_grad_bucket{k}`` id for bucketed-exchange collectives, else None —
+    tagged synchronous rows are priced by ``_price_bucketed`` instead of the
+    fully-exposed rule). Raises ``ValueError`` on malformed async pairing
+    (propagated from ``hlo.parse_async_pairs``) — an unparseable exposed-comm
+    report must fail loudly.
     """
     lines = hlo_text.splitlines()
     pairs = hlo.parse_async_pairs(hlo_text)
@@ -102,12 +187,14 @@ def analyze_program(hlo_text, flops, hbm_bytes, spec, slice_sets=None,
         hide_s = _window_hiding_seconds(lines, pair["start_line"],
                                         pair["done_line"], spec)
         overlap_s = min(comm_s, hide_s)
+        m = _BUCKET_RE.search(lines[pair["start_line"]])
         rows.append({
             "instruction": pair["name"], "op": pair["op"],
             "line": pair["start_line"],
             "level": _level(pair["groups"], slice_sets),
             "bytes": pair["bytes"], "async": True,
             "zero_overlap": overlap_s <= 0.0,
+            "bucket": int(m.group(1)) if m else None,
             "comm_s": comm_s, "overlap_s": overlap_s,
             "exposed_s": comm_s - overlap_s,
         })
@@ -115,14 +202,18 @@ def analyze_program(hlo_text, flops, hbm_bytes, spec, slice_sets=None,
             hlo_text):
         if line_no in paired_start_lines or line_no in inner_lines:
             continue
-        # synchronous (or unpaired-start, conservatively): fully exposed
+        # synchronous (or unpaired-start, conservatively): fully exposed,
+        # unless bucket-tagged — _price_bucketed reprices those below
         level = _level(groups, slice_sets)
         comm_s = b / (spec.link_gbps(level) * 1e9)
+        m = _BUCKET_RE.search(lines[line_no])
         rows.append({
             "instruction": iname, "op": op, "line": line_no, "level": level,
             "bytes": b, "async": False, "zero_overlap": True,
+            "bucket": int(m.group(1)) if m else None,
             "comm_s": comm_s, "overlap_s": 0.0, "exposed_s": comm_s,
         })
+    _price_bucketed(rows, lines, spec)
     rows.sort(key=lambda r: r["line"])
     wire = {"ici": 0, "dcn": 0}
     exposed = {"ici": 0.0, "dcn": 0.0}
@@ -184,7 +275,8 @@ def _program_json(report):
         "collectives": [{
             "instruction": r["instruction"], "op": r["op"],
             "level": r["level"], "bytes": r["bytes"], "async": r["async"],
-            "zero_overlap": r["zero_overlap"], "comm_us": _us(r["comm_s"]),
+            "zero_overlap": r["zero_overlap"], "bucket": r["bucket"],
+            "comm_us": _us(r["comm_s"]),
             "overlap_us": _us(r["overlap_s"]),
             "exposed_us": _us(r["exposed_s"]),
         } for r in report["collectives"]],
@@ -238,12 +330,14 @@ def to_anatomy_trace_events(reports):
 
 
 def comm_compare(entry_reports):
-    """The flat-vs-hierarchical-vs-compressed exchange comparison: summed
-    exposed-DCN and wire bytes per registry entry, plus the reduction each
-    two-level mode achieves over the flat exchange. ``ok`` iff both
-    hierarchical and compressed expose strictly less DCN time than flat."""
+    """The flat-vs-hierarchical-vs-compressed-vs-overlap exchange comparison:
+    summed exposed-DCN and wire bytes per registry entry, plus the reduction
+    each mode achieves over the flat exchange. ``ok`` iff every two-level
+    mode exposes strictly less DCN time than flat, AND the bucketed overlap
+    mode exposes strictly less DCN than the monolithic hierarchical exchange
+    with exactly zero exposed-ICI on its tagged grad collectives."""
     modes = {"flat": "standard", "hierarchical": "comm_hierarchical",
-             "compressed": "comm_compressed"}
+             "compressed": "comm_compressed", "overlap": "comm_overlap"}
     if not all(entry in entry_reports for entry in modes.values()):
         return None
     out = {}
@@ -256,14 +350,21 @@ def comm_compare(entry_reports):
             "wire_dcn_bytes": sum(r["wire_bytes"]["dcn"] for r in reports),
             "wire_ici_bytes": sum(r["wire_bytes"]["ici"] for r in reports),
         }
+    out["overlap"]["grad_ici_exposed_us"] = _us(sum(
+        c["exposed_s"] for r in entry_reports["comm_overlap"]
+        for c in r["collectives"]
+        if c["bucket"] is not None and c["level"] == "ici"))
     flat_dcn = out["flat"]["exposed_dcn_us"]
     reductions = {}
-    for mode in ("hierarchical", "compressed"):
+    for mode in ("hierarchical", "compressed", "overlap"):
         reductions[mode] = (round(1.0 - out[mode]["exposed_dcn_us"] / flat_dcn,
                                   4) if flat_dcn > 0 else 0.0)
     out["exposed_dcn_reduction_vs_flat"] = reductions
     out["ok"] = (flat_dcn > out["hierarchical"]["exposed_dcn_us"]
-                 and flat_dcn > out["compressed"]["exposed_dcn_us"])
+                 and flat_dcn > out["compressed"]["exposed_dcn_us"]
+                 and (out["hierarchical"]["exposed_dcn_us"]
+                      > out["overlap"]["exposed_dcn_us"])
+                 and out["overlap"]["grad_ici_exposed_us"] == 0.0)
     return out
 
 
@@ -348,6 +449,15 @@ def anatomy_main(argv=None):
 
     all_reports = sorted((r for reports in entry_reports.values()
                           for r in reports), key=lambda r: r["name"])
+    # overlap gate: a bucket-tagged grad collective with zero overlap means
+    # the bucketed exchange failed to create the window it exists for
+    for r in all_reports:
+        for c in r["collectives"]:
+            if c["bucket"] is not None and c["zero_overlap"]:
+                errors.append(
+                    f"{r['name']}#{c['instruction']}: overlap gate: bucketed "
+                    f"grad collective (bucket {c['bucket']}, {c['level']}) "
+                    "has zero overlap")
     compare = comm_compare(entry_reports)
     report = {
         "version": ANATOMY_REPORT_VERSION,
@@ -390,7 +500,9 @@ def anatomy_main(argv=None):
             print(f"comm compare: flat {compare['flat']['exposed_dcn_us']}us "
                   f"exposed DCN; hierarchical "
                   f"-{round(red['hierarchical'] * 100, 2)}%, compressed "
-                  f"-{round(red['compressed'] * 100, 2)}%"
+                  f"-{round(red['compressed'] * 100, 2)}%, overlap "
+                  f"-{round(red['overlap'] * 100, 2)}% (grad ICI exposed "
+                  f"{compare['overlap']['grad_ici_exposed_us']}us)"
                   + ("" if compare["ok"] else "  [NOT LOWER — FAIL]"))
         for e in report["errors"]:
             print(f"ERROR {e}")

@@ -42,13 +42,18 @@ if [ "$serve_rc" -eq 0 ]; then
     serve_rc=$?
 fi
 # anatomy: roofline ledger + overlap analysis over the comm-mode registry
-# entries, with the flat-vs-hierarchical exposed-DCN comparison byte-compared
-# against the committed golden — any pricing or exchange drift fails CI.
-# (`ds-tpu anatomy` itself exits nonzero when the two-level modes stop
-# strictly beating flat.) Full report in /tmp/_anatomy.json (deterministic
-# bytes); /tmp/_anatomy.trace.json is the predicted-schedule Perfetto view.
+# entries, with the flat-vs-hierarchical-vs-overlap exposed-DCN comparison
+# byte-compared against the committed golden — any pricing or exchange drift
+# fails CI. (`ds-tpu anatomy` itself exits nonzero when the two-level modes
+# stop strictly beating flat, when bucketed overlap stops strictly beating
+# the monolithic hierarchical exchange or its grad-ICI exposure leaves zero,
+# or when any overlap-enabled entry reports a zero-overlap bucketed grad
+# collective — the overlap gate.) Full report in /tmp/_anatomy.json
+# (deterministic bytes); /tmp/_anatomy.trace.json is the predicted-schedule
+# Perfetto view.
 timeout -k 10 300 "$REPO/bin/ds-tpu" anatomy --json --out /tmp/_anatomy.json \
     --entry standard --entry comm_hierarchical --entry comm_compressed \
+    --entry comm_overlap --entry comm_overlap_compressed \
     --timeline /tmp/_anatomy.trace.json \
     --comm-compare-out /tmp/_anatomy_comm.json \
 && cmp "$REPO/tests/unit/golden/anatomy_comm_compare.json" \

@@ -16,7 +16,8 @@ Three layers, mirroring the subsystem's own structure:
 
 Regenerate the golden with:
     ds-tpu anatomy --entry standard --entry comm_hierarchical \
-        --entry comm_compressed \
+        --entry comm_compressed --entry comm_overlap \
+        --entry comm_overlap_compressed \
         --comm-compare-out tests/unit/golden/anatomy_comm_compare.json
 """
 
@@ -154,6 +155,73 @@ def test_no_slice_factorization_means_no_dcn():
     assert r["exposed_s"]["ici"] > 0.0
 
 
+# two-bucket grad exchange in the scheduled (synchronous) form the CPU
+# backend emits: each bucket's producer -> reduce-scatter (ici) -> all-reduce
+# (dcn) -> all-gather (ici) chain carries the ds_grad_bucket{k} scope, with a
+# compute instruction inside each bucket's issue window and an untagged loss
+# all-reduce that must keep the fully-exposed sync pricing
+BUCKETED_SYNC = """
+HloModule m
+
+ENTRY main {
+  p0 = f32[1024]{0} parameter(0)
+  prod0 = f32[1024]{0} negate(p0), metadata={op_name="jit(f)/ds_grad_bucket0/pad"}
+  rs0 = f32[256]{0} reduce-scatter(prod0), replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}, to_apply=add, metadata={op_name="jit(f)/ds_grad_bucket0/reduce_scatter"}
+  c0 = f32[1024]{0} add(p0, p0)
+  ar0 = f32[256]{0} all-reduce(rs0), replica_groups={{0,4},{1,5},{2,6},{3,7}}, to_apply=add, metadata={op_name="jit(f)/ds_grad_bucket0/psum"}
+  ag0 = f32[1024]{0} all-gather(ar0), replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}, metadata={op_name="jit(f)/ds_grad_bucket0/all_gather"}
+  prod1 = f32[1024]{0} negate(p0), metadata={op_name="jit(f)/ds_grad_bucket1/reshape"}
+  rs1 = f32[256]{0} reduce-scatter(prod1), replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}, to_apply=add, metadata={op_name="jit(f)/ds_grad_bucket1/reduce_scatter"}
+  c1 = f32[1024]{0} add(p0, p0)
+  ar1 = f32[256]{0} all-reduce(rs1), replica_groups={{0,4},{1,5},{2,6},{3,7}}, to_apply=add, metadata={op_name="jit(f)/ds_grad_bucket1/psum"}
+  ag1 = f32[1024]{0} all-gather(ar1), replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}, metadata={op_name="jit(f)/ds_grad_bucket1/all_gather"}
+  loss = f32[] all-reduce(p0), replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=add
+  ROOT t = (f32[1024]{0}, f32[1024]{0}, f32[]) tuple(ag0, ag1, loss)
+}
+"""
+
+
+def test_bucket_scope_regex_matches_comm_constant():
+    """anatomy parses HLO text without importing jax, so it carries its own
+    copy of the bucket scope — pin it to the comm subsystem's constant."""
+    from deepspeed_tpu.comm.hierarchical import GRAD_BUCKET_SCOPE
+    m = anatomy._BUCKET_RE.search(f"op_name=\"x/{GRAD_BUCKET_SCOPE}7/psum\"")
+    assert m is not None and m.group(1) == "7"
+
+
+def test_bucketed_sync_collectives_get_overlap_credit():
+    """The eager-issue pricing of the bucketed exchange: every tagged ICI
+    phase hides fully under the other bucket's in-flight DCN wire (equal
+    buckets: all-gather wire time == the peer DCN psum wire time at the
+    cpu-test 4x ICI:DCN ratio), the DCN phases hide only behind the compute
+    in their own issue window (partial), and the untagged loss all-reduce
+    keeps the fully-exposed synchronous pricing."""
+    r = anatomy.analyze_program(BUCKETED_SYNC, 0, 0, SPEC,
+                                slice_sets=SLICE_SETS, name="b")
+    rows = r["collectives"]
+    assert [row["bucket"] for row in rows] == [0, 0, 0, 1, 1, 1, None]
+    for row in rows:
+        if row["bucket"] is None:
+            continue
+        assert not row["async"] and not row["zero_overlap"]
+        if row["level"] == "ici":
+            assert row["exposed_s"] == pytest.approx(0.0)
+            assert row["overlap_s"] == pytest.approx(row["comm_s"])
+        else:
+            # window compute (one 4 KB add) hides part of the DCN psum
+            assert 0 < row["overlap_s"] < row["comm_s"]
+            assert row["exposed_s"] == pytest.approx(
+                row["comm_s"] - row["overlap_s"])
+    loss = rows[-1]
+    assert loss["bucket"] is None and loss["zero_overlap"]
+    assert loss["exposed_s"] == pytest.approx(loss["comm_s"])
+    assert r["exposed_s"]["ici"] == pytest.approx(0.0)
+    # both DCN psums partially exposed — strictly between 0 and full wire
+    dcn_wire = sum(row["comm_s"] for row in rows
+                   if row["level"] == "dcn" and row["bucket"] is not None)
+    assert 0 < r["exposed_s"]["dcn"] < dcn_wire + loss["comm_s"]
+
+
 def test_opportunities_threshold_and_order():
     big = anatomy.analyze_program(EMPTY_WINDOW, 0, 0, SPEC, SLICE_SETS, "big")
     small = anatomy.analyze_program(SYNC_ONLY, 0, 0, SPEC, SLICE_SETS, "small")
@@ -256,11 +324,12 @@ def test_anatomy_keeps_every_step_path_hlo_identical(path, tmp_path):
 
 @pytest.fixture(scope="module")
 def comm_entry_reports():
-    """Anatomy reports for the flat/hierarchical/compressed registry entries,
-    captured once per module (three engine builds)."""
+    """Anatomy reports for the flat/hierarchical/compressed/overlap registry
+    entries, captured once per module (five engine builds)."""
     from deepspeed_tpu.lint import registry
     out = {}
-    for entry in ("standard", "comm_hierarchical", "comm_compressed"):
+    for entry in ("standard", "comm_hierarchical", "comm_compressed",
+                  "comm_overlap", "comm_overlap_compressed"):
         artifacts = registry.capture_entry(entry)
         out[entry] = [anatomy.analyze_artifact(a, SPEC, slice_sets=SLICE_SETS)
                       for a in artifacts]
@@ -276,6 +345,22 @@ def test_hierarchical_and_compressed_expose_less_dcn(comm_entry_reports):
     assert flat > 0
     assert dcn("comm_hierarchical") < flat
     assert dcn("comm_compressed") < flat
+
+
+def test_overlap_entry_grad_collectives_are_bucketed_and_hidden(
+        comm_entry_reports):
+    """The overlap acceptance shape on the real registry programs: the
+    bucketed exchange's collectives carry their bucket ids, every ICI phase
+    is fully hidden (exposed == 0), nothing bucketed is zero-overlap, and no
+    grad collective survives into the opportunity list."""
+    reports = {r["name"]: r for r in comm_entry_reports["comm_overlap"]}
+    rows = reports["comm_overlap:loss_and_grad"]["collectives"]
+    tagged = [r for r in rows if r["bucket"] is not None]
+    assert {r["bucket"] for r in tagged} == {0, 1, 2}
+    assert all(not r["zero_overlap"] for r in tagged)
+    assert all(r["exposed_s"] == 0.0 for r in tagged if r["level"] == "ici")
+    opps = anatomy.opportunities(comm_entry_reports["comm_overlap"])
+    assert not [o for o in opps if "loss_and_grad" in o["program"]], opps
 
 
 def test_zero_grad_collective_is_flagged_zero_overlap(comm_entry_reports):

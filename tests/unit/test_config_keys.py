@@ -154,6 +154,14 @@ SWEEP = {
         ({"mode": "ring"}, ("raise", ValueError)),
         ({"dcn_slices": -1}, ("raise", ValueError)),
         ({"compress_start_step": -3}, ("raise", ValueError)),
+        ({"overlap": {"mode": "bucketed"}},
+         ("attr", "comm_overlap_mode", "bucketed")),
+        ({"overlap": {"mode": "bucketed", "bucket_mb": 12.5}},
+         ("attr", "comm_overlap_bucket_mb", 12.5)),
+        ({"overlap": {}}, ("attr", "comm_overlap_mode", "off")),
+        ({"overlap": {"mode": "eager"}}, ("raise", ValueError)),
+        ({"overlap": {"bucket_mb": 0}}, ("raise", ValueError)),
+        ({"overlap": {"bucket_mb": True}}, ("raise", ValueError)),
     ),
     "sparse_attention": ({"mode": "fixed", "block": 16},
                          ("attr_pred", lambda c: c.sparse_attention.mode == "fixed")),
@@ -251,6 +259,20 @@ def test_unknown_request_trace_slo_key_warns(capture):
     assert "ttft_ms" in capture.text     # the known-keys hint points at the fix
 
 
+def test_unknown_comm_key_warns(capture):
+    _cfg(comm={"mode": "hierarchical", "dcn_slicez": 2})
+    assert "unknown comm config key" in capture.text
+    assert "dcn_slicez" in capture.text
+    assert "dcn_slices" in capture.text  # the known-keys hint points at the fix
+
+
+def test_unknown_comm_overlap_key_warns(capture):
+    _cfg(comm={"overlap": {"mode": "bucketed", "bucket_md": 25}})
+    assert "unknown comm.overlap config key" in capture.text
+    assert "bucket_md" in capture.text
+    assert "bucket_mb" in capture.text   # the known-keys hint points at the fix
+
+
 def test_unknown_numerics_key_warns(capture):
     _cfg(numerics={"enabled": True, "ring_sz": 4})
     assert "unknown numerics config key" in capture.text
@@ -264,7 +286,9 @@ def test_known_nested_keys_do_not_warn(capture):
                                 "dcn_gbps": 25.0}},
          numerics={"enabled": True, "audit_interval": 3},
          serving={"request_trace": {"enabled": True, "capacity": 64,
-                                    "slo": {"ttft_ms": 250.0, "tpot_ms": 40.0}}})
+                                    "slo": {"ttft_ms": 250.0, "tpot_ms": 40.0}}},
+         comm={"mode": "hierarchical", "dcn_slices": 2,
+               "overlap": {"mode": "bucketed", "bucket_mb": 25.0}})
     assert "unknown" not in capture.text
 
 
