@@ -80,7 +80,21 @@ REGRESSION_KEYS = (
     "extra.decode_420m.greedy_tok_s",
     "extra.serving_420m.tok_s",
     "extra.serving_420m.goodput_tok_s",
+    # serving latency ledger: TTFT percentiles regress independently of tok/s
+    # (e.g. a scheduler change that favors decode over prefill admission) —
+    # note lower-is-better keys flag on RISES via the inverted delta below
+    "extra.serving_420m.ttft_ms_p50",
+    "extra.serving_420m.ttft_ms_p95",
+    # prefix-cache efficacy + sharded-decode throughput
+    "extra.serving_420m_prefix_cache.prefix_cache_hit_rate",
+    "extra.serving_420m_prefix_cache.ttft_ms_p50",
+    "extra.serving_420m_sharded.tok_s",
 )
+
+# keys where LOWER is better (latency): a regression is a RISE past the
+# threshold, so their delta sign is inverted before the flag check
+LOWER_IS_BETTER_KEYS = frozenset(
+    k for k in REGRESSION_KEYS if k.endswith("_ms_p50") or k.endswith("_ms_p95"))
 
 
 def regression_vs_previous_round(current, threshold_pct=5.0):
@@ -103,7 +117,8 @@ def regression_vs_previous_round(current, threshold_pct=5.0):
             continue
         delta = 100.0 * (now - was) / was
         row = {"prev": was, "cur": now, "delta_pct": round(delta, 2)}
-        if delta < -threshold_pct:
+        worse = -delta if key in LOWER_IS_BETTER_KEYS else delta
+        if worse < -threshold_pct:
             row["regressed"] = True
             out["regressed"].append(key)
         out["metrics"][key] = row
@@ -579,7 +594,8 @@ def bench_decode_420m():
 
 def bench_serving_summary(cfg_kwargs, *, n_requests, num_slots, block_size,
                           num_blocks, max_model_len, prefill_chunk,
-                          param_dtype=None, seed=11):
+                          param_dtype=None, seed=11, prefix_cache=False,
+                          sharding=1, shared_prefix=0):
     """Continuous-batching serving summary (docs/serving.md): replay a seeded
     mixed greedy/beam trace through the InferenceEngine and report tok/s,
     TTFT/TPOT latency percentiles (request-trace ledger), preemption-waste
@@ -610,10 +626,13 @@ def bench_serving_summary(cfg_kwargs, *, n_requests, num_slots, block_size,
             "enabled": True, "max_seqs": num_slots, "block_size": block_size,
             "num_blocks": num_blocks, "max_model_len": max_model_len,
             "prefill_chunk": prefill_chunk,
+            "prefix_cache": {"enabled": prefix_cache},
+            "sharding": {"model": sharding},
             "request_trace": {"enabled": True,
                               "capacity": max(n_requests + 1, 256)}}})
     reqs = synth_trace(n_requests, vocab_size=cfg.vocab_size,
-                       max_model_len=max_model_len, seed=seed)
+                       max_model_len=max_model_len, seed=seed,
+                       shared_prefix_len=shared_prefix)
     t0 = time.time()
     outs, logs = eng.run(reqs)
     wall = max(time.time() - t0, 1e-9)
@@ -623,8 +642,18 @@ def bench_serving_summary(cfg_kwargs, *, n_requests, num_slots, block_size,
     recompiles = sum(session.watchdog.recompiles(n)
                      for n in session.watchdog.records
                      if n.startswith("serve:"))
+    cache_extra = {}
+    if eng.prefix_cache is not None:
+        cs = eng.prefix_cache.stats()
+        cache_extra = {
+            "prefix_cache_hit_rate": round(cs["hit_rate"], 4),
+            "cached_token_fraction": round(cs["cached_token_fraction"], 4),
+            "cached_prefix_tokens": cs["hit_tokens"],
+            "prefix_cache_evictions": cs["evictions"]}
     return {"requests": len(reqs), "finished": len(fin),
             "iterations": len(logs), "wall_s": round(wall, 2),
+            **({"sharding_model_ways": sharding} if sharding > 1 else {}),
+            **cache_extra,
             # tok_s counts every sampled token (all beam lanes, preempted
             # work included); goodput only tokens of finished requests
             "tok_s": round(eng._tokens_sampled / wall, 1),
@@ -652,6 +681,27 @@ def bench_serving_smoke():
         max_model_len=64, prefill_chunk=16)
 
 
+def bench_serving_prefix_cache_smoke():
+    """Prefix-cache smoke: shared-system-prompt trace, cache on — reports
+    hit-rate / cached-token fraction next to the same tok/s columns."""
+    return bench_serving_summary(
+        dict(vocab_size=256, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+             loss_chunk=0),
+        n_requests=16, num_slots=4, block_size=8, num_blocks=33,
+        max_model_len=64, prefill_chunk=16, prefix_cache=True,
+        shared_prefix=24)
+
+
+def bench_serving_sharded_smoke():
+    """Model-axis sharded smoke (2-way head shard over the CPU mesh) — the
+    sharded-decode tok/s column of the regression ledger."""
+    return bench_serving_summary(
+        dict(vocab_size=256, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+             loss_chunk=0),
+        n_requests=16, num_slots=4, block_size=8, num_blocks=33,
+        max_model_len=64, prefill_chunk=16, sharding=2)
+
+
 def bench_serving_420m():
     """TPU serving path: GPT-2 420M bf16, 32-request mixed trace."""
     import jax.numpy as jnp
@@ -660,6 +710,33 @@ def bench_serving_420m():
              n_head=16, use_flash_attention=True),
         n_requests=32, num_slots=8, block_size=16, num_blocks=513,
         max_model_len=1024, prefill_chunk=128, param_dtype=jnp.bfloat16)
+    gc.collect()
+    return out
+
+
+def bench_serving_420m_prefix_cache():
+    """420M shared-system-prompt trace with the prefix cache on: the TTFT
+    delta vs ``serving_420m`` prices what cross-request reuse buys at size."""
+    import jax.numpy as jnp
+    out = bench_serving_summary(
+        dict(vocab_size=50304, n_positions=1024, n_embd=1024, n_layer=24,
+             n_head=16, use_flash_attention=True),
+        n_requests=32, num_slots=8, block_size=16, num_blocks=513,
+        max_model_len=1024, prefill_chunk=128, param_dtype=jnp.bfloat16,
+        prefix_cache=True, shared_prefix=256)
+    gc.collect()
+    return out
+
+
+def bench_serving_420m_sharded():
+    """420M decode sharded 2 ways over the model axis by attention head."""
+    import jax.numpy as jnp
+    out = bench_serving_summary(
+        dict(vocab_size=50304, n_positions=1024, n_embd=1024, n_layer=24,
+             n_head=16, use_flash_attention=True),
+        n_requests=32, num_slots=8, block_size=16, num_blocks=513,
+        max_model_len=1024, prefill_chunk=128, param_dtype=jnp.bfloat16,
+        sharding=2)
     gc.collect()
     return out
 
@@ -1010,6 +1087,14 @@ def main():
             serving = bench_serving_smoke()
         except Exception as e:
             serving = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            serving_prefix = bench_serving_prefix_cache_smoke()
+        except Exception as e:
+            serving_prefix = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            serving_sharded = bench_serving_sharded_smoke()
+        except Exception as e:
+            serving_sharded = {"error": f"{type(e).__name__}: {e}"}
         anatomy = telemetry.get("anatomy") or {}
         result = {"metric": "gpt2_tokens_per_sec_per_chip_cpu_smoke",
                   "value": round(tps, 1), "unit": "tokens/s", "vs_baseline": 0.0,
@@ -1021,7 +1106,9 @@ def main():
                             "anatomy_predicted_floor_ms":
                                 anatomy.get("predicted_floor_ms"),
                             "pipeline_goodput": pipeline_goodput,
-                            "serving": serving}}
+                            "serving": serving,
+                            "serving_prefix_cache": serving_prefix,
+                            "serving_sharded": serving_sharded}}
         result["extra"]["regression_vs_previous_round"] = \
             regression_vs_previous_round(result)
         print(json.dumps(result))
@@ -1071,6 +1158,14 @@ def main():
         extra["serving_420m"] = bench_serving_420m()
     except Exception as e:
         extra["serving_420m"] = {"error": f"{type(e).__name__}: {e}"}
+    try:  # prefix-cache TTFT delta + hit-rate on a shared-prompt trace
+        extra["serving_420m_prefix_cache"] = bench_serving_420m_prefix_cache()
+    except Exception as e:
+        extra["serving_420m_prefix_cache"] = {"error": f"{type(e).__name__}: {e}"}
+    try:  # model-axis sharded decode tok/s
+        extra["serving_420m_sharded"] = bench_serving_420m_sharded()
+    except Exception as e:
+        extra["serving_420m_sharded"] = {"error": f"{type(e).__name__}: {e}"}
     mp = max_params_offload()
     extra["max_trainable_params_per_chip_zero_offload"] = int(mp)
     if os.environ.get("DS_BENCH_SKIP_WORKLOADS", "0") != "1":

@@ -84,6 +84,9 @@ def init_inference(model=None,
         prefill_chunk=ds_config.serving_prefill_chunk,
         use_pallas=ds_config.serving_use_pallas_decode,
         telemetry=telemetry, mirror=mirror,
+        prefix_cache=ds_config.serving_prefix_cache_enabled,
+        sharding={"model": ds_config.serving_sharding_model}
+        if ds_config.serving_sharding_model > 1 else None,
         request_trace={
             "enabled": ds_config.serving_request_trace_enabled,
             "capacity": ds_config.serving_request_trace_capacity,

@@ -262,6 +262,20 @@ def _build_serving():
     return eng, None
 
 
+def _build_serving_sharded():
+    # model-axis sharded serving: same programs lowered over a 2-way head
+    # shard. The manifests tighten to a collective BUDGET — decode/prefill
+    # must contain exactly n_layer f32 all-reduces (the per-layer proj psum)
+    # and nothing else; copy_blocks must stay collective-free (the block axis
+    # is unsharded, so GSPMD has nothing to exchange)
+    from ..serve.engine import InferenceEngine
+    model, params = _tiny_gpt2()
+    eng = InferenceEngine(model, params, num_slots=4, block_size=4,
+                          num_blocks=17, max_model_len=32, prefill_chunk=8,
+                          sharding={"model": 2})
+    return eng, None
+
+
 BUILDERS = {
     "standard": _build_standard,
     "external_master_fused": _build_external_master_fused,
@@ -274,6 +288,7 @@ BUILDERS = {
     "pipeline": _build_pipeline,
     "gpt2_decode": _build_gpt2_decode,
     "serving": _build_serving,
+    "serving_sharded": _build_serving_sharded,
 }
 
 

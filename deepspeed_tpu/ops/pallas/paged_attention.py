@@ -17,6 +17,13 @@ test (tests/unit/test_paged_attention.py).
 
 ``interpret=True`` (automatic off-TPU) runs the same grid sequentially on
 CPU — scratch persistence across the page dimension matches TPU semantics.
+
+Head sharding: under ``serving.sharding.model`` the engine invokes this kernel
+inside ``shard_map`` with the pool's head axis already split, so ``n_head``
+here is the PER-SHARD head count and the pool refs are the shard-local pages.
+Nothing in the kernel is head-global — the softmax reduces over each head's
+own pages independently — so the same kernel body serves both layouts; the
+cross-shard f32 psum lives in the caller's projection, not here.
 """
 
 import functools

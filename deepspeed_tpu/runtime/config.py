@@ -387,6 +387,24 @@ class DeepSpeedConfig:
                     f"{attr[len('serving_slo_'):]} must be a number >= 0 "
                     f"(0 = not gated), got {val!r}")
 
+        sh_dict = sv_dict.get(SERVING_SHARDING, {}) or {}
+        self._warn_unknown_nested(f"{SERVING}.{SERVING_SHARDING}",
+                                  sh_dict, SERVING_SHARDING_CONFIG_KEYS)
+        self.serving_sharding_model = get_scalar_param(
+            sh_dict, SERVING_SHARDING_MODEL, SERVING_SHARDING_MODEL_DEFAULT)
+        val = self.serving_sharding_model
+        if isinstance(val, bool) or not isinstance(val, int) or val < 1:
+            raise ValueError(
+                "DeepSpeedConfig: serving.sharding.model must be an int >= 1 "
+                f"(1 = single-chip), got {val!r}")
+
+        pc_dict = sv_dict.get(SERVING_PREFIX_CACHE, {}) or {}
+        self._warn_unknown_nested(f"{SERVING}.{SERVING_PREFIX_CACHE}",
+                                  pc_dict, SERVING_PREFIX_CACHE_CONFIG_KEYS)
+        self.serving_prefix_cache_enabled = get_scalar_param(
+            pc_dict, SERVING_PREFIX_CACHE_ENABLED,
+            SERVING_PREFIX_CACHE_ENABLED_DEFAULT)
+
         cm_dict = param_dict.get(COMM, {})
         self._warn_unknown_nested(COMM, cm_dict, COMM_CONFIG_KEYS)
         self.comm_mode = get_scalar_param(cm_dict, COMM_MODE, COMM_MODE_DEFAULT)

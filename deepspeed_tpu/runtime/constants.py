@@ -280,6 +280,21 @@ SERVING_SLO_TTFT_MS = "ttft_ms"                      # 0.0 = metric not gated
 SERVING_SLO_TTFT_MS_DEFAULT = 0.0
 SERVING_SLO_TPOT_MS = "tpot_ms"
 SERVING_SLO_TPOT_MS_DEFAULT = 0.0
+# serving.sharding — model-axis tensor parallelism for the serving engine:
+# the per-layer KV pools and attention compute are sharded over "model"
+# devices by attention head (n_head must divide evenly); activations stay
+# replicated and each layer's output projection does one f32 all-reduce.
+# model=1 (the default) is the exact single-chip path, byte-identical HLO.
+SERVING_SHARDING = "sharding"
+SERVING_SHARDING_MODEL = "model"
+SERVING_SHARDING_MODEL_DEFAULT = 1
+# serving.prefix_cache — cross-request prompt-prefix reuse: full prompt
+# blocks are content-keyed at decode start (and at preemption, enabling warm
+# restarts), parked in the allocator's LRU cached tier on last free, and
+# remapped into new block tables on admission instead of re-prefilled.
+SERVING_PREFIX_CACHE = "prefix_cache"
+SERVING_PREFIX_CACHE_ENABLED = "enabled"
+SERVING_PREFIX_CACHE_ENABLED_DEFAULT = False
 
 #############################################
 # Comm (hierarchical ICI+DCN collectives)
@@ -502,6 +517,16 @@ SERVING_CONFIG_KEYS = frozenset({
     SERVING_PREFILL_CHUNK,
     SERVING_USE_PALLAS_DECODE,
     SERVING_REQUEST_TRACE,
+    SERVING_SHARDING,
+    SERVING_PREFIX_CACHE,
+})
+
+SERVING_SHARDING_CONFIG_KEYS = frozenset({
+    SERVING_SHARDING_MODEL,
+})
+
+SERVING_PREFIX_CACHE_CONFIG_KEYS = frozenset({
+    SERVING_PREFIX_CACHE_ENABLED,
 })
 
 SERVING_REQUEST_TRACE_CONFIG_KEYS = frozenset({

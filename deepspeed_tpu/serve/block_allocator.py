@@ -19,9 +19,18 @@ Design points (vLLM's PagedAttention memory model):
   by incrementing refcounts; a writer that needs an exclusive page calls
   :meth:`ensure_exclusive`, which returns the ``(src, dst)`` page copy the
   caller must mirror on-device (paged.copy_blocks) when the page was shared.
+- **Cached tier** (SGLang's RadixAttention eviction model): a page registered
+  through :meth:`register_cached` parks in an LRU *cached* tier when its last
+  reference drops instead of returning to the free list — its KV bytes stay
+  valid on device, so a later prefix hit revives it for free. Allocation
+  drains the free list first and only then evicts cached pages oldest-first
+  (``evict_hook`` tells the prefix cache its key died), so cached prefixes
+  are reclaimed under pressure *before* admission is ever refused. With no
+  registrations the tier is empty and every path below is bit-identical to
+  the pre-cache allocator.
 """
 
-from collections import deque
+from collections import OrderedDict, deque
 
 NULL_BLOCK = 0
 
@@ -42,27 +51,42 @@ class BlockAllocator:
         self.block_size = int(block_size)
         self._free = deque(range(1, self.num_blocks))   # block 0 reserved
         self._refcount = {}                              # block -> int (>0)
+        # prefix-cache tier: block -> cache key while registered (live OR
+        # parked); parked zero-ref pages sit in ``_cached`` oldest-first
+        self._cache_keys = {}
+        self._cached = OrderedDict()
+        self._evict_hook = None
         # cumulative free-list traffic counters for the serving request-trace
         # pool timeline (monotonic; never reset)
         self.alloc_count = 0        # pages handed out
         self.free_count = 0         # pages returned to the free list
         self.fork_count = 0         # page references added by table forks
         self.cow_copies = 0         # shared pages copied by ensure_exclusive
+        self.cached_count = 0       # pages parked in the cached tier
+        self.cache_evictions = 0    # parked pages reclaimed under pressure
+        self.cache_revivals = 0     # parked pages brought back by a hit
 
     # ------------------------------------------------------------- queries
     @property
     def num_free(self) -> int:
-        return len(self._free)
+        """Allocatable pages: truly free plus evictable cached prefixes —
+        admission control must see cached pages as reclaimable, or the cache
+        would shrink effective pool capacity."""
+        return len(self._free) + len(self._cached)
+
+    @property
+    def num_cached(self) -> int:
+        return len(self._cached)
 
     @property
     def num_used(self) -> int:
-        return self.num_blocks - 1 - len(self._free)
+        return self.num_blocks - 1 - self.num_free
 
     def blocks_for_tokens(self, num_tokens: int) -> int:
         return -(-int(num_tokens) // self.block_size)  # ceil div
 
     def can_allocate(self, num_blocks: int) -> bool:
-        return num_blocks <= len(self._free)
+        return num_blocks <= self.num_free
 
     def refcount(self, block: int) -> int:
         return self._refcount.get(block, 0)
@@ -76,21 +100,31 @@ class BlockAllocator:
 
     # ------------------------------------------------------- alloc/free/fork
     def allocate(self, num_blocks: int) -> list:
-        if num_blocks > len(self._free):
+        if num_blocks > len(self._free) + len(self._cached):
             raise AllocationError(
                 f"requested {num_blocks} KV pages with {len(self._free)} free "
-                f"(pool {self.num_blocks - 1} usable pages of "
-                f"{self.block_size} tokens)")
-        out = [self._free.popleft() for _ in range(num_blocks)]
-        for b in out:
+                f"+ {len(self._cached)} cached (pool {self.num_blocks - 1} "
+                f"usable pages of {self.block_size} tokens)")
+        out = []
+        for _ in range(num_blocks):
+            if self._free:
+                b = self._free.popleft()
+            else:
+                # pressure: reclaim the least-recently-parked cached prefix
+                b, key = self._cached.popitem(last=False)
+                del self._cache_keys[b]
+                self.cache_evictions += 1
+                if self._evict_hook is not None:
+                    self._evict_hook(b, key)
             self._refcount[b] = 1
+            out.append(b)
         self.alloc_count += num_blocks
         return out
 
     def free(self, blocks) -> None:
-        """Drop one reference per block; pages return to the free list when
-        their last reference goes. Order of return is the order given —
-        deterministic for replay."""
+        """Drop one reference per block. A last-reference page parks in the
+        cached tier when registered, else returns to the free list. Order of
+        return is the order given — deterministic for replay."""
         for b in blocks:
             if b == NULL_BLOCK:
                 continue
@@ -99,8 +133,12 @@ class BlockAllocator:
                 raise ValueError(f"double free of block {b}")
             if c == 1:
                 del self._refcount[b]
-                self._free.append(b)
-                self.free_count += 1
+                if b in self._cache_keys:
+                    self._cached[b] = self._cache_keys[b]   # newest LRU slot
+                    self.cached_count += 1
+                else:
+                    self._free.append(b)
+                    self.free_count += 1
             else:
                 self._refcount[b] = c - 1
 
@@ -130,3 +168,42 @@ class BlockAllocator:
         self._refcount[block] = c - 1
         self.cow_copies += 1
         return fresh, (block, fresh)
+
+    # ------------------------------------------------------------ cache tier
+    def set_evict_hook(self, fn) -> None:
+        """``fn(block, key)`` fires when a parked cached page is reclaimed by
+        :meth:`allocate` — its device bytes are about to be overwritten, so
+        the prefix cache must forget the key."""
+        self._evict_hook = fn
+
+    def register_cached(self, block: int, key) -> None:
+        """Mark a live page as prefix-cache backed under ``key``: its last
+        free parks it in the cached tier instead of the free list. Idempotent
+        re-registration under the same key is a no-op; re-keying is a bug."""
+        if block not in self._refcount:
+            raise ValueError(f"register_cached of unallocated block {block}")
+        old = self._cache_keys.get(block)
+        if old is not None and old != key:
+            raise ValueError(f"block {block} already cached under another key")
+        self._cache_keys[block] = key
+
+    def is_parked(self, block: int) -> bool:
+        return block in self._cached
+
+    def add_ref(self, block: int) -> None:
+        """One more reference on a live page — a prefix hit mapping a shared
+        block into a new table (same bookkeeping as a single-block fork)."""
+        if block not in self._refcount:
+            raise ValueError(f"add_ref of unallocated block {block}")
+        self._refcount[block] += 1
+        self.fork_count += 1
+
+    def revive(self, block: int) -> None:
+        """A prefix hit on a parked page: leave the cached tier, refcount 1.
+        The page keeps its registration, so it re-parks on its next last
+        free — that re-park lands at the newest LRU slot (the touch)."""
+        if block not in self._cached:
+            raise ValueError(f"revive of non-parked block {block}")
+        del self._cached[block]
+        self._refcount[block] = 1
+        self.cache_revivals += 1

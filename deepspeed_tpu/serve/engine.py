@@ -40,7 +40,7 @@ class InferenceEngine:
     def __init__(self, model, params, *, num_slots=8, block_size=16,
                  num_blocks=257, max_model_len=256, prefill_chunk=32,
                  use_pallas=False, telemetry=None, mirror=False,
-                 request_trace=None):
+                 request_trace=None, prefix_cache=False, sharding=None):
         c = model.config
         if max_model_len % block_size != 0:
             raise ValueError(f"max_model_len {max_model_len} not a multiple "
@@ -52,6 +52,33 @@ class InferenceEngine:
             raise ValueError("serving supports dense models only (no MoE)")
         if getattr(c, "sparse_attention", None):
             raise ValueError("serving supports dense attention only")
+        if isinstance(sharding, dict):
+            tp = int(sharding.get("model", 1))
+        else:
+            tp = int(sharding or 1)
+        if tp < 1:
+            raise ValueError(f"serving.sharding.model must be >= 1, got {tp}")
+        if tp > 1:
+            import jax
+            if c.n_head % tp:
+                raise ValueError(f"n_head {c.n_head} not divisible by "
+                                 f"serving.sharding.model {tp}")
+            if tp > len(jax.devices()):
+                raise ValueError(f"serving.sharding.model {tp} exceeds "
+                                 f"{len(jax.devices())} devices")
+            if mirror:
+                raise ValueError(
+                    "mirror asserts bitwise identity against the dense "
+                    "oracle; the sharded proj psum reorders each reduction "
+                    "(token-identical, not bitwise) — run the mirror on an "
+                    "unsharded engine")
+        if mirror and prefix_cache:
+            raise ValueError(
+                "mirror cannot run with prefix_cache: a warm start skips "
+                "prefill chunks whose KV the dense per-slot oracle no longer "
+                "holds (its cache is overwritten on slot reuse) — prove "
+                "bitwise identity on a cache-off engine instead")
+        self.tp = tp
         self.model = model
         self.params = params
         self.num_slots = int(num_slots)
@@ -75,10 +102,24 @@ class InferenceEngine:
                 slo=rt.get("slo"),
                 host_id=rt.get("host_id", 0))
 
+        self._mesh = None
+        if tp > 1:
+            import jax
+            from ..comm.topology import CommTopology
+            from ..parallel.mesh import build_mesh
+            self._mesh = build_mesh(data=1, model=tp, pipe=1,
+                                    devices=jax.devices()[:tp])
+            if self.telemetry is not None:
+                # classify the decode/prefill psums' links for the anatomy
+                # ledger: the model axis of one serving replica rides a
+                # single slice, so its collectives are all-ICI wire
+                topo = CommTopology(tp, 1)
+                self.telemetry.set_comm_topology(
+                    topo.slice_device_sets(self._mesh))
         self._raw = build_paged_programs(
             model, num_slots=self.num_slots, block_size=self.block_size,
             max_blocks=self.max_blocks, prefill_chunk=self.prefill_chunk,
-            use_pallas=use_pallas)
+            use_pallas=use_pallas, mesh=self._mesh)
         self._decode = self._watch("serve:decode_step", self._raw["decode_step"])
         self._prefill = self._watch("serve:prefill_chunk",
                                     self._raw["prefill_chunk"])
@@ -90,11 +131,18 @@ class InferenceEngine:
                       c.n_head, c.head_dim)
         self.k_pool = jnp.zeros(pool_shape, c.compute_dtype)
         self.v_pool = jnp.zeros(pool_shape, c.compute_dtype)
+        if self._mesh is not None:
+            import jax
+            self.k_pool = jax.device_put(self.k_pool,
+                                         self._raw["pool_sharding"])
+            self.v_pool = jax.device_put(self.v_pool,
+                                         self._raw["pool_sharding"])
 
         self.scheduler = Scheduler(
             num_slots=self.num_slots, num_blocks=self.num_blocks,
             block_size=self.block_size, max_model_len=self.max_model_len,
-            prefill_chunk=self.prefill_chunk)
+            prefill_chunk=self.prefill_chunk, prefix_cache=prefix_cache)
+        self.prefix_cache = self.scheduler.prefix_cache
 
         self._mirror = None
         self.mirror_checks = 0
@@ -177,6 +225,12 @@ class InferenceEngine:
         self._scalar("occupancy", sched.occupancy())
         self._scalar("waiting", len(sched.waiting))
         self._scalar("free_blocks", sched.allocator.num_free)
+        if self.prefix_cache is not None:
+            pc = self.prefix_cache.stats()
+            self._scalar("PrefixCache/hit_rate", pc["hit_rate"])
+            self._scalar("PrefixCache/hit_tokens", pc["hit_tokens"])
+            self._scalar("PrefixCache/parked_blocks", pc["parked_blocks"])
+            self._scalar("PrefixCache/evictions", pc["evictions"])
         elapsed = max(time.perf_counter() - self._start_wall, 1e-9)
         self._scalar("tok_s", self._tokens_sampled / elapsed)
         self._scalar("goodput_tok_s", self._tokens_finished / elapsed)
@@ -467,6 +521,29 @@ class InferenceEngine:
             "strict": True,
             "any_reduction": {"max": 0},
         }
+        copy_manifest = manifest
+        if self.tp > 1:
+            # head-sharded programs: exactly one f32 proj psum per layer and
+            # nothing else on the wire — threshold 0 so even a tiny stray
+            # resharding collective fails the budget, not just a large one
+            manifest = {
+                "compute_dtype": compute,
+                "donation": {"check_unusable": True,
+                             "min_undonated_bytes": 1024},
+                "strict": True,
+                "small_element_threshold": 0,
+                "collectives": {"all-reduce": {"min": c.n_layer,
+                                               "max": c.n_layer,
+                                               "dtypes": ["f32"]}},
+            }
+            copy_manifest = {
+                "compute_dtype": compute,
+                "donation": {"check_unusable": True,
+                             "min_undonated_bytes": 1024},
+                "strict": True,
+                "small_element_threshold": 0,
+                "any_reduction": {"max": 0},
+            }
         S, MB, C, P = (self.num_slots, self.max_blocks, self.prefill_chunk,
                        self.copy_width)
         pool_shape = (c.n_layer, self.num_blocks, self.block_size,
@@ -483,5 +560,5 @@ class InferenceEngine:
               jnp.int32(1), jnp.zeros(MB, jnp.int32), kp, vp), manifest),
             ("serve_copy_blocks", self._raw["copy_blocks"],
              (kp, vp, jnp.zeros(P, jnp.int32), jnp.zeros(P, jnp.int32)),
-             manifest),
+             copy_manifest),
         ]

@@ -22,6 +22,26 @@ the per-slot-position dense mirror for mixed traces.
 All cache/pool arguments are donated (the lesson of the relay-kill crashes,
 models/gpt2.py): XLA aliases one pool buffer through every program, so serving
 HBM is params + pool + activations — never 2x pool.
+
+**Model-axis sharding** (``mesh=`` a Mesh carrying a ``model`` axis of size
+``tp``): the KV pool is sharded by attention head — each chip holds
+``[n_layer, num_blocks, block_size, n_head/tp, head_dim]`` — and decode /
+prefill lower as one pjit program over that axis via ``shard_map``. Per
+shard: slice the local head columns of ``c_attn_w`` (rows of ``c_proj_w``)
+by ``axis_index``, run attention against the *local* pool shard (the block
+table is replicated, pages are local — the same table steers every shard's
+gather, including the Pallas kernel's BlockSpec index maps, which are
+shape-generic over the head count), then one f32 ``psum`` per layer rebuilds
+the proj contraction. Everything outside attention (LN, MLP, residual,
+logits) is replicated compute on replicated activations, so all shards hold
+bit-identical activations; the psum splits each proj dot's reduction into
+``tp`` ordered partials, which moves float rounding by ulps — the sharded
+engine is **token-identical** to the single-chip one (asserted by ``ds-tpu
+serve-sim --sharding``), while the *bitwise* dense-mirror contract stays on
+the unsharded path. Per-iteration variation still rides as array values and
+the collective set is static (``n_layer`` all-reduces per program — the lint
+registry's collective-budget manifest pins exactly that), so the
+zero-recompile contract is unchanged.
 """
 
 import math
@@ -33,11 +53,16 @@ from .block_allocator import NULL_BLOCK
 
 
 def build_paged_programs(model, *, num_slots, block_size, max_blocks,
-                         prefill_chunk, copy_width=None, use_pallas=False):
+                         prefill_chunk, copy_width=None, use_pallas=False,
+                         mesh=None):
     """Jitted program dict for one engine: ``decode_step``, ``prefill_chunk``,
     ``copy_blocks`` plus ``beam_init(K, eos)`` / ``beam_select(K, eos)``
     factories (per-(K, eos) program caches — K is a shape, eos a baked
-    constant, so each variant is its own fixed-signature program)."""
+    constant, so each variant is its own fixed-signature program). With
+    ``mesh`` (carrying a ``model`` axis), the pool-touching programs lower
+    as head-sharded pjit programs instead; the dict also carries the
+    ``pool_sharding`` / ``replicated_sharding`` placements the engine puts
+    its buffers with."""
     c = model.config
     nh, hd = c.n_head, c.head_dim
     S, BS, MB, C = int(num_slots), int(block_size), int(max_blocks), int(prefill_chunk)
@@ -70,11 +95,13 @@ def build_paged_programs(model, *, num_slots, block_size, max_blocks,
                 + bp["c_proj_b"].astype(x_dtype))
 
     def _gather(pool, li, tables):
-        """[S_, nh, ML, hd] dense view of one layer's pages by table — the
-        exact layout ``kcs[li]`` has in the model's cached forward."""
-        g = pool[li][tables]                              # [S_, MB, BS, nh, hd]
+        """[S_, heads, ML, hd] dense view of one layer's pages by table — the
+        exact layout ``kcs[li]`` has in the model's cached forward. Shape-
+        generic over the pool's head dim, so a shard_map-local pool shard
+        gathers its local heads with the same code."""
+        g = pool[li][tables]                       # [S_, MB, BS, heads, hd]
         S_ = tables.shape[0]
-        return g.reshape(S_, ML, nh, hd).transpose(0, 2, 1, 3)
+        return g.reshape(S_, ML, pool.shape[3], hd).transpose(0, 2, 1, 3)
 
     def _attend(q, kg, vg, mask, x_dtype):
         # verbatim attn_cached score/softmax/value path
@@ -84,8 +111,8 @@ def build_paged_programs(model, *, num_slots, block_size, max_blocks,
         p = jax.nn.softmax(s, axis=-1).astype(x_dtype)
         y = jnp.einsum("bhqk,bhkd->bhqd", p, vg,
                        preferred_element_type=jnp.float32).astype(x_dtype)
-        B_, _, Tn, _ = y.shape
-        return y.transpose(0, 2, 1, 3).reshape(B_, Tn, nh * hd)
+        B_, heads, Tn, _ = y.shape
+        return y.transpose(0, 2, 1, 3).reshape(B_, Tn, heads * hd)
 
     def _blocks_forward(p, x, attn_fn):
         for li, bp in enumerate(p["blocks"]):
@@ -219,11 +246,152 @@ def build_paged_programs(model, *, num_slots, block_size, max_blocks,
             beam_cache[key] = jax.jit(f)
         return beam_cache[key]
 
+    if mesh is None:
+        return {
+            "decode_step": jax.jit(decode_step, donate_argnums=(5, 6)),
+            "prefill_chunk": jax.jit(prefill_chunk_fn, donate_argnums=(5, 6)),
+            "copy_blocks": jax.jit(copy_blocks, donate_argnums=(0, 1)),
+            "beam_init": beam_init,
+            "beam_select": beam_select,
+            "copy_width": P,
+        }
+
+    # ------------------------------------------------- model-axis sharding
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+
+    from ..parallel.mesh import MODEL_AXIS, shard_map
+
+    tp = mesh.shape[MODEL_AXIS]
+    if nh % tp:
+        raise ValueError(f"n_head {nh} not divisible by model-axis size {tp}")
+    nh_l = nh // tp
+    H = nh * hd
+    POOL = PS(None, None, None, MODEL_AXIS, None)   # pool sharded by head
+    REP = PS()                                      # everything else replicated
+    pool_sharding = NamedSharding(mesh, POOL)
+    rep_sharding = NamedSharding(mesh, REP)
+
+    def _qkv_local(x, bp):
+        """Local-head slice of the attn projection: column block
+        ``[part*H + h0, +nh_l*hd)`` of ``c_attn_w`` for part in (q, k, v).
+        Same dot/bias/reshape structure as ``_qkv``, nh_l heads wide."""
+        B_, Tn, _ = x.shape
+        h0 = jax.lax.axis_index(MODEL_AXIS) * (nh_l * hd)
+        w = bp["c_attn_w"].astype(x.dtype)
+        b = bp["c_attn_b"].astype(x.dtype)
+
+        def part(i):
+            wc = jax.lax.dynamic_slice_in_dim(w, i * H + h0, nh_l * hd, 1)
+            bc = jax.lax.dynamic_slice_in_dim(b, i * H + h0, nh_l * hd, 0)
+            out = jnp.dot(x, wc,
+                          preferred_element_type=jnp.float32).astype(x.dtype) \
+                + bc
+            return out.reshape(B_, Tn, nh_l, hd).transpose(0, 2, 1, 3)
+
+        return part(0), part(1), part(2)
+
+    def _proj_local(y, bp, x_dtype):
+        """Row block of ``c_proj_w`` for the local heads; the f32 ``psum``
+        over the model axis rebuilds the full contraction (the ONE collective
+        per layer the budget manifest admits), bias added once after."""
+        h0 = jax.lax.axis_index(MODEL_AXIS) * (nh_l * hd)
+        wr = jax.lax.dynamic_slice_in_dim(
+            bp["c_proj_w"].astype(x_dtype), h0, nh_l * hd, 0)
+        part = jnp.dot(y, wr, preferred_element_type=jnp.float32)
+        return (jax.lax.psum(part, MODEL_AXIS).astype(x_dtype)
+                + bp["c_proj_b"].astype(x_dtype))
+
+    def sharded_decode_step(p, toks, pos, tables, active, k_pool, v_pool):
+        def body(p, toks, pos, tables, active, k_pool, v_pool):
+            pools = {"k": k_pool, "v": v_pool}
+            x = p["wte"][toks[:, None]].astype(cd) \
+                + p["wpe"][pos[:, None]].astype(cd)
+            wblk = jnp.where(active, tables[jnp.arange(S), pos // BS],
+                             NULL_BLOCK)
+            off = pos % BS
+
+            def attn(xin, bp, li):
+                q, k, v = _qkv_local(xin, bp)        # [S, nh_l, 1, hd]
+                pools["k"] = pools["k"].at[li, wblk, off].set(
+                    k[:, :, 0, :].astype(pools["k"].dtype))
+                pools["v"] = pools["v"].at[li, wblk, off].set(
+                    v[:, :, 0, :].astype(pools["v"].dtype))
+                if paged_decode_attention is not None:
+                    y = paged_decode_attention(q, pools["k"], pools["v"], li,
+                                               tables, pos + 1, block_size=BS)
+                    y = y.transpose(0, 2, 1, 3).reshape(S, 1, nh_l * hd)
+                else:
+                    kg = _gather(pools["k"], li, tables)
+                    vg = _gather(pools["v"], li, tables)
+                    mask = (jnp.arange(ML)[None, :]
+                            <= pos[:, None])[:, None, None, :]
+                    y = _attend(q, kg, vg, mask, xin.dtype)
+                return _proj_local(y, bp, xin.dtype)
+
+            x = _blocks_forward(p, x, attn)
+            return _logits(x[:, -1], p), pools["k"], pools["v"]
+
+        return shard_map(body, mesh=mesh,
+                         in_specs=(REP, REP, REP, REP, REP, POOL, POOL),
+                         out_specs=(REP, POOL, POOL))(
+            p, toks, pos, tables, active, k_pool, v_pool)
+
+    def sharded_prefill_chunk(p, toks, pos, n_valid, table, k_pool, v_pool):
+        def body(p, toks, pos, n_valid, table, k_pool, v_pool):
+            pools = {"k": k_pool, "v": v_pool}
+            wpe_cap = p["wpe"].shape[0] - 1
+            tp_ = pos + jnp.arange(C)
+            positions = jnp.minimum(tp_, wpe_cap)
+            x = p["wte"][toks].astype(cd) \
+                + p["wpe"][positions][None].astype(cd)
+            valid = jnp.arange(C) < n_valid
+            wblk = jnp.where(valid, table[jnp.minimum(tp_ // BS, MB - 1)],
+                             NULL_BLOCK)
+            off = tp_ % BS
+            tbl1 = table[None]
+
+            def attn(xin, bp, li):
+                q, k, v = _qkv_local(xin, bp)        # [1, nh_l, C, hd]
+                pools["k"] = pools["k"].at[li, wblk, off].set(
+                    k[0].transpose(1, 0, 2).astype(pools["k"].dtype))
+                pools["v"] = pools["v"].at[li, wblk, off].set(
+                    v[0].transpose(1, 0, 2).astype(pools["v"].dtype))
+                kg = _gather(pools["k"], li, tbl1)
+                vg = _gather(pools["v"], li, tbl1)
+                mask = jnp.arange(ML)[None, :] <= tp_[:, None]
+                return _proj_local(_attend(q, kg, vg, mask, xin.dtype),
+                                   bp, xin.dtype)
+
+            x = _blocks_forward(p, x, attn)
+            last = jax.lax.dynamic_slice(x, (0, n_valid - 1, 0),
+                                         (1, 1, x.shape[-1]))[:, 0]
+            return _logits(last, p), pools["k"], pools["v"]
+
+        return shard_map(body, mesh=mesh,
+                         in_specs=(REP, REP, REP, REP, REP, POOL, POOL),
+                         out_specs=(REP, POOL, POOL))(
+            p, toks, pos, n_valid, table, k_pool, v_pool)
+
+    # copy_blocks scatters along the (unsharded) block axis only — GSPMD
+    # partitions it per shard with zero collectives; no shard_map needed
     return {
-        "decode_step": jax.jit(decode_step, donate_argnums=(5, 6)),
-        "prefill_chunk": jax.jit(prefill_chunk_fn, donate_argnums=(5, 6)),
-        "copy_blocks": jax.jit(copy_blocks, donate_argnums=(0, 1)),
+        "decode_step": jax.jit(
+            sharded_decode_step, donate_argnums=(5, 6),
+            in_shardings=(rep_sharding,) * 5 + (pool_sharding,) * 2,
+            out_shardings=(rep_sharding, pool_sharding, pool_sharding)),
+        "prefill_chunk": jax.jit(
+            sharded_prefill_chunk, donate_argnums=(5, 6),
+            in_shardings=(rep_sharding,) * 5 + (pool_sharding,) * 2,
+            out_shardings=(rep_sharding, pool_sharding, pool_sharding)),
+        "copy_blocks": jax.jit(
+            copy_blocks, donate_argnums=(0, 1),
+            in_shardings=(pool_sharding, pool_sharding,
+                          rep_sharding, rep_sharding),
+            out_shardings=(pool_sharding, pool_sharding)),
         "beam_init": beam_init,
         "beam_select": beam_select,
         "copy_width": P,
+        "pool_sharding": pool_sharding,
+        "replicated_sharding": rep_sharding,
+        "model_parallel": tp,
     }

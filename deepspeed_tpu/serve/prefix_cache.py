@@ -1,0 +1,130 @@
+"""Cross-request prefix cache over the paged KV pool (host-only).
+
+SGLang's RadixAttention observation, restated for this engine: for
+shared-system-prompt traffic the dominant TTFT cost is re-prefilling tokens
+whose KV already sits in the pool under some other request's block table. The
+allocator's refcount/fork machinery (block_allocator.py) was built for exactly
+this kind of sharing — beam lanes already read one prompt's pages through many
+tables — so cross-request reuse is the same trick with a content key instead
+of a parent lane.
+
+Design:
+
+- **Block-granular chained keys.** A prompt's cacheable unit is a *full*
+  block of ``block_size`` tokens; block ``i``'s key is the exact chain
+  ``(key_{i-1}, tokens_i)`` (nested tuples — collision-free by construction
+  and deterministic across processes, which the byte-identical replay
+  contract requires; "hashing" the chain would trade that for nothing at
+  serving scale). A key therefore identifies the whole prefix up to and
+  including its block, never a block out of context.
+- **Only immutable pages are cached.** Decode writes land at positions
+  ``>= prompt_len``, so prompt blocks fully inside ``[0, prompt_len)`` are
+  written exactly once (during prefill) and never again; only those are
+  registered. A hit is additionally capped at the last *full* block strictly
+  before the final prompt token — the completing prefill chunk must run for
+  real, because its logits seed the first token.
+- **Lifecycle rides the allocator's cached tier.** Registration marks live
+  pages; their last free parks them in the LRU tier instead of the free list
+  (block_allocator.register_cached). A hit on a live page is one more
+  reference; a hit on a parked page revives it. Pressure evicts parked pages
+  oldest-first and the allocator's evict hook erases the key here — admission
+  is refused only once both free and cached tiers are empty.
+- **Two-phase admission.** ``peek`` is a pure read (the scheduler retries a
+  blocked front request every iteration — counters must not inflate);
+  ``acquire`` commits the references and records the hit/miss. Everything is
+  a pure function of the request trace, so schedule replays stay
+  byte-identical with the cache on.
+"""
+
+from .block_allocator import BlockAllocator
+
+
+class PrefixCache:
+    def __init__(self, allocator: BlockAllocator, block_size: int):
+        self.allocator = allocator
+        self.block_size = int(block_size)
+        self._by_key = {}                     # chain key -> block id
+        allocator.set_evict_hook(self._on_evict)
+        # admission-commit counters (peek never counts)
+        self.hits = 0                         # admissions reusing >= 1 block
+        self.misses = 0                       # admissions reusing none
+        self.hit_tokens = 0                   # prompt tokens never prefetched
+        self.lookup_tokens = 0                # prompt tokens of all admissions
+        self.registered_blocks = 0            # cumulative register() inserts
+
+    # -------------------------------------------------------------- keying
+    def _chain(self, prompt, n_blocks):
+        """Chained content keys for the first ``n_blocks`` full blocks."""
+        BS, key, out = self.block_size, None, []
+        for i in range(n_blocks):
+            key = (key, tuple(prompt[i * BS:(i + 1) * BS]))
+            out.append(key)
+        return out
+
+    def _max_hit_blocks(self, prompt_len):
+        # full blocks strictly before the last prompt token: the chunk that
+        # completes the prompt always prefills, so first-token logits exist
+        return max(prompt_len - 1, 0) // self.block_size
+
+    # -------------------------------------------------------------- lookup
+    def peek(self, prompt):
+        """Longest cached chain for this prompt: ``(blocks, hit_tokens)``.
+        Pure read — no refcounts move, no counters advance."""
+        blocks = []
+        for key in self._chain(prompt, self._max_hit_blocks(len(prompt))):
+            b = self._by_key.get(key)
+            if b is None:
+                break
+            blocks.append(b)
+        return blocks, len(blocks) * self.block_size
+
+    def acquire(self, blocks, prompt_len):
+        """Commit a peeked hit into a new table: live pages gain a reference,
+        parked pages revive. Call only when admission is certain."""
+        for b in blocks:
+            if self.allocator.is_parked(b):
+                self.allocator.revive(b)
+            else:
+                self.allocator.add_ref(b)
+        if blocks:
+            self.hits += 1
+        else:
+            self.misses += 1
+        self.hit_tokens += len(blocks) * self.block_size
+        self.lookup_tokens += int(prompt_len)
+
+    # ------------------------------------------------------------ register
+    def register(self, prompt, table, known_tokens):
+        """Register every full, immutable prompt block whose KV the pool
+        already holds (``known_tokens`` prefilled so far; at ``begin_decode``
+        that is the whole prompt, at preemption the prefill frontier).
+        Idempotent; first writer wins on a duplicate chain."""
+        n = min(int(known_tokens), len(prompt)) // self.block_size
+        n = min(n, len(table))
+        for i, key in enumerate(self._chain(prompt, n)):
+            if key in self._by_key:
+                continue                      # same content already mapped
+            self.allocator.register_cached(table[i], key)
+            self._by_key[key] = table[i]
+            self.registered_blocks += 1
+
+    def _on_evict(self, block, key):
+        # the page's device bytes are being reclaimed — forget the mapping
+        self._by_key.pop(key, None)
+
+    # --------------------------------------------------------------- stats
+    def stats(self):
+        looked = self.hits + self.misses
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": (self.hits / looked) if looked else 0.0,
+            "hit_tokens": self.hit_tokens,
+            "lookup_tokens": self.lookup_tokens,
+            "cached_token_fraction": ((self.hit_tokens / self.lookup_tokens)
+                                      if self.lookup_tokens else 0.0),
+            "registered_blocks": self.registered_blocks,
+            "parked_blocks": self.allocator.num_cached,
+            "evictions": self.allocator.cache_evictions,
+            "revivals": self.allocator.cache_revivals,
+        }

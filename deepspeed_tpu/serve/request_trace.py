@@ -60,6 +60,7 @@ SLO_METRICS = ("ttft_ms", "tpot_ms")
 EV_SUBMIT = "submit"
 EV_REFUSED = "refused"          # args: reason
 EV_ADMIT = "admit"              # args: lanes, queue_delay_iters
+EV_CACHE_HIT = "cache_hit"      # args: cached_prefix_tokens (prefix reuse)
 EV_PREFILL = "prefill"          # args: pos, n, replayed
 EV_DECODE = "decode"            # args: lanes, replayed
 EV_FORK = "fork"                # args: lanes (beam CoW table fork)
@@ -133,7 +134,8 @@ class RequestTracer:
         self.iterations = deque(maxlen=self.iteration_capacity)
         self.hist = {m: StreamingHistogram() for m in LATENCY_METRICS}
         self.totals = {"prefill_tokens": 0, "prefill_replayed": 0,
-                       "decode_tokens": 0, "decode_replayed": 0}
+                       "decode_tokens": 0, "decode_replayed": 0,
+                       "cached_prefix_tokens": 0}
         self.slo_met = 0
         self.slo_violated = 0
         self.refused = 0
@@ -181,6 +183,16 @@ class RequestTracer:
         if rec is None:
             return
         self._event(rec, EV_ADMIT, it, g.lanes, int(it) - rec["arrival"])
+        cached = int(getattr(g, "cached_prefix_tokens", 0))
+        if cached:
+            # prefix-cache reuse: these prompt tokens are never scheduled, so
+            # they enter neither the useful nor the replayed side of the
+            # waste split — a preempt-restart's remapped prefix must not be
+            # billed as recomputation (that is the whole point of the remap)
+            self._event(rec, EV_CACHE_HIT, it, cached)
+            rec["cached_prefix_tokens"] = (
+                rec.get("cached_prefix_tokens", 0) + cached)
+            self.totals["cached_prefix_tokens"] += cached
 
     def on_prefill(self, g, it, pos, n, replayed):
         rec = self.live.get(g.req.req_id)
@@ -315,6 +327,10 @@ class RequestTracer:
             "decode_tokens": t["decode_tokens"],
             "decode_replayed": t["decode_replayed"],
             "waste_fraction": (replayed / scheduled) if scheduled else 0.0,
+            # prefix-cache reuse: prompt tokens whose KV was remapped rather
+            # than scheduled — by construction OUTSIDE the useful+replayed ==
+            # scheduled identity, so reuse is never misread as recomputation
+            "cached_prefix_tokens": t["cached_prefix_tokens"],
         }
 
     def slo_summary(self):
@@ -444,6 +460,11 @@ def to_serve_trace_events(bundle, us_per_iter=1000):
                 run[1] = it
                 run[2] += lanes
                 run[3] += replayed
+            elif name == EV_CACHE_HIT:
+                # only ever present with the prefix cache on and hitting, so
+                # cache-off exports (the golden-file contract) are unchanged
+                events.append(instant_event(0, tid, it * U, "prefix cache hit",
+                                            {"cached_tokens": ev[3]}))
             elif name == EV_PREEMPT:
                 events.append(instant_event(0, tid, it * U, "preempt",
                                             {"evicted_blocks": ev[3]}))

@@ -17,9 +17,21 @@ and preemption is full restart (vLLM's recompute mode) — the restarted run
 recomputes bit-identical logits because every device program has one fixed
 shape, so discarding progress never changes the tokens (the preempt-resume
 equivalence test pins exactly this).
+
+With ``prefix_cache=True`` admission first consults the cross-request prefix
+cache (serve/prefix_cache.py): cached full prompt blocks are mapped into the
+new table by reference (live pages) or revival (parked pages) and prefill
+starts past them — the chunk positions a warm start skips produce KV that is
+bit-identical to a cold prefill's, because every per-row op in the fixed-shape
+programs depends only on that row's inputs, so the downstream logits (and
+tokens) cannot change. Preemption registers the prefill frontier before
+freeing, which is what makes a preempt-restart warm instead of a full
+re-prefill. Cache decisions are pure functions of the trace too, so replays
+stay byte-identical with the cache on.
 """
 
 from .block_allocator import AllocationError, BlockAllocator
+from .prefix_cache import PrefixCache
 
 
 class Request:
@@ -85,6 +97,7 @@ class Group:
         self.slots = slots                      # K slot ids, lane order
         self.tables = [table]                   # lanes fork at prefill end
         self.prefill_done = 0
+        self.cached_prefix_tokens = 0           # prompt tokens a cache hit skipped
         self.phase = "prefill"
         self.generated = []                     # per lane after first token
         self.scores = None                      # beam lane scores (host floats)
@@ -127,12 +140,14 @@ class Group:
 
 class Scheduler:
     def __init__(self, *, num_slots, num_blocks, block_size, max_model_len,
-                 prefill_chunk):
+                 prefill_chunk, prefix_cache=False):
         self.num_slots = int(num_slots)
         self.block_size = int(block_size)
         self.max_model_len = int(max_model_len)
         self.prefill_chunk = int(prefill_chunk)
         self.allocator = BlockAllocator(num_blocks, block_size)
+        self.prefix_cache = (PrefixCache(self.allocator, block_size)
+                             if prefix_cache else None)
         self.free_slots = list(range(self.num_slots))
         self.waiting = []                       # Groups-to-be: (req, submit_idx)
         self.running = []                       # admission order
@@ -185,21 +200,34 @@ class Scheduler:
                 + (req.num_beams - 1))
 
     def admit(self, it):
-        """FIFO, front-blocking admission of every due request that fits."""
+        """FIFO, front-blocking admission of every due request that fits.
+        With the prefix cache on, cached prompt blocks don't count against
+        the pool (they are reused, not allocated) — but parked hit blocks
+        stop counting as reclaimable, since the hit is about to pin them."""
         admitted = []
         while self.waiting:
             req, submit_idx = self.waiting[0]
             if req.arrival > it:
                 break
+            hit_blocks, hit_tokens = ([], 0)
+            if self.prefix_cache is not None:
+                hit_blocks, hit_tokens = self.prefix_cache.peek(req.prompt)
+            parked = sum(1 for b in hit_blocks
+                         if self.allocator.is_parked(b))
+            fresh_needed = self._admit_blocks_needed(req) - len(hit_blocks)
             if (req.num_beams > len(self.free_slots)
-                    or not self.allocator.can_allocate(
-                        self._admit_blocks_needed(req))):
+                    or fresh_needed > self.allocator.num_free - parked):
                 break                            # front-blocking: no overtaking
             self.waiting.pop(0)
             slots = [self.free_slots.pop(0) for _ in range(req.num_beams)]
-            table = self.allocator.allocate(
-                self.allocator.blocks_for_tokens(len(req.prompt)))
+            if self.prefix_cache is not None:
+                self.prefix_cache.acquire(hit_blocks, len(req.prompt))
+            table = list(hit_blocks) + self.allocator.allocate(
+                self.allocator.blocks_for_tokens(len(req.prompt))
+                - len(hit_blocks))
             g = Group(req, submit_idx, self._admission_counter, slots, table)
+            g.cached_prefix_tokens = hit_tokens
+            g.prefill_done = hit_tokens          # resume prefill past the hit
             self._admission_counter += 1
             self.running.append(g)
             admitted.append(g)
@@ -209,8 +237,14 @@ class Scheduler:
     def _preempt(self, g):
         """Full restart: free everything, requeue at the group's original
         queue position. The fixed-shape programs make the restarted run
-        bit-identical, so no generated state needs saving."""
+        bit-identical, so no generated state needs saving. With the prefix
+        cache on, the prefill frontier's full blocks are registered first, so
+        the freed prompt pages park in the cached tier and the restart remaps
+        them instead of re-prefilling — unless pressure evicts them first."""
         g.evicted_blocks = len({b for t in g.tables for b in t})
+        if self.prefix_cache is not None and g.tables:
+            self.prefix_cache.register(g.req.prompt, g.tables[0],
+                                       g.prefill_done)
         for t in g.tables:
             self.allocator.free(t)
         g.tables = []
@@ -311,6 +345,10 @@ class Scheduler:
         g.entered_decode_it = it
         g.first_token_it = it
         base = g.tables[0]
+        if self.prefix_cache is not None:
+            # the whole prompt is in the pool now; its full blocks are
+            # immutable from here on (decode writes land past prompt_len)
+            self.prefix_cache.register(g.req.prompt, base, g.prompt_len)
         g.tables = [base] + [self.allocator.fork(base)
                              for _ in range(g.lanes - 1)]
 

@@ -31,22 +31,33 @@ import sys
 
 
 def synth_trace(n, *, vocab_size, max_model_len, seed, beam_every=7,
-                include_infeasible=False):
+                include_infeasible=False, shared_prefix_len=0):
     """Seeded mixed trace: prompts 1..~ML/2, generations 1..~ML/4, arrivals
-    staggered 0-2 iterations apart, every ``beam_every``-th request beam-4."""
+    staggered 0-2 iterations apart, every ``beam_every``-th request beam-4.
+
+    With ``shared_prefix_len > 0`` every prompt starts with the SAME seeded
+    ``shared_prefix_len``-token system prompt followed by a per-request tail —
+    the canonical prefix-cache workload. The default path draws nothing extra,
+    so existing seeded traces (and their goldens) are untouched."""
     import numpy as np
     from .scheduler import Request
 
     rng = np.random.RandomState(seed)
+    P = int(shared_prefix_len)
+    if P >= max_model_len:
+        raise ValueError("shared_prefix_len must leave room for a tail and "
+                         f"generation (got {P} >= {max_model_len})")
+    system_prompt = rng.randint(0, vocab_size, size=P).tolist() if P else []
     reqs, arrival = [], 0
     for i in range(n):
         arrival += int(rng.randint(0, 3))
-        T0 = int(rng.randint(1, max(2, max_model_len // 2)))
+        T0 = P + int(rng.randint(1, max(2, (max_model_len - P) // 2)))
         L = int(rng.randint(1, max(2, max_model_len // 4)))
         if T0 + L > max_model_len:          # keep the trace feasible
             L = max_model_len - T0
         K = 4 if (beam_every and i % beam_every == beam_every - 1) else 1
-        prompt = rng.randint(0, vocab_size, size=T0).tolist()
+        prompt = system_prompt + rng.randint(0, vocab_size,
+                                             size=T0 - P).tolist()
         reqs.append(Request(f"req{i:03d}", prompt, L, arrival=arrival,
                             num_beams=K))
     if include_infeasible:
@@ -56,13 +67,24 @@ def synth_trace(n, *, vocab_size, max_model_len, seed, beam_every=7,
     return reqs
 
 
-def _build(args, telemetry):
+def _p50(values):
+    """Deterministic iteration-domain median: upper median of sorted ints."""
+    vals = sorted(v for v in values if v is not None)
+    return vals[len(vals) // 2] if vals else None
+
+
+def _build(args, telemetry, prefix_cache=None, sharding=None):
     import jax
     import jax.numpy as jnp
 
     from ..models.gpt2 import GPT2Config, GPT2Model
     from .engine import InferenceEngine
 
+    pc = args.prefix_cache if prefix_cache is None else prefix_cache
+    tp = args.sharding if sharding is None else sharding
+    # the dense-cache oracle cannot mirror either mode (skipped prefills /
+    # reduction-order drift), and the engine constructor enforces that
+    mirror = not args.no_mirror and not pc and tp <= 1
     cfg = GPT2Config(vocab_size=args.vocab_size, n_positions=args.max_model_len,
                      n_embd=args.n_embd, n_layer=args.n_layer,
                      n_head=args.n_head, compute_dtype=jnp.float32,
@@ -73,7 +95,8 @@ def _build(args, telemetry):
         model, params, num_slots=args.slots, block_size=args.block_size,
         num_blocks=args.num_blocks, max_model_len=args.max_model_len,
         prefill_chunk=args.prefill_chunk, use_pallas=args.pallas,
-        telemetry=telemetry, mirror=not args.no_mirror,
+        telemetry=telemetry, mirror=mirror, prefix_cache=pc,
+        sharding={"model": tp} if tp > 1 else None,
         request_trace=None if args.no_trace else {
             "enabled": True,
             "capacity": max(args.requests + 1, 256),
@@ -82,7 +105,15 @@ def _build(args, telemetry):
     return engine
 
 
-def _report(args, trace, outputs, logs, tracer, waste, slo, failures):
+def _trace(args):
+    return synth_trace(args.requests, vocab_size=args.vocab_size,
+                       max_model_len=args.max_model_len, seed=args.seed,
+                       include_infeasible=args.include_infeasible,
+                       shared_prefix_len=args.shared_prefix)
+
+
+def _report(args, trace, outputs, logs, tracer, waste, slo, failures,
+            cache_stats=None, ttft_compare=None):
     """Machine-readable serve-sim report. The ``deterministic`` subtree is a
     pure function of the seeded trace (iteration-domain latencies, token
     counts, waste split — byte-stable across runs on one platform); ``wall``
@@ -109,7 +140,10 @@ def _report(args, trace, outputs, logs, tracer, waste, slo, failures):
                  "slots": args.slots, "block_size": args.block_size,
                  "num_blocks": args.num_blocks,
                  "max_model_len": args.max_model_len,
-                 "prefill_chunk": args.prefill_chunk},
+                 "prefill_chunk": args.prefill_chunk,
+                 "shared_prefix": args.shared_prefix,
+                 "sharding": args.sharding,
+                 "prefix_cache": bool(args.prefix_cache)},
         "n_finished": sum(1 for o in outputs if o.status == "finished"),
         "n_refused": sum(1 for o in outputs if o.status == "refused"),
         "iterations": len(logs),
@@ -117,6 +151,11 @@ def _report(args, trace, outputs, logs, tracer, waste, slo, failures):
         "requests": table,
         "waste": waste,
     }
+    if cache_stats is not None:
+        # pure functions of the seeded schedule -> deterministic subtree
+        det["prefix_cache"] = cache_stats
+    if ttft_compare is not None:
+        det["ttft_p50_iters"] = ttft_compare
     wall = {}
     if tracer is not None:
         wall["percentiles"] = tracer.percentiles()
@@ -144,6 +183,26 @@ def main(argv=None):
     ap.add_argument("--n-head", type=int, default=2)
     ap.add_argument("--no-mirror", action="store_true",
                     help="skip the dense-oracle bitwise lockstep (faster)")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="enable the cross-request prefix cache (disables the "
+                         "mirror oracle: remapped prefixes skip the prefill "
+                         "the oracle would need to reproduce)")
+    ap.add_argument("--shared-prefix", type=int, default=0, metavar="P",
+                    help="give every request the same seeded P-token system "
+                         "prompt (the prefix-cache workload); 0 = off")
+    ap.add_argument("--compare-prefix-cache", action="store_true",
+                    help="run the trace cache-off AND cache-on, assert token "
+                         "identity and a STRICT cache-on p50 TTFT (iters) "
+                         "improvement (implies --prefix-cache)")
+    ap.add_argument("--sharding", type=int, default=1, metavar="TP",
+                    help="shard the KV pool + decode programs over TP model-"
+                         "axis devices by attention head (disables the "
+                         "mirror oracle: per-layer psum is token-identical, "
+                         "not bitwise)")
+    ap.add_argument("--verify-unsharded", action="store_true",
+                    help="with --sharding > 1: also run the trace on a "
+                         "single-chip engine and assert token-identical "
+                         "outputs (greedy and beam)")
     ap.add_argument("--pallas", action="store_true",
                     help="use the Pallas paged-decode kernel (interpret mode "
                          "on CPU)")
@@ -175,12 +234,21 @@ def main(argv=None):
                           or args.dump_ledger):
         ap.error("--no-trace is incompatible with --slo-*/--dump-ledger "
                  "(they need the ledger)")
+    if args.compare_prefix_cache:
+        args.prefix_cache = True
+    if args.verify_unsharded and args.sharding <= 1:
+        ap.error("--verify-unsharded needs --sharding > 1")
+    if args.sharding < 1:
+        ap.error("--sharding must be >= 1")
+    mirror_on = not args.no_mirror and not args.prefix_cache \
+        and args.sharding <= 1
+    if not args.no_mirror and not mirror_on:
+        print("serve-sim: note: mirror oracle disabled "
+              "(incompatible with --prefix-cache / --sharding)")
 
     from ..utils.telemetry import TelemetrySession
 
-    trace = synth_trace(args.requests, vocab_size=args.vocab_size,
-                        max_model_len=args.max_model_len, seed=args.seed,
-                        include_infeasible=args.include_infeasible)
+    trace = _trace(args)
 
     session = TelemetrySession(output_path=args.output, job_name="serve_sim")
     engine = _build(args, session)
@@ -208,22 +276,57 @@ def main(argv=None):
         failures.append("no serve:* programs reached the compile watchdog")
 
     # invariant 2: the oracle lockstep actually ran
-    if not args.no_mirror and engine.mirror_checks == 0:
+    if mirror_on and engine.mirror_checks == 0:
         failures.append("mirror enabled but no bitwise checks executed")
 
     # invariant 3 (optional): byte-identical replay on a fresh engine
     if args.replay:
         engine2 = _build(args, None)
-        outputs2, logs2 = engine2.run(
-            synth_trace(args.requests, vocab_size=args.vocab_size,
-                        max_model_len=args.max_model_len, seed=args.seed,
-                        include_infeasible=args.include_infeasible))
+        outputs2, logs2 = engine2.run(_trace(args))
         if json.dumps(logs) != json.dumps(logs2):
             failures.append("replay schedule log diverged")
         toks1 = [(o.req_id, o.status, o.tokens) for o in outputs]
         toks2 = [(o.req_id, o.status, o.tokens) for o in outputs2]
         if toks1 != toks2:
             failures.append("replay outputs diverged")
+
+    # invariant 6 (optional): the model-axis sharded engine is a memory-layout
+    # + compute-placement change, not a sampling change — token-identical to
+    # the single-chip engine on the same trace (greedy and beam lanes alike)
+    ttft_compare = None
+    if args.verify_unsharded:
+        eng1 = _build(args, None, sharding=1)
+        outs1, _ = eng1.run(_trace(args))
+        sharded = {(o.req_id): (o.status, o.tokens) for o in outputs}
+        single = {(o.req_id): (o.status, o.tokens) for o in outs1}
+        if sharded != single:
+            bad = sorted(r for r in sharded if sharded[r] != single.get(r))
+            failures.append(
+                f"sharded (model={args.sharding}) outputs diverge from "
+                f"single-chip on {len(bad)} request(s): {', '.join(bad[:8])}")
+
+    # invariant 7 (optional): the prefix cache must actually BUY something on
+    # this trace — token-identical outputs AND a strictly better p50 TTFT in
+    # the deterministic iteration domain than the same engine cache-off
+    if args.compare_prefix_cache:
+        eng_off = _build(args, None, prefix_cache=False)
+        outs_off, _ = eng_off.run(_trace(args))
+        t_on = {o.req_id: (o.status, o.tokens) for o in outputs}
+        t_off = {o.req_id: (o.status, o.tokens) for o in outs_off}
+        if t_on != t_off:
+            bad = sorted(r for r in t_on if t_on[r] != t_off.get(r))
+            failures.append(
+                f"prefix cache changed tokens on {len(bad)} request(s): "
+                f"{', '.join(bad[:8])}")
+        p50_on = _p50(o.ttft_iters for o in outputs
+                      if o.status == "finished")
+        p50_off = _p50(o.ttft_iters for o in outs_off
+                       if o.status == "finished")
+        ttft_compare = {"cache_on": p50_on, "cache_off": p50_off}
+        if p50_on is None or p50_off is None or not p50_on < p50_off:
+            failures.append(
+                f"prefix cache did not strictly improve p50 TTFT: "
+                f"cache-on {p50_on} vs cache-off {p50_off} iters")
 
     tracer = engine.tracer
     waste = slo = None
@@ -258,9 +361,13 @@ def main(argv=None):
     if args.dump_ledger:
         tracer.dump(args.dump_ledger)
 
+    cache_stats = (engine.prefix_cache.stats()
+                   if engine.prefix_cache is not None else None)
+
     if args.json_out:
         report = _report(args, trace, outputs, logs, tracer, waste, slo,
-                         failures)
+                         failures, cache_stats=cache_stats,
+                         ttft_compare=ttft_compare)
         blob = json.dumps(report, sort_keys=True, separators=(",", ":"))
         if args.json_out == "-":
             print(blob)
@@ -279,9 +386,23 @@ def main(argv=None):
               f"max {max(ttfts)}")
     print(f"  programs watched : {len(serve_names)} "
           f"(recompiles after warmup: {total_recompiles})")
-    if not args.no_mirror:
+    if mirror_on:
         print(f"  oracle lockstep  : {engine.mirror_checks} bitwise checks, "
               f"all identical")
+    if args.sharding > 1:
+        shard_note = (" (token-identical to single-chip)"
+                      if args.verify_unsharded and not failures else "")
+        print(f"  sharding         : model={args.sharding} ways by attention "
+              f"head{shard_note}")
+    if cache_stats is not None:
+        print(f"  prefix cache     : hit-rate {cache_stats['hit_rate']:.1%} "
+              f"({cache_stats['hits']} hits), "
+              f"{cache_stats['hit_tokens']} prompt tokens remapped "
+              f"({cache_stats['cached_token_fraction']:.1%} of looked-up), "
+              f"{cache_stats['evictions']} evictions")
+    if ttft_compare is not None:
+        print(f"  TTFT p50 iters   : cache-on {ttft_compare['cache_on']} vs "
+              f"cache-off {ttft_compare['cache_off']}")
     if args.replay:
         print("  replay           : byte-identical schedule + outputs")
     if waste is not None:

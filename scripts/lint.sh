@@ -41,6 +41,23 @@ if [ "$serve_rc" -eq 0 ]; then
            /tmp/_serve_timeline.trace.json
     serve_rc=$?
 fi
+# prefix-cache gate: seeded shared-system-prompt trace run cache-off AND
+# cache-on — token identity plus a STRICT cache-on p50 TTFT improvement in
+# the deterministic iteration domain, hit-rate in the JSON report; any
+# regression in the cache's ability to buy TTFT fails CI
+timeout -k 10 300 "$REPO/bin/ds-tpu" serve-sim --shared-prefix 96 \
+    --compare-prefix-cache --slo-ttft-ms 60000 --slo-tpot-ms 60000 \
+    --json /tmp/_serve_prefix_cache.json \
+    --output /tmp/_serve_prefix_cache_telemetry
+cache_rc=$?
+# sharded-decode gate: the same seeded 64-request trace (greedy + beam)
+# through the 2-way model-axis head-sharded engine AND a single-chip engine —
+# outputs must be token-identical and every sharded program must still
+# compile exactly once (zero recompiles after warmup)
+timeout -k 10 300 "$REPO/bin/ds-tpu" serve-sim --sharding 2 \
+    --verify-unsharded --json /tmp/_serve_sharded.json \
+    --output /tmp/_serve_sharded_telemetry
+shard_rc=$?
 # anatomy: roofline ledger + overlap analysis over the comm-mode registry
 # entries, with the flat-vs-hierarchical-vs-overlap exposed-DCN comparison
 # byte-compared against the committed golden — any pricing or exchange drift
@@ -62,4 +79,6 @@ anatomy_rc=$?
 [ "$lint_rc" -ne 0 ] && exit "$lint_rc"
 [ "$comm_rc" -ne 0 ] && exit "$comm_rc"
 [ "$serve_rc" -ne 0 ] && exit "$serve_rc"
+[ "$cache_rc" -ne 0 ] && exit "$cache_rc"
+[ "$shard_rc" -ne 0 ] && exit "$shard_rc"
 exit "$anatomy_rc"

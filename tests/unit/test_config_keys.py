@@ -145,6 +145,12 @@ SWEEP = {
         ({"request_trace": {"iteration_capacity": 0}}, ("raise", ValueError)),
         ({"request_trace": {"slo": {"ttft_ms": -1}}}, ("raise", ValueError)),
         ({"request_trace": {"slo": {"tpot_ms": True}}}, ("raise", ValueError)),
+        ({"sharding": {"model": 2}},
+         ("attr", "serving_sharding_model", 2)),
+        ({"sharding": {"model": 0}}, ("raise", ValueError)),
+        ({"sharding": {"model": True}}, ("raise", ValueError)),
+        ({"prefix_cache": {"enabled": True}},
+         ("attr", "serving_prefix_cache_enabled", True)),
     ),
     "comm": (
         ({"mode": "hierarchical"}, ("attr", "comm_mode", "hierarchical")),
@@ -257,6 +263,20 @@ def test_unknown_request_trace_slo_key_warns(capture):
     assert "unknown serving.request_trace.slo config key" in capture.text
     assert "ttft" in capture.text
     assert "ttft_ms" in capture.text     # the known-keys hint points at the fix
+
+
+def test_unknown_serving_sharding_key_warns(capture):
+    _cfg(serving={"sharding": {"model": 2, "modle": 4}})
+    assert "unknown serving.sharding config key" in capture.text
+    assert "modle" in capture.text
+    assert "model" in capture.text       # the known-keys hint points at the fix
+
+
+def test_unknown_prefix_cache_key_warns(capture):
+    _cfg(serving={"prefix_cache": {"enabled": True, "enabeld": False}})
+    assert "unknown serving.prefix_cache config key" in capture.text
+    assert "enabeld" in capture.text
+    assert "enabled" in capture.text     # the known-keys hint points at the fix
 
 
 def test_unknown_comm_key_warns(capture):
