@@ -89,12 +89,20 @@ REGRESSION_KEYS = (
     "extra.serving_420m_prefix_cache.prefix_cache_hit_rate",
     "extra.serving_420m_prefix_cache.ttft_ms_p50",
     "extra.serving_420m_sharded.tok_s",
+    # resilience ledger: caller-thread checkpoint stall and the warm/cold
+    # restart TTFT ratio (docs/resilience.md) — both lower-is-better
+    "extra.resilience.checkpoint_stall_ms",
+    "extra.resilience.restore_warm_vs_cold_ttft",
 )
 
 # keys where LOWER is better (latency): a regression is a RISE past the
 # threshold, so their delta sign is inverted before the flag check
 LOWER_IS_BETTER_KEYS = frozenset(
-    k for k in REGRESSION_KEYS if k.endswith("_ms_p50") or k.endswith("_ms_p95"))
+    k for k in REGRESSION_KEYS
+    if k.endswith("_ms_p50") or k.endswith("_ms_p95")) | frozenset({
+        "extra.resilience.checkpoint_stall_ms",
+        "extra.resilience.restore_warm_vs_cold_ttft",
+    })
 
 
 def regression_vs_previous_round(current, threshold_pct=5.0):
@@ -702,6 +710,91 @@ def bench_serving_sharded_smoke():
         max_model_len=64, prefill_chunk=16, sharding=2)
 
 
+def bench_resilience_smoke():
+    """Resilience smoke (docs/resilience.md): measures what the async
+    checkpointer actually costs the step — median step wall time with a
+    background commit in flight vs no saves at all, plus the caller-thread
+    snapshot stall — and what a warm serving restart actually buys: mean TTFT
+    of requests drained after a warm restore vs a cold restart of the same
+    pending work (plus the deterministic prefill-chunk counts behind it).
+    Runs OUTSIDE the headline window like the serving smokes."""
+    import shutil
+    import tempfile
+
+    from deepspeed_tpu.resilience.async_ckpt import AsyncCheckpointer
+    from deepspeed_tpu.resilience.crash_sim import (_drain, _make_server,
+                                                    _make_trainer,
+                                                    _prefill_chunks,
+                                                    _serve_trace,
+                                                    _train_batches)
+    from deepspeed_tpu.resilience.serve_restart import (restore_server,
+                                                        save_server)
+    from deepspeed_tpu.serve.scheduler import pack_request, unpack_request
+
+    workdir = tempfile.mkdtemp(prefix="ds_bench_resilience_")
+    try:
+        engine = _make_trainer(0)
+        batches = _train_batches(12, 0)
+
+        def timed_step(x, y):
+            t0 = time.perf_counter()
+            loss = engine(x, y)
+            engine.backward(loss)
+            engine.step()
+            _fence(loss)
+            return (time.perf_counter() - t0) * 1e3
+
+        for x, y in batches[:2]:  # pay the compiles outside both windows
+            timed_step(x, y)
+        base = [timed_step(x, y) for x, y in batches[2:7]]
+        ck = AsyncCheckpointer(engine, os.path.join(workdir, "train"))
+        stalls, with_save = [], []
+        for i, (x, y) in enumerate(batches[7:12]):
+            # issue the save BEFORE the timed step: the commit thread then
+            # overlaps the step, which is exactly the fencing claim under test
+            ck.save(tag=f"s{i}")
+            stalls.append(ck.last_stall_ms)
+            with_save.append(timed_step(x, y))
+        ck.wait()
+
+        trace = _serve_trace(1)
+        victim = _make_server(1, 129)
+        for r in trace:
+            victim.submit(unpack_request(pack_request(r)))
+        for _ in range(6):  # partial progress, then the replica dies
+            if victim.scheduler.idle:
+                break
+            victim.step()
+        finished_at_kill = set(victim.outputs)
+        snap = save_server(victim, os.path.join(workdir, "serve"))
+
+        warm = _make_server(1, 129)
+        restore_server(warm, snap)
+        warm_logs = _drain(warm)
+        warm_ttft = [o.ttft_ms for rid, o in warm.outputs.items()
+                     if rid not in finished_at_kill and o.status == "finished"]
+        cold = _make_server(1, 129)
+        pending = [r for r in trace if r.req_id not in finished_at_kill]
+        cold_out, cold_logs = cold.run([unpack_request(pack_request(r))
+                                        for r in pending])
+        cold_ttft = [o.ttft_ms for o in cold_out if o.status == "finished"]
+        warm_ms = float(np.mean(warm_ttft)) if warm_ttft else 0.0
+        cold_ms = float(np.mean(cold_ttft)) if cold_ttft else 0.0
+        return {"checkpoint_stall_ms": round(float(np.median(stalls)), 2),
+                "step_ms_no_save": round(float(np.median(base)), 2),
+                "step_ms_with_async_save": round(float(np.median(with_save)), 2),
+                "saves_committed": int(ck.saves_committed),
+                "restore_warm_ttft_ms_mean": round(warm_ms, 2),
+                "restore_cold_ttft_ms_mean": round(cold_ms, 2),
+                # warm/cold TTFT ratio (lower is better; < 1.0 = warm wins)
+                "restore_warm_vs_cold_ttft": round(warm_ms / cold_ms, 3)
+                if cold_ms > 0 else 0.0,
+                "warm_prefill_chunks": int(_prefill_chunks(warm_logs)),
+                "cold_prefill_chunks": int(_prefill_chunks(cold_logs))}
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_serving_420m():
     """TPU serving path: GPT-2 420M bf16, 32-request mixed trace."""
     import jax.numpy as jnp
@@ -1095,6 +1188,10 @@ def main():
             serving_sharded = bench_serving_sharded_smoke()
         except Exception as e:
             serving_sharded = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            resilience = bench_resilience_smoke()
+        except Exception as e:
+            resilience = {"error": f"{type(e).__name__}: {e}"}
         anatomy = telemetry.get("anatomy") or {}
         result = {"metric": "gpt2_tokens_per_sec_per_chip_cpu_smoke",
                   "value": round(tps, 1), "unit": "tokens/s", "vs_baseline": 0.0,
@@ -1108,7 +1205,8 @@ def main():
                             "pipeline_goodput": pipeline_goodput,
                             "serving": serving,
                             "serving_prefix_cache": serving_prefix,
-                            "serving_sharded": serving_sharded}}
+                            "serving_sharded": serving_sharded,
+                            "resilience": resilience}}
         result["extra"]["regression_vs_previous_round"] = \
             regression_vs_previous_round(result)
         print(json.dumps(result))

@@ -11,10 +11,22 @@ JSON. ``latest`` file tracks the most recent tag (engine.py:1351-1353).
 In the single-controller JAX runtime one process owns every shard, so "per-rank files"
 are written by slicing the global arrays — the on-disk layout (one optim file per DP rank)
 is preserved so multi-host loaders and the elastic merge path work identically.
+
+Durability (docs/resilience.md): a save is a two-phase operation. Phase 1
+(``snapshot_checkpoint``) materializes every payload as host data — it runs the
+device→host copies and the multi-host collective gathers but touches no files,
+so phase 2 (``write_snapshot``) can run on a background thread while training
+continues. Phase 2 commits through ``<tag>.tmp/`` + per-file sha256 manifest +
+fsync + atomic rename, and ``latest`` is updated via tmp + ``os.replace`` — a
+crash at any point leaves either the previous committed state or a ``.tmp``
+dir/mismatched manifest that ``verify_checkpoint`` detects and restore skips,
+never loads.
 """
 
+import hashlib
 import json
 import os
+import shutil
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -101,6 +113,102 @@ def _ckpt_dir(save_dir: str, tag: str) -> str:
     return os.path.join(save_dir, str(tag))
 
 
+# --------------------------------------------------------- commit protocol
+# Manifest name is distinct from the offload_manifest_* region manifests: this
+# one is the integrity record of the WHOLE tag dir (per-file sha256), written
+# last so its presence certifies every other file landed completely.
+MANIFEST_NAME = "ds_ckpt_manifest.json"
+TMP_SUFFIX = ".tmp"
+
+
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the directory entry so renames/creates inside it are durable.
+    Best-effort: not every filesystem (or platform) supports dir fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write_text(path: str, text: str) -> None:
+    """tmp-file + fsync + os.replace: readers see the old content or the new
+    content, never a torn prefix."""
+    tmp = path + TMP_SUFFIX
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
+def write_latest(save_dir: str, tag: str) -> None:
+    """Atomically point ``latest`` at ``tag`` — a preemption mid-write must
+    never leave a torn ``latest`` that fails every future restore."""
+    _atomic_write_text(os.path.join(save_dir, "latest"), str(tag))
+
+
+def write_manifest(ckpt_dir: str, extra: Optional[Dict] = None) -> Dict:
+    """Checksum every file in ``ckpt_dir`` into the integrity manifest. Written
+    LAST in the commit sequence: a save killed before this point leaves no (or
+    a stale) manifest, which verify_checkpoint reports as torn."""
+    entries = {}
+    for name in sorted(os.listdir(ckpt_dir)):
+        path = os.path.join(ckpt_dir, name)
+        if name == MANIFEST_NAME or name.endswith(TMP_SUFFIX) \
+                or not os.path.isfile(path):
+            continue
+        entries[name] = {"sha256": _file_sha256(path),
+                         "bytes": os.path.getsize(path)}
+    manifest = {"version": 1, "files": entries}
+    if extra:
+        manifest.update(extra)
+    _atomic_write_text(os.path.join(ckpt_dir, MANIFEST_NAME),
+                       json.dumps(manifest, sort_keys=True))
+    return manifest
+
+
+def verify_checkpoint(ckpt_dir: str):
+    """(ok, reason) integrity verdict for one tag dir. A checkpoint whose
+    manifest is missing a file, or whose bytes/sha256 disagree with the
+    manifest, is TORN — restore must skip it, never load it. Pre-manifest
+    (legacy) checkpoints pass with a reason noting the weaker guarantee."""
+    if not os.path.isdir(ckpt_dir):
+        return False, "missing checkpoint directory"
+    mpath = os.path.join(ckpt_dir, MANIFEST_NAME)
+    if not os.path.isfile(mpath):
+        return True, "legacy (no integrity manifest)"
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (ValueError, OSError) as e:
+        return False, f"unreadable manifest ({e})"
+    for name, ent in manifest.get("files", {}).items():
+        path = os.path.join(ckpt_dir, name)
+        if not os.path.isfile(path):
+            return False, f"missing file {name}"
+        if os.path.getsize(path) != ent.get("bytes"):
+            return False, (f"size mismatch in {name}: "
+                           f"{os.path.getsize(path)} != {ent.get('bytes')}")
+        if _file_sha256(path) != ent.get("sha256"):
+            return False, f"checksum mismatch in {name}"
+    return True, "ok"
+
+
 def model_states_name(mp_rank: int = 0) -> str:
     return f"mp_rank_{mp_rank:02d}_model_states"
 
@@ -121,15 +229,16 @@ def _offload_leaf_keys(off):
             for path, _ in jax.tree_util.tree_flatten_with_path(skeleton)[0]]
 
 
-def _save_offload_regions(engine, ckpt_dir: str):
-    """Per-PROCESS region files for the host-tier state (multi-host safe).
+def _snapshot_offload_regions(engine):
+    """Per-PROCESS region payloads for the host-tier state (multi-host safe).
 
-    Each process writes only the master/moment regions its devices own
-    (``zero_offload_proc_N``); a manifest records leaf shapes and every region's
-    slice so any topology can reassemble full leaves on load — the region-wise
-    analog of the reference's per-rank ``zero_pp_rank_N`` files."""
+    Each process snapshots only the master/moment regions its devices own
+    (``zero_offload_proc_N``); a region manifest records leaf shapes and every
+    region's slice so any topology can reassemble full leaves on load — the
+    region-wise analog of the reference's per-rank ``zero_pp_rank_N`` files.
+    Buffer regions are COPIED: the async writer thread must not observe the
+    next step's in-place host updates."""
     off = engine._offload
-    proc = jax.process_index()
     keys = _offload_leaf_keys(off)
     shard = {}
     regions_meta = []
@@ -138,17 +247,16 @@ def _save_offload_regions(engine, ckpt_dir: str):
             tag = f"r{li}_{r.offset}"
             for prefix, buf in (("master", off.fp32), ("exp_avg", off.exp_avg),
                                 ("exp_avg_sq", off.exp_avg_sq)):
-                shard[f"{prefix}/{tag}"] = buf[r.offset:r.offset + r.size]
+                shard[f"{prefix}/{tag}"] = np.array(
+                    buf[r.offset:r.offset + r.size])
             regions_meta.append({"tag": tag, "leaf": li,
                                  "starts": [sl.start for sl in r.slices],
                                  "stops": [sl.stop for sl in r.slices]})
-    np.savez(os.path.join(ckpt_dir, offload_states_name(proc) + ".npz"), **shard)
-    # one manifest per process: concurrent writers never touch the same file
-    with open(os.path.join(ckpt_dir, f"offload_manifest_{proc}.json"), "w") as f:
-        json.dump({"n_procs": jax.process_count(), "proc": proc,
-                   "leaves": [{"key": k, "shape": list(shp)}
-                              for k, shp in zip(keys, off._shapes)],
-                   "regions": regions_meta}, f)
+    manifest = {"n_procs": jax.process_count(), "proc": jax.process_index(),
+                "leaves": [{"key": k, "shape": list(shp)}
+                           for k, shp in zip(keys, off._shapes)],
+                "regions": regions_meta}
+    return shard, manifest
 
 
 def _save_barrier():
@@ -237,35 +345,61 @@ def _scatter_offload_regions(ckpt_dir: str, off) -> bool:
     return matched == set(local.keys())
 
 
-def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_state: Dict = {},
-                    save_latest: bool = True):
+def comm_ef_geometry(engine):
+    """Geometry descriptor of the engine-held compressed-exchange error-feedback
+    buffers (``_comm_we``/``_comm_se``), or None when the engine holds none.
+    This is what save records next to the buffers and what restore validates
+    (resilience/elastic.py) — the chunk→global-offset map is a function of
+    (dp, slice_size) and, under bucketed overlap, of the per-bucket leaf
+    partition, so a restore must prove it can replay the same layout (or a
+    remappable resize of it) before touching the buffers."""
+    if getattr(engine, "_comm_we", None) is None:
+        return None
+    topo = engine._comm_topo
+    plan = getattr(engine, "_overlap_plan", None)
+    geo = {"dp": int(engine.dp_size), "slice_size": int(topo.slice_size)}
+    if plan is not None:
+        geo["layout"] = "bucketed"
+        geo["buckets"] = [{"sizes": [int(s) for s in b["sizes"]],
+                           "n": int(b["n"]), "n_pad": int(b["n_pad"])}
+                          for b in plan]
+    else:
+        from ..comm.hierarchical import padded_size, tree_size
+        n_total = tree_size(engine.params)
+        geo["layout"] = "monolithic"
+        geo["n"] = int(n_total)
+        geo["n_pad"] = int(padded_size(n_total, engine.dp_size))
+    return geo
+
+
+def snapshot_checkpoint(engine, tag: Optional[str] = None, client_state: Dict = {}):
+    """Phase 1 of a save: materialize every checkpoint payload as HOST data.
+
+    Runs the device→host copies (and the multi-host collective gathers every
+    process must join) but touches NO files — the returned snapshot is
+    self-contained host state, so phase 2 (``write_snapshot``) can run on a
+    background writer thread while training keeps stepping
+    (resilience/async_ckpt.py). The step programs donate their state buffers,
+    but device_get copies to host before the next step runs, so the snapshot
+    can never observe a half-updated tree."""
     if tag is None:
         tag = f"global_step{engine.global_steps}"
-    ckpt_dir = _ckpt_dir(save_dir, tag)
-    os.makedirs(ckpt_dir, exist_ok=True)
     offload = getattr(engine, "_offload", None)
-
-    if offload is not None:
-        # host-tier state: each process writes its own regions (multi-host safe)
-        _save_offload_regions(engine, ckpt_dir)
-        if jax.process_index() == 0:
-            # a reused tag dir may hold files from an older, larger topology;
-            # current writers only touch indices < process_count, so this is safe
-            import glob as _glob
-            for stale in _glob.glob(os.path.join(ckpt_dir, "offload_manifest_*.json")):
-                idx = int(stale.rsplit("_", 1)[1].split(".")[0])
-                if idx >= jax.process_count():
-                    os.remove(stale)
-                    npz = os.path.join(ckpt_dir, offload_states_name(idx) + ".npz")
-                    if os.path.isfile(npz):
-                        os.remove(npz)
     # Multi-host: the model-states/scaler/optim-shard/latest files are shared paths —
     # exactly one WRITER (process 0), or concurrent identical-path np.savez calls
     # corrupt the archives. But cross-process sharded state (ZeRO masters, a
     # pipe-sharded wte) needs a collective gather that EVERY process participates in,
     # so ALL processes run every flatten below (offload included — no early return
-    # before the last flatten) and only the file writes are gated.
+    # before the last flatten) and only the payload retention is gated.
     writer = jax.process_index() == 0
+    files: Dict[str, Any] = {}  # filename -> ("npz", flat dict) | ("json", obj)
+
+    if offload is not None:
+        # host-tier state: each process snapshots its own regions (multi-host safe)
+        shard, off_manifest = _snapshot_offload_regions(engine)
+        proc = jax.process_index()
+        files[offload_states_name(proc) + ".npz"] = ("npz", shard)
+        files[f"offload_manifest_{proc}.json"] = ("json", off_manifest)
 
     # --- model states (replicated compute params + host-side counters) ---
     # _ckpt_export: engines with a non-canonical runtime layout (SPMD pipeline's
@@ -274,7 +408,7 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
     params_flat = _flatten_with_paths(engine._ckpt_export(engine.params, "params"),
                                       materialize=writer)
     if writer:
-        np.savez(os.path.join(ckpt_dir, model_states_name() + ".npz"), **params_flat)
+        files[model_states_name() + ".npz"] = ("npz", params_flat)
     meta = {
         "external_master": bool(getattr(engine, "_external_master", False)),
         "global_steps": engine.global_steps,
@@ -291,19 +425,19 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
         "client_state": client_state,
     }
     if writer:
-        with open(os.path.join(ckpt_dir, model_states_name() + ".json"), "w") as f:
-            json.dump(meta, f)
+        files[model_states_name() + ".json"] = ("json", meta)
 
     # --- scaler state ---
     scaler_flat = _flatten_with_paths(engine.scaler_state, materialize=writer)
     if writer:
-        np.savez(os.path.join(ckpt_dir, "loss_scaler.npz"), **scaler_flat)
+        files["loss_scaler.npz"] = ("npz", scaler_flat)
 
     if offload is None:
         # --- optimizer + master states, one file per DP rank (elastic layout) ---
         # external-master engines hold no master (it is byte-for-byte derivable as
         # the fp32 upcast of the saved params — writing it would triple the
         # checkpoint and materialize a full fp32 tree on device for nothing)
+        from ..runtime.zero.sharding import elastic_split
         dp = engine.dp_size
         if getattr(engine, "_external_master", False):
             master_flat = {}
@@ -313,25 +447,117 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_sta
         opt_flat = _flatten_with_paths(engine._ckpt_export(engine.opt_state, "opt"),
                                        materialize=writer)
         if writer:
+            split = {f"{prefix}/{key}": elastic_split(arr, dp)
+                     for prefix, flat in (("master", master_flat), ("opt", opt_flat))
+                     for key, arr in flat.items()}
             for dp_rank in range(dp):
-                shard = {}
-                for prefix, flat in (("master", master_flat), ("opt", opt_flat)):
-                    for key, arr in flat.items():
-                        parts = np.array_split(arr.reshape(-1), dp)
-                        shard[f"{prefix}/{key}"] = parts[dp_rank]
-                np.savez(os.path.join(ckpt_dir, optim_states_name(dp_rank) + ".npz"),
-                         **shard)
+                files[optim_states_name(dp_rank) + ".npz"] = (
+                    "npz", {key: parts[dp_rank] for key, parts in split.items()})
             # shape manifest for elastic restore
             shapes = {f"master/{k}": list(v.shape) for k, v in master_flat.items()}
             shapes.update({f"opt/{k}": list(v.shape) for k, v in opt_flat.items()})
-            with open(os.path.join(ckpt_dir, "optim_shapes.json"), "w") as f:
-                json.dump({"dp_world_size": dp, "shapes": shapes}, f)
+            files["optim_shapes.json"] = ("json", {"dp_world_size": dp,
+                                                  "shapes": shapes})
 
-    if save_latest and writer:
-        with open(os.path.join(save_dir, "latest"), "w") as f:
-            f.write(tag)
+    # --- engine-held compressed-comm error feedback (docs/resilience.md) ---
+    ef_geo = comm_ef_geometry(engine)
+    if ef_geo is not None:
+        ef_flat = _flatten_with_paths({"server_error": engine._comm_se,
+                                       "worker_error": engine._comm_we},
+                                      materialize=writer)
+        if writer:
+            files["comm_ef.npz"] = ("npz", ef_flat)
+            files["comm_ef.json"] = ("json", ef_geo)
+
+    return {"tag": str(tag), "writer": writer,
+            "single_process": jax.process_count() == 1,
+            "offload": offload is not None,
+            "n_procs": jax.process_count(),
+            "manifest_meta": {"tag": str(tag),
+                              "global_steps": int(engine.global_steps),
+                              "dp_world_size": int(engine.dp_size)},
+            "files": files}
+
+
+def _write_payloads(dirpath: str, files: Dict[str, Any]) -> None:
+    for name in sorted(files):
+        kind, payload = files[name]
+        path = os.path.join(dirpath, name)
+        if kind == "npz":
+            with open(path, "wb") as f:
+                np.savez(f, **payload)
+                f.flush()
+                os.fsync(f.fileno())
+        else:
+            with open(path, "w") as f:
+                json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+
+
+def write_snapshot(snapshot: Dict, save_dir: str, save_latest: bool = True) -> str:
+    """Phase 2 of a save: the commit protocol. Pure host file I/O — no device
+    access — so it is safe on a background writer thread.
+
+    Single-process: every file lands in ``<tag>.tmp/``, the integrity manifest
+    is written (itself via tmp + replace), everything is fsynced, and the tmp
+    dir is atomically renamed to ``<tag>/``. A crash at ANY point leaves
+    either the previous committed state or a ``.tmp`` dir restore ignores.
+
+    Multi-process: each process writes its own files straight into the final
+    dir (a cross-host dir rename cannot be made atomic without another
+    rendezvous); after the barrier, process 0 writes the manifest LAST, so a
+    torn multi-host save still presents as missing/mismatched manifest and is
+    skipped at restore. ``latest`` always updates via tmp + os.replace."""
+    tag = snapshot["tag"]
+    files = snapshot["files"]
+    final_dir = _ckpt_dir(save_dir, tag)
+    os.makedirs(save_dir, exist_ok=True)
+
+    if snapshot["single_process"]:
+        tmp_dir = final_dir + TMP_SUFFIX
+        if os.path.isdir(tmp_dir):
+            shutil.rmtree(tmp_dir)
+        os.makedirs(tmp_dir)
+        _write_payloads(tmp_dir, files)
+        write_manifest(tmp_dir, extra=snapshot["manifest_meta"])
+        _fsync_dir(tmp_dir)
+        if os.path.isdir(final_dir):
+            # re-saving an existing tag: the old dir must vacate the name. The
+            # crash window between rmtree and rename can lose THIS tag, but
+            # ``latest`` still points at a committed tag until the final step.
+            shutil.rmtree(final_dir)
+        os.rename(tmp_dir, final_dir)
+        _fsync_dir(save_dir)
+    else:
+        os.makedirs(final_dir, exist_ok=True)
+        if snapshot["offload"] and snapshot["writer"]:
+            # a reused tag dir may hold files from an older, larger topology;
+            # current writers only touch indices < n_procs, so this is safe
+            import glob as _glob
+            for stale in _glob.glob(os.path.join(final_dir, "offload_manifest_*.json")):
+                idx = int(stale.rsplit("_", 1)[1].split(".")[0])
+                if idx >= snapshot["n_procs"]:
+                    os.remove(stale)
+                    npz = os.path.join(final_dir, offload_states_name(idx) + ".npz")
+                    if os.path.isfile(npz):
+                        os.remove(npz)
+        _write_payloads(final_dir, files)
+        _save_barrier()
+        if snapshot["writer"]:
+            write_manifest(final_dir, extra=snapshot["manifest_meta"])
+
+    if save_latest and snapshot["writer"]:
+        write_latest(save_dir, tag)
+    return final_dir
+
+
+def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_state: Dict = {},
+                    save_latest: bool = True):
+    snapshot = snapshot_checkpoint(engine, tag=tag, client_state=client_state)
+    write_snapshot(snapshot, save_dir, save_latest=save_latest)
     _save_barrier()
-    logger.info(f"[deepspeed_tpu] saved checkpoint {tag} to {save_dir}")
+    logger.info(f"[deepspeed_tpu] saved checkpoint {snapshot['tag']} to {save_dir}")
     return True
 
 
@@ -368,6 +594,13 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     ckpt_dir = _ckpt_dir(load_dir, tag)
     if not os.path.isdir(ckpt_dir):
         logger.warning(f"Client provided checkpoint tag {tag} does not exist in {load_dir}")
+        return None, {}
+    ok, reason = verify_checkpoint(ckpt_dir)
+    if not ok:
+        # torn / partially-written save (a crash mid-write) — refuse it rather
+        # than load silently-corrupt state; auto-resume falls back to an older
+        # committed tag (resilience/auto_resume.py)
+        logger.warning(f"[deepspeed_tpu] REFUSING to load checkpoint {tag}: {reason}")
         return None, {}
 
     with open(os.path.join(ckpt_dir, model_states_name() + ".json")) as f:
@@ -469,6 +702,13 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         else:
             engine.master_params = engine._place_master(
                 jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32), engine.params))
+
+    if getattr(engine, "_comm_we", None) is not None:
+        # engine-held compressed-comm error feedback: restore (with elastic
+        # remap on a dp change) or, for pre-resilience checkpoints that never
+        # saved it, keep the zero-initialized buffers
+        from ..resilience.elastic import restore_comm_ef
+        restore_comm_ef(engine, ckpt_dir)
 
     logger.info(f"[deepspeed_tpu] loaded checkpoint {tag} from {load_dir} "
                 f"(saved dp={meta['dp_world_size']}, current dp={engine.dp_size})")

@@ -1,0 +1,31 @@
+"""Resilience layer: async sharded checkpointing, elastic restart, auto-resume,
+serving warm restart, and the crash-sim fault-injection harness.
+
+The pieces this wires together already exist in-repo — the donation lint
+proves no shadow copies race a snapshot, ``OneBitAdam.elastic_adapt`` remaps
+error-feedback buffers across a dp resize, and the flight recorder knows the
+first bad step and the journaled loss scale. This package turns them into one
+survivability story (docs/resilience.md):
+
+- ``async_ckpt``:  two-phase save — device→host snapshot on the step thread,
+  commit-protocol file writes on a background writer thread.
+- ``elastic``:     topology-changing restore of the engine-held compressed-comm
+  error-feedback buffers (monolithic AND PR 11 bucketed layouts), with a
+  geometry-validation pass that refuses mismatched layouts.
+- ``auto_resume``: pick the newest committed checkpoint *before* the flight
+  recorder's first bad step; restore the journaled loss scale.
+- ``serve_restart``: checkpoint/restore a serving replica's paged KV pool,
+  allocator, prefix-cache index, and scheduler ledger for warm rejoin.
+- ``crash_sim``:   kill/restart trainer and serve-sim runs at adversarial
+  points and assert bit-exact or documented-tolerance recovery.
+"""
+
+from .async_ckpt import AsyncCheckpointer
+from .auto_resume import auto_resume, find_resume_point
+from .elastic import restore_comm_ef
+from .serve_restart import (restore_server, save_server, server_state_dict,
+                            load_server_state)
+
+__all__ = ["AsyncCheckpointer", "auto_resume", "find_resume_point",
+           "restore_comm_ef", "restore_server", "save_server",
+           "server_state_dict", "load_server_state"]

@@ -1,0 +1,398 @@
+"""``ds-tpu crash-sim`` — fault-injection harness for the resilience layer.
+
+Kills and restarts trainer and serve-sim runs at adversarial points and
+asserts recovery, in-process and deterministically (the transcript is a pure
+function of the seed — ints/bools/strings only, no wall-clock, no floats —
+so CI golden-pins it byte-identically, scripts/lint.sh):
+
+- ``trainer_mid_save``      — die during a checkpoint COMMIT: the tmp dir is
+  fully written but never renamed. Restart must ignore the ``.tmp`` carcass,
+  resume from the previous committed tag, and retrain BIT-EQUAL to an
+  uninterrupted oracle.
+- ``trainer_between_shards`` — die between shard writes (simulated as a
+  committed tag with one optimizer shard torn afterwards): the manifest
+  checksum pass must refuse the tag, and auto-resume falls back to the older
+  committed one. Bit-equal retrain again.
+- ``trainer_auto_resume``   — a flight-recorder dump names the first bad
+  step; auto-resume must select the newest checkpoint strictly BEFORE it,
+  not the newest overall.
+- ``serve_mid_decode``      — kill a serving replica mid-decode-step, warm
+  restart from the serving snapshot: strictly fewer prefill chunks than a
+  cold restart, token-identical outputs vs the uninterrupted oracle, and the
+  request-trace waste identity (useful + replayed == scheduled) intact.
+- ``serve_post_preempt``    — same assertions with the kill landing right
+  after a pool-pressure preemption (the snapshot then carries parked prefix
+  pages AND requeued carry state at once).
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+
+HIDDEN = 16
+BATCH = 8
+TRAIN_STEPS = 8
+SAVE_STEP = 3
+KILL_STEP = 5
+
+
+class _MLP:
+    """Two-layer MLP returning MSE loss (the unit-test fixture model)."""
+
+    def __init__(self, hidden=HIDDEN):
+        self.hidden = hidden
+
+    def init(self, rng):
+        import jax
+        import jax.numpy as jnp
+        k1, k2 = jax.random.split(rng)
+        h = self.hidden
+        return {"w1": jax.random.normal(k1, (h, h), jnp.float32) * 0.1,
+                "b1": jnp.zeros((h,), jnp.float32),
+                "w2": jax.random.normal(k2, (h, h), jnp.float32) * 0.1,
+                "b2": jnp.zeros((h,), jnp.float32)}
+
+    def apply(self, params, x, y):
+        import jax.numpy as jnp
+        h = jnp.tanh(x @ params["w1"] + params["b1"])
+        out = h @ params["w2"] + params["b2"]
+        return jnp.mean(jnp.square(out - y))
+
+
+def _train_batches(n, seed):
+    rng = np.random.default_rng(seed)
+    w_true = np.random.default_rng(1234).normal(
+        size=(HIDDEN, HIDDEN)).astype(np.float32) * 0.3
+    out = []
+    for _ in range(n):
+        x = rng.normal(size=(BATCH, HIDDEN)).astype(np.float32)
+        out.append((x, np.tanh(x @ w_true)))
+    return out
+
+
+def _make_trainer(init_seed):
+    import jax
+
+    import deepspeed_tpu
+    model = _MLP()
+    params = model.init(jax.random.PRNGKey(init_seed))
+    cfg = {"train_batch_size": BATCH, "steps_per_print": 1 << 30,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config_params=cfg)
+    return engine
+
+
+def _train(engine, batches):
+    for x, y in batches:
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+
+
+def _masters_bit_equal(a, b):
+    import jax
+    la = jax.tree_util.tree_leaves(jax.device_get(a))
+    lb = jax.tree_util.tree_leaves(jax.device_get(b))
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb))
+
+
+def _trainer_scenario(kill_point, seed, workdir):
+    """One trainer kill/recover cycle. Returns the transcript record."""
+    from ..checkpoint.checkpointing import (_write_payloads,
+                                            snapshot_checkpoint)
+    from .async_ckpt import AsyncCheckpointer
+    from .auto_resume import auto_resume
+
+    save_dir = os.path.join(workdir, f"trainer_{kill_point}")
+    os.makedirs(save_dir, exist_ok=True)
+    batches = _train_batches(TRAIN_STEPS, seed)
+
+    oracle = _make_trainer(seed)
+    _train(oracle, batches)
+
+    victim = _make_trainer(seed)
+    ck = AsyncCheckpointer(victim, save_dir)
+    _train(victim, batches[:SAVE_STEP])
+    ck.save(tag=f"step{SAVE_STEP}")
+    _train(victim, batches[SAVE_STEP:KILL_STEP])
+
+    if kill_point == "mid_save":
+        # the commit dies after every payload is written but BEFORE the
+        # atomic rename: a fully-populated .tmp carcass restore must ignore
+        snap = snapshot_checkpoint(victim, tag=f"step{KILL_STEP}")
+        tmp = os.path.join(save_dir, f"step{KILL_STEP}.tmp")
+        os.makedirs(tmp, exist_ok=True)
+        _write_payloads(tmp, snap["files"])
+    else:  # between_shards: a committed tag torn afterwards (one shard
+        # truncated) — the manifest checksum pass must refuse the whole tag
+        ck.save(tag=f"step{KILL_STEP}")
+        ck.wait()
+        shard = os.path.join(save_dir, f"step{KILL_STEP}",
+                             "zero_pp_rank_0_mp_rank_00_optim_states.npz")
+        with open(shard, "r+b") as f:
+            f.truncate(max(os.path.getsize(shard) // 2, 1))
+    # the dead run's in-memory state is gone from here on
+
+    restarted = _make_trainer(seed + 1000)  # different init: restore must win
+    path, _, info = auto_resume(restarted, save_dir)
+    resumed = path is not None and info is not None
+    resumed_at_save_step = bool(
+        resumed and info["global_steps"] == SAVE_STEP
+        and restarted.global_steps == SAVE_STEP)
+    _train(restarted, batches[SAVE_STEP:])
+    bit_equal = _masters_bit_equal(oracle.master_params,
+                                   restarted.master_params)
+    return {"kill_point": kill_point, "resumed": bool(resumed),
+            "resumed_at_step": int(info["global_steps"]) if resumed else -1,
+            "resumed_at_save_step": resumed_at_save_step,
+            "retrained_bit_equal": bool(bit_equal),
+            "ok": bool(resumed_at_save_step and bit_equal)}
+
+
+def _auto_resume_scenario(seed, workdir):
+    """A flight-recorder dump pins the first bad step between two committed
+    checkpoints: selection must take the OLDER one."""
+    from .auto_resume import find_resume_point
+
+    save_dir = os.path.join(workdir, "trainer_auto_resume")
+    dump_dir = os.path.join(workdir, "dumps")
+    os.makedirs(dump_dir, exist_ok=True)
+    batches = _train_batches(TRAIN_STEPS, seed)
+    engine = _make_trainer(seed)
+    _train(engine, batches[:SAVE_STEP])
+    engine.save_checkpoint(save_dir, tag=f"step{SAVE_STEP}")
+    _train(engine, batches[SAVE_STEP:KILL_STEP])
+    engine.save_checkpoint(save_dir, tag=f"step{KILL_STEP}")
+    with open(os.path.join(dump_dir, "numerics_dump_host0_0.json"), "w") as f:
+        json.dump({"first_bad_step": SAVE_STEP + 1,
+                   "loss_scale_trajectory": [[SAVE_STEP, 1024.0],
+                                             [SAVE_STEP + 1, 512.0]]}, f)
+    info = find_resume_point(save_dir, dump_dir)
+    picked_before_bad = bool(info is not None
+                             and info["tag"] == f"step{SAVE_STEP}"
+                             and info["first_bad_step"] == SAVE_STEP + 1)
+    no_dump = find_resume_point(save_dir, None)
+    newest_without_dump = bool(no_dump is not None
+                               and no_dump["tag"] == f"step{KILL_STEP}")
+    return {"picked_before_bad_step": picked_before_bad,
+            "journal_scale_seen": bool(info is not None
+                                       and info["journal_scale"] == 512.0),
+            "newest_without_dump": newest_without_dump,
+            "ok": bool(picked_before_bad and newest_without_dump)}
+
+
+# ------------------------------------------------------------------ serving
+SERVE_GEOM = dict(num_slots=4, block_size=8, max_model_len=64,
+                  prefill_chunk=8)
+
+
+def _make_server(seed, num_blocks):
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.gpt2 import GPT2Config, GPT2Model
+    from ..serve.engine import InferenceEngine
+    cfg = GPT2Config(vocab_size=64, n_positions=SERVE_GEOM["max_model_len"],
+                     n_embd=32, n_layer=2, n_head=2,
+                     compute_dtype=jnp.float32, loss_chunk=0)
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(seed))
+    return InferenceEngine(
+        model, params, num_blocks=num_blocks, prefix_cache=True,
+        request_trace={"enabled": True, "capacity": 512}, **SERVE_GEOM)
+
+
+def _serve_trace(seed, gen_lo=4, gen_hi=10):
+    """Seeded greedy trace: shared 16-token system prefix (two full blocks —
+    prefix-cache food), no EOS, so the schedule is independent of token
+    VALUES and chunk counts are machine-independent."""
+    from ..serve.scheduler import Request
+    rng = np.random.RandomState(seed)
+    shared = rng.randint(0, 64, size=16).tolist()
+    reqs = []
+    for i in range(6):
+        tail = rng.randint(0, 64, size=int(rng.randint(6, 20))).tolist()
+        reqs.append(Request(f"req{i:02d}", shared + tail,
+                            int(rng.randint(gen_lo, gen_hi)), arrival=i))
+    return reqs
+
+
+def _drain(engine):
+    logs = []
+    guard = 0
+    while not engine.scheduler.idle:
+        if not engine.scheduler.running:
+            na = engine.scheduler.next_arrival()
+            if na is not None and na > engine._it:
+                engine._it = na
+        logs.append(engine.step())
+        guard += 1
+        if guard > 100000:
+            raise RuntimeError("crash-sim serving loop failed to drain")
+    return logs
+
+
+def _prefill_chunks(logs):
+    return sum(1 for l in logs if l.get("prefill") is not None)
+
+
+def _serve_scenario(kill_point, seed, workdir):
+    from ..serve.scheduler import pack_request, unpack_request
+    from .serve_restart import restore_server, save_server
+
+    # post_preempt needs pool pressure (tight pool + long generations so
+    # concurrent decode demand outruns the free list); mid_decode wants a
+    # roomy pool so the kill lands on plain decode progress
+    if kill_point == "post_preempt":
+        num_blocks, trace = 13, _serve_trace(seed, gen_lo=12, gen_hi=24)
+    else:
+        num_blocks, trace = 129, _serve_trace(seed)
+    save_dir = os.path.join(workdir, f"serve_{kill_point}")
+
+    oracle = _make_server(seed, num_blocks)
+    oracle_out, _ = oracle.run([unpack_request(pack_request(r))
+                                for r in trace])
+    oracle_tokens = {o.req_id: list(o.tokens) for o in oracle_out
+                     if o.status == "finished"}
+
+    victim = _make_server(seed, num_blocks)
+    for r in trace:
+        victim.submit(unpack_request(pack_request(r)))
+    # drive to the adversarial kill point (a pure function of the schedule)
+    armed = False
+    kill_it = -1
+    guard = 0
+    while not victim.scheduler.idle:
+        log = victim.step()
+        if kill_point == "mid_decode":
+            armed = armed or bool(log["decode"])
+        else:
+            armed = armed or bool(log["preempted"])
+        if armed:
+            kill_it = log["it"]
+            break
+        guard += 1
+        if guard > 100000:
+            raise RuntimeError(f"crash-sim never reached {kill_point}")
+    if not armed:  # trace drained before the adversarial point fired —
+        # a silent pass here would test nothing, so refuse loudly
+        raise RuntimeError(
+            f"crash-sim trace drained without reaching {kill_point}")
+    finished_at_kill = set(victim.outputs)
+    snap_dir = save_server(victim, save_dir)
+    # the dead replica's in-memory state is gone from here on
+
+    warm = _make_server(seed, num_blocks)
+    warm_ok = restore_server(warm, snap_dir)
+    warm_logs = _drain(warm)
+    warm_chunks = _prefill_chunks(warm_logs)
+    warm_tokens = {o.req_id: list(o.tokens) for o in warm.outputs.values()
+                   if o.status == "finished"}
+
+    cold = _make_server(seed, num_blocks)
+    pending = [r for r in trace if r.req_id not in finished_at_kill]
+    cold_out, cold_logs = cold.run([unpack_request(pack_request(r))
+                                    for r in pending])
+    cold_chunks = _prefill_chunks(cold_logs)
+    cold_tokens = {o.req_id: list(o.tokens) for o in cold_out
+                   if o.status == "finished"}
+
+    tokens_match_oracle = warm_tokens == oracle_tokens
+    cold_match = all(cold_tokens.get(r.req_id) == oracle_tokens.get(r.req_id)
+                     for r in pending)
+    fewer_chunks = warm_chunks < cold_chunks
+    ws = warm.tracer.waste_summary()
+    waste_identity = (ws["useful_tokens"] + ws["replayed_tokens"]
+                      == ws["scheduled_tokens"])
+    return {"kill_point": kill_point, "kill_iteration": int(kill_it),
+            "restored_warm": bool(warm_ok),
+            "finished_before_kill": int(len(finished_at_kill)),
+            "warm_prefill_chunks": int(warm_chunks),
+            "cold_prefill_chunks": int(cold_chunks),
+            "warm_fewer_chunks_than_cold": bool(fewer_chunks),
+            "tokens_match_oracle": bool(tokens_match_oracle),
+            "cold_tokens_match_oracle": bool(cold_match),
+            "waste_identity_intact": bool(waste_identity),
+            "ok": bool(warm_ok and fewer_chunks and tokens_match_oracle
+                       and cold_match and waste_identity)}
+
+
+KILL_POINTS = ("mid_save", "between_shards", "auto_resume", "mid_decode",
+               "post_preempt")
+
+
+def run_crash_sim(seed=0, kill_points=KILL_POINTS, workdir=None):
+    own_dir = workdir is None
+    if own_dir:
+        workdir = tempfile.mkdtemp(prefix="ds_tpu_crash_sim_")
+    try:
+        scenarios = {}
+        for kp in kill_points:
+            if kp in ("mid_save", "between_shards"):
+                scenarios[f"trainer_{kp}"] = _trainer_scenario(
+                    kp, seed, workdir)
+            elif kp == "auto_resume":
+                scenarios["trainer_auto_resume"] = _auto_resume_scenario(
+                    seed, workdir)
+            else:
+                scenarios[f"serve_{kp}"] = _serve_scenario(kp, seed, workdir)
+        return {"version": 1, "seed": int(seed), "scenarios": scenarios,
+                "ok": all(s["ok"] for s in scenarios.values())}
+    finally:
+        if own_dir:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="ds-tpu crash-sim",
+        description="Kill/restart trainer and serve-sim runs at adversarial "
+                    "points; assert bit-exact or documented-tolerance "
+                    "recovery.")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--kill-points", default="all",
+                        help="comma list of "
+                             f"{','.join(KILL_POINTS)} (default: all)")
+    parser.add_argument("--json", metavar="PATH",
+                        help="write the deterministic recovery transcript")
+    parser.add_argument("--workdir", default=None,
+                        help="keep checkpoints here instead of a tmp dir")
+    args = parser.parse_args(argv)
+
+    kps = (KILL_POINTS if args.kill_points == "all"
+           else tuple(args.kill_points.split(",")))
+    bad = [k for k in kps if k not in KILL_POINTS]
+    if bad:
+        print(f"crash-sim: unknown kill point(s): {bad}", file=sys.stderr)
+        return 2
+    transcript = run_crash_sim(seed=args.seed, kill_points=kps,
+                               workdir=args.workdir)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(transcript, f, indent=2, sort_keys=True)
+            f.write("\n")
+    print(f"crash-sim seed={args.seed}")
+    for name, s in transcript["scenarios"].items():
+        status = "PASS" if s["ok"] else "FAIL"
+        extra = ""
+        if "warm_prefill_chunks" in s:
+            extra = (f" (warm {s['warm_prefill_chunks']} vs cold "
+                     f"{s['cold_prefill_chunks']} prefill chunks)")
+        elif "retrained_bit_equal" in s:
+            extra = (f" (resumed at step {s['resumed_at_step']}, "
+                     f"bit-equal={s['retrained_bit_equal']})")
+        print(f"  {status} {name}{extra}")
+    print("crash-sim: all kill points recovered" if transcript["ok"]
+          else "crash-sim: RECOVERY FAILURES", flush=True)
+    return 0 if transcript["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,153 @@
+"""Topology-changing restore of the engine-held compressed-comm EF buffers.
+
+The engine's bucketed-overlap compressed exchange (runtime/engine.py) holds
+its error feedback as two ``(dp, cols)`` buffers whose column layout is the
+per-bucket chunks laid back to back — a function of the bucket plan (leaf
+partition), dp, and the topology's slice factor. A restore under a different
+dp cannot just reshape: each bucket's chunk→global-offset map changes with
+(dp, slice_size), exactly the problem ``OneBitAdam.elastic_adapt`` already
+solves for the monolithic optimizer-held buffers. This module generalizes
+that remap to the per-bucket layout:
+
+- the saved geometry (``comm_ef.json``, written by
+  ``checkpoint.comm_ef_geometry``) is validated against a replay of the LIVE
+  engine's bucket plan — same layout kind, same bucket count, same per-bucket
+  leaf ``sizes``/``n``. Anything else (different bucket_bytes, different
+  model, a monolithic↔bucketed flip) is REFUSED with ``ValueError`` instead
+  of silently corrupting the residuals;
+- a validated geometry with a different (dp, slice_size) is remapped
+  bucket-by-bucket with OneBitAdam's math: ``server_error`` by exact index
+  permutation (bit-identical on every real-data element), ``worker_error``
+  by the f64 slice-mean re-placement (mean-preserving — the strongest
+  invariant a topology change admits, see ops/onebit_adam.py:207).
+"""
+
+import json
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops.onebit_adam import OneBitAdam
+from ..parallel.mesh import DATA_AXIS
+from ..utils import logger
+
+
+def _geometry_blocks(geo):
+    """Per-bucket ``(n_pad, we_cols, se_cols)`` column spans of a saved/live
+    EF geometry — one block for the monolithic layout."""
+    dp, L = geo["dp"], geo["slice_size"]
+    if geo["layout"] == "bucketed":
+        return [(b["n_pad"], b["n_pad"] // L, b["n_pad"] // dp)
+                for b in geo["buckets"]]
+    return [(geo["n_pad"], geo["n_pad"] // L, geo["n_pad"] // dp)]
+
+
+def _validate_remappable(saved, live):
+    """Raise ValueError unless ``saved`` EF state can be carried into the
+    ``live`` layout. The per-bucket leaf sizes pin the chunk boundaries the
+    residuals were accumulated under — only (dp, slice_size) may differ."""
+    if saved["layout"] != live["layout"]:
+        raise ValueError(
+            f"checkpointed comm EF layout {saved['layout']!r} cannot restore "
+            f"into a {live['layout']!r} engine — the residual chunking "
+            f"differs structurally; refusing rather than corrupting")
+    if saved["layout"] == "bucketed":
+        s_b, l_b = saved["buckets"], live["buckets"]
+        if len(s_b) != len(l_b) or any(
+                tuple(a["sizes"]) != tuple(b["sizes"]) or a["n"] != b["n"]
+                for a, b in zip(s_b, l_b)):
+            raise ValueError(
+                f"checkpointed comm EF bucket plan ({len(s_b)} buckets) does "
+                f"not replay under the live engine ({len(l_b)} buckets) — "
+                f"bucket_bytes or the parameter tree changed; refusing "
+                f"rather than corrupting")
+    elif saved["n"] != live["n"]:
+        raise ValueError(
+            f"checkpointed comm EF covers {saved['n']} elements but the live "
+            f"parameter tree has {live['n']} — refusing rather than "
+            f"corrupting")
+
+
+def remap_ef_block(we, se, dp_o, L_o, np_o, dp_n, L_n, np_n):
+    """Remap one contiguous EF block (one bucket, or the monolithic whole)
+    from geometry (dp_o, L_o, np_o) to (dp_n, L_n, np_n). Same math as
+    ``OneBitAdam.elastic_adapt``'s per-kind branches."""
+    keep = min(np_o, np_n)
+    # server: the dp sub-chunks tile the padded vector exactly — permutation
+    g = np.zeros(np_o, np.float32)
+    cs_o = np_o // dp_o
+    for d, off in enumerate(OneBitAdam._server_offsets(dp_o, L_o, np_o)):
+        g[off:off + cs_o] = np.asarray(se)[d]
+    g_new = np.zeros(np_n, np.float32)
+    g_new[:keep] = g[:keep]
+    cs_n = np_n // dp_n
+    se_new = np.stack([g_new[off:off + cs_n]
+                       for off in OneBitAdam._server_offsets(dp_n, L_n, np_n)])
+    # worker: slice-sharers hold independent residuals; re-place their mean
+    C_o = np_o // L_o
+    gw = np.zeros(np_o, np.float64)
+    w64 = np.asarray(we, np.float64)
+    for l in range(L_o):
+        gw[l * C_o:(l + 1) * C_o] = w64[l::L_o].mean(axis=0)
+    gw_new = np.zeros(np_n, np.float64)
+    gw_new[:keep] = gw[:keep]
+    C_n = np_n // L_n
+    we_new = np.stack([gw_new[(d % L_n) * C_n:(d % L_n + 1) * C_n]
+                       for d in range(dp_n)]).astype(np.float32)
+    return we_new, se_new
+
+
+def restore_comm_ef(engine, ckpt_dir: str) -> bool:
+    """Restore (or elastically remap) the engine's ``_comm_we``/``_comm_se``
+    from a checkpoint dir. Returns True when the buffers were restored; False
+    for a pre-resilience checkpoint that never saved them (the engine keeps
+    its zero-initialized buffers — the reference's lazy-reallocation trade)."""
+    from ..checkpoint.checkpointing import comm_ef_geometry
+    live = comm_ef_geometry(engine)
+    if live is None:
+        return False
+    npz_path = os.path.join(ckpt_dir, "comm_ef.npz")
+    json_path = os.path.join(ckpt_dir, "comm_ef.json")
+    if not (os.path.isfile(npz_path) and os.path.isfile(json_path)):
+        logger.warning("[deepspeed_tpu] checkpoint holds no comm EF state "
+                       "(pre-resilience save) — compression restarts with "
+                       "zero residuals")
+        return False
+    with open(json_path) as f:
+        saved = json.load(f)
+    with np.load(npz_path) as data:
+        we_s = data["worker_error"]
+        se_s = data["server_error"]
+
+    sharding = NamedSharding(engine.mesh, P(DATA_AXIS, None))
+    if saved == live:
+        # identical geometry: bit-identical passthrough
+        engine._comm_we = jax.device_put(jnp.asarray(we_s, jnp.float32), sharding)
+        engine._comm_se = jax.device_put(jnp.asarray(se_s, jnp.float32), sharding)
+        return True
+
+    _validate_remappable(saved, live)
+    dp_o, L_o = saved["dp"], saved["slice_size"]
+    dp_n, L_n = live["dp"], live["slice_size"]
+    we_parts, se_parts = [], []
+    wo = so = 0
+    for (np_o, wc_o, sc_o), (np_n, _, _) in zip(_geometry_blocks(saved),
+                                                _geometry_blocks(live)):
+        we_b, se_b = remap_ef_block(we_s[:, wo:wo + wc_o],
+                                    se_s[:, so:so + sc_o],
+                                    dp_o, L_o, np_o, dp_n, L_n, np_n)
+        we_parts.append(we_b)
+        se_parts.append(se_b)
+        wo += wc_o
+        so += sc_o
+    engine._comm_we = jax.device_put(
+        jnp.asarray(np.concatenate(we_parts, axis=1), jnp.float32), sharding)
+    engine._comm_se = jax.device_put(
+        jnp.asarray(np.concatenate(se_parts, axis=1), jnp.float32), sharding)
+    logger.info(f"[deepspeed_tpu] remapped comm EF state dp={dp_o} "
+                f"slice={L_o} -> dp={dp_n} slice={L_n}")
+    return True

@@ -1,0 +1,111 @@
+"""Serving warm restart: checkpoint/restore of a paged-serving replica.
+
+A preempted serving replica loses host state (scheduler ledger, allocator
+free list, prefix-cache index) and device state (the paged KV pool). The
+expensive part to rebuild is the pool: every cached prompt page holds KV a
+cold replica must re-prefill. This module persists BOTH halves with the same
+commit protocol the trainer checkpoints use (tmp dir → fsync → atomic rename
++ integrity manifest — checkpoint/checkpointing.py), so a warm restart:
+
+- verifies the manifest and REFUSES a torn snapshot (never loads it);
+- validates the engine geometry (``InferenceEngine.geometry``) and refuses a
+  snapshot from a differently-shaped replica (page indices and pool bytes
+  are meaningless under another layout);
+- restores the pool bytes, allocator ledger (free-list/cached-tier ORDER
+  included — allocation determinism depends on it), prefix-cache index, and
+  requeued in-flight requests — which then rejoin through the PR 12 prefix
+  machinery: parked prompt pages remap into their new tables instead of
+  re-prefilling, which is exactly what makes the restart *warm* (crash-sim
+  asserts strictly fewer prefill chunks than a cold start, token-identical
+  outputs).
+
+The snapshot itself quiesces the scheduler (``Scheduler.quiesce``): every
+running group is preempted — its prefill frontier registers in the prefix
+cache and its requests requeue at their original positions — leaving a
+ledger with no live Group objects to serialize.
+"""
+
+import json
+import os
+import shutil
+
+import numpy as np
+
+from ..checkpoint.checkpointing import (TMP_SUFFIX, _fsync_dir,
+                                        verify_checkpoint, write_manifest)
+from ..utils import logger
+
+STATE_JSON = "serve_state.json"
+POOL_NPZ = "serve_pool.npz"
+
+
+def server_state_dict(engine) -> dict:
+    """Snapshot a serving engine (quiesces it). Alias for
+    ``InferenceEngine.state_dict`` so callers can stay serve-agnostic."""
+    return engine.state_dict()
+
+
+def save_server(engine, save_dir: str, tag: str = "serve") -> str:
+    """Snapshot ``engine`` and commit it under ``save_dir/tag/`` atomically.
+    Returns the committed directory path."""
+    state = server_state_dict(engine)
+    k_pool = state.pop("k_pool")
+    v_pool = state.pop("v_pool")
+    final_dir = os.path.join(save_dir, tag)
+    tmp_dir = final_dir + TMP_SUFFIX
+    if os.path.isdir(tmp_dir):
+        shutil.rmtree(tmp_dir)
+    os.makedirs(tmp_dir)
+    with open(os.path.join(tmp_dir, POOL_NPZ), "wb") as f:
+        np.savez(f, k_pool=k_pool, v_pool=v_pool)
+        f.flush()
+        os.fsync(f.fileno())
+    with open(os.path.join(tmp_dir, STATE_JSON), "w") as f:
+        json.dump(state, f)
+        f.flush()
+        os.fsync(f.fileno())
+    write_manifest(tmp_dir, extra={"kind": "serve", "tag": tag,
+                                   "it": int(state["it"])})
+    _fsync_dir(tmp_dir)
+    if os.path.isdir(final_dir):
+        shutil.rmtree(final_dir)
+    os.rename(tmp_dir, final_dir)
+    _fsync_dir(save_dir)
+    logger.info(f"[deepspeed_tpu] serving snapshot committed to {final_dir}")
+    return final_dir
+
+
+def load_server_state(ckpt_dir: str):
+    """Read a committed serving snapshot back into a ``state_dict``-shaped
+    dict, or None for a missing/torn snapshot (refused, never loaded)."""
+    ok, reason = verify_checkpoint(ckpt_dir)
+    if not ok:
+        logger.warning(f"[deepspeed_tpu] REFUSING serving snapshot "
+                       f"{ckpt_dir}: {reason}")
+        return None
+    try:
+        with open(os.path.join(ckpt_dir, STATE_JSON)) as f:
+            state = json.load(f)
+        with np.load(os.path.join(ckpt_dir, POOL_NPZ)) as data:
+            state["k_pool"] = data["k_pool"]
+            state["v_pool"] = data["v_pool"]
+    except (OSError, ValueError, KeyError) as e:
+        logger.warning(f"[deepspeed_tpu] REFUSING serving snapshot "
+                       f"{ckpt_dir}: unreadable ({e})")
+        return None
+    return state
+
+
+def restore_server(engine, ckpt_dir: str) -> bool:
+    """Load a committed snapshot into ``engine``. Returns False when the
+    snapshot is missing/torn (caller starts cold); raises ValueError on a
+    geometry mismatch (restarting into the wrong shape is a config bug, not
+    a recoverable condition)."""
+    state = load_server_state(ckpt_dir)
+    if state is None:
+        return False
+    engine.load_state_dict(state)
+    logger.info(f"[deepspeed_tpu] serving replica rejoined warm from "
+                f"{ckpt_dir} (it={engine._it}, "
+                f"{len(engine.scheduler.waiting)} requests requeued)")
+    return True

@@ -439,6 +439,29 @@ class DeepSpeedConfig:
                 f"got {bmb!r}")
         self.comm_overlap_bucket_mb = float(bmb)
 
+        rs_dict = param_dict.get(RESILIENCE, {})
+        self._warn_unknown_nested(RESILIENCE, rs_dict, RESILIENCE_CONFIG_KEYS)
+        self.resilience_enabled = get_scalar_param(rs_dict, RESILIENCE_ENABLED,
+                                                   RESILIENCE_ENABLED_DEFAULT)
+        self.resilience_save_dir = get_scalar_param(rs_dict, RESILIENCE_SAVE_DIR,
+                                                    RESILIENCE_SAVE_DIR_DEFAULT)
+        self.resilience_save_interval = get_scalar_param(rs_dict, RESILIENCE_SAVE_INTERVAL,
+                                                         RESILIENCE_SAVE_INTERVAL_DEFAULT)
+        self.resilience_async_save = get_scalar_param(rs_dict, RESILIENCE_ASYNC_SAVE,
+                                                      RESILIENCE_ASYNC_SAVE_DEFAULT)
+        self.resilience_auto_resume = get_scalar_param(rs_dict, RESILIENCE_AUTO_RESUME,
+                                                       RESILIENCE_AUTO_RESUME_DEFAULT)
+        val = self.resilience_save_interval
+        if isinstance(val, bool) or not isinstance(val, int) or val < 0:
+            raise ValueError(
+                "DeepSpeedConfig: resilience.save_interval must be an int >= 0 "
+                f"(0 = no periodic saves), got {val!r}")
+        if self.resilience_enabled and self.resilience_save_interval > 0 \
+                and not self.resilience_save_dir:
+            raise ValueError(
+                "DeepSpeedConfig: resilience.save_interval > 0 requires "
+                "resilience.save_dir to be set")
+
         self.sparse_attention = None
         if SPARSE_ATTENTION in param_dict:
             self.sparse_attention = SparseAttentionConfig(param_dict[SPARSE_ATTENTION])

@@ -241,6 +241,25 @@ NUMERICS_INSTALL_SIGNAL_HANDLERS = "install_signal_handlers"
 NUMERICS_INSTALL_SIGNAL_HANDLERS_DEFAULT = False
 
 #############################################
+# Resilience (TPU-native fault tolerance, no reference key — async sharded
+# checkpointing with a torn-write-proof commit protocol, topology-changing
+# restore, flight-recorder-driven auto-resume. See docs/resilience.md. All
+# hooks are host-side: with the block disabled (the default) the lowered
+# step program is HLO-instruction-identical to a build without it.)
+#############################################
+RESILIENCE = "resilience"
+RESILIENCE_ENABLED = "enabled"
+RESILIENCE_ENABLED_DEFAULT = False
+RESILIENCE_SAVE_DIR = "save_dir"
+RESILIENCE_SAVE_DIR_DEFAULT = ""
+RESILIENCE_SAVE_INTERVAL = "save_interval"
+RESILIENCE_SAVE_INTERVAL_DEFAULT = 0  # 0 = no periodic saves
+RESILIENCE_ASYNC_SAVE = "async_save"
+RESILIENCE_ASYNC_SAVE_DEFAULT = True
+RESILIENCE_AUTO_RESUME = "auto_resume"
+RESILIENCE_AUTO_RESUME_DEFAULT = False
+
+#############################################
 # Serving (TPU-native inference engine, no reference key — the reference
 # 0.3.0 ships no inference path. Block-paged KV cache + continuous batching;
 # see docs/serving.md. Sizes are in tokens; the pool holds num_blocks pages of
@@ -453,6 +472,7 @@ TOP_LEVEL_CONFIG_KEYS = frozenset({
     TENSORBOARD,
     TELEMETRY,
     NUMERICS,
+    RESILIENCE,
     SERVING,
     COMM,
     SPARSE_ATTENTION,
@@ -552,4 +572,12 @@ COMM_CONFIG_KEYS = frozenset({
 COMM_OVERLAP_CONFIG_KEYS = frozenset({
     COMM_OVERLAP_MODE,
     COMM_OVERLAP_BUCKET_MB,
+})
+
+RESILIENCE_CONFIG_KEYS = frozenset({
+    RESILIENCE_ENABLED,
+    RESILIENCE_SAVE_DIR,
+    RESILIENCE_SAVE_INTERVAL,
+    RESILIENCE_ASYNC_SAVE,
+    RESILIENCE_AUTO_RESUME,
 })

@@ -554,6 +554,20 @@ class DeepSpeedEngine:
 
         self._compile_steps()
 
+        # ---- resilience (docs/resilience.md): periodic async checkpointing +
+        # flight-recorder-driven auto-resume. Everything here is host-side —
+        # the save hook snapshots committed step state and commits in a
+        # background thread — so with the block disabled the lowered step
+        # programs are HLO-instruction-identical to a build without it.
+        self._resilience = None
+        if self.config.resilience_enabled and self.config.resilience_save_dir:
+            from ..resilience.async_ckpt import AsyncCheckpointer
+            self._resilience = AsyncCheckpointer(
+                self, self.config.resilience_save_dir)
+            if self.config.resilience_auto_resume:
+                from ..resilience.auto_resume import auto_resume
+                auto_resume(self, self.config.resilience_save_dir)
+
         if self.config.dump_state:
             self.config.print("DeepSpeedEngine configuration")
 
@@ -1942,6 +1956,15 @@ class DeepSpeedEngine:
         if self._numerics is not None:
             self._commit_numerics(numerics_host, overflowed, self._window_losses)
         self._window_losses = []
+        interval = self.config.resilience_save_interval
+        if (self._resilience is not None and interval > 0
+                and self.global_steps % interval == 0):
+            # snapshot on this thread (device->host of committed step state),
+            # commit in the background — the next step never fences on the
+            # filesystem. async_save=False degrades to the synchronous path.
+            self._resilience.save(tag=f"global_step{self.global_steps}")
+            if not self.config.resilience_async_save:
+                self._resilience.wait()
         if self.wall_clock_breakdown():
             self.timers("step_microstep").stop()
             self.timers.log(["forward_microstep", "backward_microstep", "step_microstep"],

@@ -95,6 +95,15 @@ def chunk_spans(total: int, cap: Optional[int]):
     return spans
 
 
+def elastic_split(arr, dp: int):
+    """Split a host array into the ``dp`` flat checkpoint shards of the elastic
+    optimizer-state layout (checkpoint/checkpointing.py). np.array_split
+    semantics — first ``size % dp`` shards get one extra element — which is
+    exactly what ``_merge_elastic`` concatenates back, so save@dp_a →
+    restore@dp_b round-trips bit-exactly for any (dp_a, dp_b)."""
+    return np.array_split(np.asarray(arr).reshape(-1), dp)
+
+
 def replicated_sharding(mesh: Mesh, tree):
     import jax
     return jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), tree)

@@ -169,6 +169,46 @@ class BlockAllocator:
         self.cow_copies += 1
         return fresh, (block, fresh)
 
+    # ------------------------------------------------------- warm restart
+    _COUNTERS = ("alloc_count", "free_count", "fork_count", "cow_copies",
+                 "cached_count", "cache_evictions", "cache_revivals")
+
+    def state_dict(self) -> dict:
+        """Full allocator bookkeeping as plain host data (lists of pairs, not
+        dicts keyed by int — JSON round-trips must not stringify block ids).
+        Cache keys serialize as chains via prefix_cache.key_to_chain. Free-list
+        and cached-tier ORDER is part of the state: allocation determinism
+        (and therefore byte-identical schedule replay after a warm restart)
+        depends on it."""
+        from .prefix_cache import key_to_chain
+        return {
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "free": list(self._free),
+            "refcount": [[b, c] for b, c in self._refcount.items()],
+            "cache_keys": [[b, key_to_chain(k)]
+                           for b, k in self._cache_keys.items()],
+            "cached": [[b, key_to_chain(k)] for b, k in self._cached.items()],
+            "counters": {k: getattr(self, k) for k in self._COUNTERS},
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        from .prefix_cache import chain_to_key
+        if (state["num_blocks"] != self.num_blocks
+                or state["block_size"] != self.block_size):
+            raise ValueError(
+                f"allocator geometry mismatch: checkpoint has "
+                f"{state['num_blocks']}x{state['block_size']}-token pages, "
+                f"this pool is {self.num_blocks}x{self.block_size}")
+        self._free = deque(int(b) for b in state["free"])
+        self._refcount = {int(b): int(c) for b, c in state["refcount"]}
+        self._cache_keys = {int(b): chain_to_key(ch)
+                            for b, ch in state["cache_keys"]}
+        self._cached = OrderedDict((int(b), chain_to_key(ch))
+                                   for b, ch in state["cached"])
+        for k in self._COUNTERS:
+            setattr(self, k, int(state["counters"][k]))
+
     # ------------------------------------------------------------ cache tier
     def set_evict_hook(self, fn) -> None:
         """``fn(block, key)`` fires when a parked cached page is reclaimed by

@@ -507,6 +507,86 @@ class InferenceEngine:
                 f"{float(np.max(np.abs(a - b)))!r}")
         self.mirror_checks += 1
 
+    # ------------------------------------------------------- warm restart
+    _OUT_FIELDS = ("req_id", "status", "tokens", "score", "refusal",
+                   "ttft_iters", "ttft_ms", "finished_it", "preemptions")
+
+    def geometry(self) -> dict:
+        """Everything the paged programs' shapes (and therefore the KV pool
+        bytes) depend on — a warm restart into a different geometry would
+        read pages laid out for another engine, so restore validates this."""
+        c = self.model.config
+        return {"num_slots": self.num_slots, "block_size": self.block_size,
+                "num_blocks": self.num_blocks,
+                "max_model_len": self.max_model_len,
+                "prefill_chunk": self.prefill_chunk, "tp": self.tp,
+                "n_layer": int(c.n_layer), "n_head": int(c.n_head),
+                "head_dim": int(c.head_dim),
+                "compute_dtype": str(jnp.dtype(c.compute_dtype).name)}
+
+    def state_dict(self) -> dict:
+        """Warm-restart snapshot: quiesces the scheduler (preempting every
+        running group so its prefill frontier parks in the prefix cache),
+        then captures the KV pools, the allocator/cache/scheduler ledgers,
+        and the request bookkeeping as host data. The restored replica remaps
+        parked prompt pages through the prefix machinery instead of
+        re-prefilling (docs/resilience.md)."""
+        from .scheduler import pack_request  # noqa: F401  (re-export site)
+        self.scheduler.quiesce()
+        return {
+            "geometry": self.geometry(),
+            "scheduler": self.scheduler.state_dict(),
+            "it": self._it,
+            "order": list(self._order),
+            "outputs": [{k: getattr(o, k) for k in self._OUT_FIELDS}
+                        for o in self.outputs.values()],
+            "tokens_sampled": self._tokens_sampled,
+            "tokens_finished": self._tokens_finished,
+            "k_pool": np.asarray(self.k_pool),
+            "v_pool": np.asarray(self.v_pool),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        """Rejoin warm from a ``state_dict`` snapshot. Refuses (ValueError) a
+        snapshot whose geometry does not match this engine — page indices and
+        pool bytes are only meaningful under the exact same layout."""
+        mine, theirs = self.geometry(), state["geometry"]
+        if mine != theirs:
+            diff = {k: (theirs.get(k), mine.get(k))
+                    for k in sorted(set(mine) | set(theirs))
+                    if theirs.get(k) != mine.get(k)}
+            raise ValueError(f"serving warm restart refused: checkpoint "
+                             f"geometry does not match this engine "
+                             f"(checkpoint vs live): {diff}")
+        self.scheduler.load_state_dict(state["scheduler"])
+        self._it = int(state["it"])
+        self._order = list(state["order"])
+        self.outputs = {d["req_id"]: RequestOutput(**d)
+                        for d in state["outputs"]}
+        self._tokens_sampled = int(state["tokens_sampled"])
+        self._tokens_finished = int(state["tokens_finished"])
+        c = self.model.config
+        self.k_pool = jnp.asarray(state["k_pool"], c.compute_dtype)
+        self.v_pool = jnp.asarray(state["v_pool"], c.compute_dtype)
+        if self._mesh is not None:
+            import jax
+            self.k_pool = jax.device_put(self.k_pool,
+                                         self._raw["pool_sharding"])
+            self.v_pool = jax.device_put(self.v_pool,
+                                         self._raw["pool_sharding"])
+        # wall-clock bookkeeping restarts: TTFT-ms of still-pending requests
+        # is measured from the rejoin (iteration-time TTFT is exact)
+        now = time.perf_counter()
+        self._submit_ms = {r.req_id: now
+                           for r, _ in self.scheduler.waiting}
+        if self.tracer is not None:
+            # requeued requests re-enter this replica's ledger fresh — their
+            # pre-kill history died with the old process, and TTFT after a
+            # warm restart is TTFT as experienced from the rejoin
+            for r, _ in self.scheduler.waiting:
+                self.tracer.on_submit(r)
+        self._start_wall = None
+
     # ------------------------------------------------------------------ lint
     def lint_programs(self, sample_batch=None):
         """(name, jitted, example_args, manifest) for the lint registry —

@@ -39,6 +39,27 @@ Design:
 from .block_allocator import BlockAllocator
 
 
+def key_to_chain(key):
+    """Nested-tuple chain key -> JSON-serializable list of token lists
+    (outermost block last, i.e. prompt order). Inverse of chain_to_key."""
+    out = []
+    while key is not None:
+        key, toks = key
+        out.append([int(t) for t in toks])
+    out.reverse()
+    return out
+
+
+def chain_to_key(chain):
+    """Fold a serialized chain back into the exact nested-tuple key — the
+    rebuilt key is ``==``/hash-identical to the original, so a warm-restarted
+    cache hits the same chains the pre-kill cache did."""
+    key = None
+    for toks in chain:
+        key = (key, tuple(int(t) for t in toks))
+    return key
+
+
 class PrefixCache:
     def __init__(self, allocator: BlockAllocator, block_size: int):
         self.allocator = allocator
@@ -111,6 +132,21 @@ class PrefixCache:
     def _on_evict(self, block, key):
         # the page's device bytes are being reclaimed — forget the mapping
         self._by_key.pop(key, None)
+
+    # ------------------------------------------------------- warm restart
+    def state_dict(self) -> dict:
+        return {
+            "by_key": [[key_to_chain(k), b] for k, b in self._by_key.items()],
+            "counters": {k: getattr(self, k) for k in
+                         ("hits", "misses", "hit_tokens", "lookup_tokens",
+                          "registered_blocks")},
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self._by_key = {chain_to_key(ch): int(b)
+                        for ch, b in state["by_key"]}
+        for k, v in state["counters"].items():
+            setattr(self, k, int(v))
 
     # --------------------------------------------------------------- stats
     def stats(self):

@@ -33,6 +33,37 @@ stay byte-identical with the cache on.
 from .block_allocator import AllocationError, BlockAllocator
 from .prefix_cache import PrefixCache
 
+_REQ_FIELDS = ("req_id", "prompt", "max_new_tokens", "arrival", "num_beams",
+               "length_penalty", "temperature", "top_k", "top_p", "seed")
+_REQ_CARRY = ("_preemptions_carry", "_replay_prefill_hwm", "_replay_decode_hwm")
+
+
+def pack_request(req) -> dict:
+    """Request -> plain dict (warm-restart serialization). The replay
+    high-water marks and preemption count a preempted attempt carries ride
+    along, so the restarted replica's waste accounting stays truthful."""
+    d = {k: getattr(req, k) for k in _REQ_FIELDS}
+    d["prompt"] = list(req.prompt)
+    # the ctor normalizes None -> -1; -1 round-trips through int() unchanged
+    d["eos_token_id"] = req.eos_token_id
+    for k in _REQ_CARRY:
+        if hasattr(req, k):
+            d[k] = getattr(req, k)
+    return d
+
+
+def unpack_request(d: dict):
+    req = Request(d["req_id"], d["prompt"], d["max_new_tokens"],
+                  arrival=d["arrival"], num_beams=d["num_beams"],
+                  eos_token_id=d["eos_token_id"],
+                  length_penalty=d["length_penalty"],
+                  temperature=d["temperature"], top_k=d["top_k"],
+                  top_p=d["top_p"], seed=d["seed"])
+    for k in _REQ_CARRY:
+        if k in d:
+            setattr(req, k, d[k])
+    return req
+
 
 class Request:
     """One serving request. ``arrival`` is the iteration index at which the
@@ -380,6 +411,52 @@ class Scheduler:
         self.free_slots.extend(g.slots)
         self.free_slots.sort()
         self.running.remove(g)
+
+    # ------------------------------------------------------- warm restart
+    def quiesce(self):
+        """Preempt every running group (latest-admitted first — the same
+        victim order pool pressure uses). After this the ledger is fully
+        serializable: no Group objects, every in-flight request requeued at
+        its original position with its prefill frontier registered in the
+        prefix cache — a restart resumes warm instead of re-prefilling.
+        Returns the preempted groups (their pages are now parked or free)."""
+        victims = sorted(self.running, key=lambda g: -g.admission_idx)
+        for g in victims:
+            self._preempt(g)
+        return victims
+
+    def state_dict(self) -> dict:
+        """Serializable scheduler ledger. Call ``quiesce`` first — running
+        groups hold live page tables this snapshot cannot represent."""
+        if self.running:
+            raise RuntimeError("state_dict requires a quiesced scheduler "
+                               f"({len(self.running)} groups still running)")
+        return {
+            "waiting": [[pack_request(r), idx] for r, idx in self.waiting],
+            "free_slots": list(self.free_slots),
+            "submit_counter": self._submit_counter,
+            "admission_counter": self._admission_counter,
+            "allocator": self.allocator.state_dict(),
+            "prefix_cache": (self.prefix_cache.state_dict()
+                             if self.prefix_cache is not None else None),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        if (state["prefix_cache"] is not None) != (self.prefix_cache is not None):
+            raise ValueError("prefix_cache on/off mismatch between the "
+                             "checkpointed scheduler and this one")
+        self.allocator.load_state_dict(state["allocator"])
+        if self.prefix_cache is not None:
+            self.prefix_cache.load_state_dict(state["prefix_cache"])
+        # rebuilt directly, NOT via submit(): submit would re-number
+        # submit_idx and lose the original queue positions
+        self.waiting = [(unpack_request(d), int(idx))
+                        for d, idx in state["waiting"]]
+        self.waiting.sort(key=lambda e: (e[0].arrival, e[1]))
+        self.free_slots = [int(s) for s in state["free_slots"]]
+        self._submit_counter = int(state["submit_counter"])
+        self._admission_counter = int(state["admission_counter"])
+        self.running = []
 
     # ------------------------------------------------------------------ misc
     def occupancy(self):

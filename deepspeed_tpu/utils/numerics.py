@@ -33,6 +33,7 @@ import atexit
 import json
 import math
 import os
+import re
 import signal
 import time
 from collections import deque
@@ -481,6 +482,32 @@ class NumericsMonitor:
 
 
 # ---------------------------------------------------------------- inspector
+
+
+def scan_dump_dir(dump_dir):
+    """Newest flight-recorder bundle in ``dump_dir`` (by host, then dump
+    index — the recorder numbers dumps monotonically per host), or None when
+    the dir holds none. Pure host file I/O — the auto-resume path
+    (resilience/auto_resume.py) calls this before any engine exists."""
+    if not dump_dir or not os.path.isdir(dump_dir):
+        return None
+    best = None
+    best_key = None
+    for name in os.listdir(dump_dir):
+        m = re.match(r"numerics_dump_host(\d+)_(\d+)\.json$", name)
+        if not m:
+            continue
+        key = (int(m.group(2)), int(m.group(1)))
+        if best_key is None or key > best_key:
+            best_key = key
+            best = os.path.join(dump_dir, name)
+    if best is None:
+        return None
+    try:
+        with open(best) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None  # a torn dump must not block resume
 
 
 def summarize_dump(bundle):

@@ -76,9 +76,19 @@ timeout -k 10 300 "$REPO/bin/ds-tpu" anatomy --json --out /tmp/_anatomy.json \
 && cmp "$REPO/tests/unit/golden/anatomy_comm_compare.json" \
        /tmp/_anatomy_comm.json
 anatomy_rc=$?
+# crash-sim: seeded kill-point sweep (mid-save, between shard writes,
+# auto-resume selection, mid-decode, post-preemption) — every scenario must
+# recover (bit-equal retrain / warm token-identical restart), and the
+# recovery transcript is byte-compared against the committed golden so any
+# drift in recovery behavior (chunk counts, resume selection) fails CI
+timeout -k 10 600 "$REPO/bin/ds-tpu" crash-sim --json /tmp/_crash_sim.json \
+&& cmp "$REPO/tests/unit/golden/crash_sim_transcript.json" \
+       /tmp/_crash_sim.json
+crash_rc=$?
 [ "$lint_rc" -ne 0 ] && exit "$lint_rc"
 [ "$comm_rc" -ne 0 ] && exit "$comm_rc"
 [ "$serve_rc" -ne 0 ] && exit "$serve_rc"
 [ "$cache_rc" -ne 0 ] && exit "$cache_rc"
 [ "$shard_rc" -ne 0 ] && exit "$shard_rc"
-exit "$anatomy_rc"
+[ "$anatomy_rc" -ne 0 ] && exit "$anatomy_rc"
+exit "$crash_rc"

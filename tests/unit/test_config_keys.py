@@ -152,6 +152,20 @@ SWEEP = {
         ({"prefix_cache": {"enabled": True}},
          ("attr", "serving_prefix_cache_enabled", True)),
     ),
+    "resilience": (
+        ({"enabled": True, "save_dir": "/tmp/ckpt"},
+         ("attr", "resilience_enabled", True)),
+        ({"save_dir": "/tmp/ckpt"}, ("attr", "resilience_save_dir", "/tmp/ckpt")),
+        ({"save_dir": "/tmp/ckpt", "save_interval": 50},
+         ("attr", "resilience_save_interval", 50)),
+        ({"async_save": False}, ("attr", "resilience_async_save", False)),
+        ({"auto_resume": True}, ("attr", "resilience_auto_resume", True)),
+        ({"save_interval": -1}, ("raise", ValueError)),
+        ({"save_interval": True}, ("raise", ValueError)),
+        # periodic saves with nowhere to put them is a config bug, not a no-op
+        ({"enabled": True, "save_interval": 5}, ("raise", ValueError)),
+        ({"nonsense_key": 1}, ("warn", "unknown resilience")),
+    ),
     "comm": (
         ({"mode": "hierarchical"}, ("attr", "comm_mode", "hierarchical")),
         ({"mode": "hierarchical_compressed", "compress_start_step": 5},
