@@ -35,6 +35,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import DATA_AXIS, shard_map
+from ..utils.cluster import named_scope as ds_named_scope
 from ..runtime.custom_collectives import _signs_collective, padded_size
 from .topology import CommTopology
 
@@ -180,7 +181,7 @@ def bucketed_two_level_mean(leaves, plan, topo: CommTopology,
     dp = topo.dp
     out = [None] * len(leaves)
     for k, bucket in enumerate(plan):
-        with jax.named_scope(f"{GRAD_BUCKET_SCOPE}{k}"):
+        with ds_named_scope(f"{GRAD_BUCKET_SCOPE}{k}"):
             mean = two_level_sum(_bucket_vec(leaves, bucket), topo,
                                  axis_name) / dp
             _bucket_unpack(mean, bucket, leaves, out)
@@ -205,7 +206,7 @@ def bucketed_two_level_compressed(leaves, we_local, se_local, plan,
     for k, bucket in enumerate(plan):
         n_pad = bucket["n_pad"]
         wcols, scols = n_pad // L, n_pad // dp
-        with jax.named_scope(f"{GRAD_BUCKET_SCOPE}{k}"):
+        with ds_named_scope(f"{GRAD_BUCKET_SCOPE}{k}"):
             vec = _bucket_vec(leaves, bucket).astype(jnp.float32)
             mean, we_k, se_k = two_level_compressed(
                 vec, we_local[we_off:we_off + wcols],

@@ -293,6 +293,52 @@ class DeepSpeedConfig:
                     f"number >= 0 (0 = use the chip table value), got {val!r}")
             setattr(self, attr, float(val))
 
+        cl_dict = tel_dict.get(TELEMETRY_CLUSTER, {}) or {}
+        self._warn_unknown_nested(f"{TELEMETRY}.{TELEMETRY_CLUSTER}",
+                                  cl_dict, CLUSTER_CONFIG_KEYS)
+        self.telemetry_cluster_enabled = get_scalar_param(cl_dict, CLUSTER_ENABLED,
+                                                          CLUSTER_ENABLED_DEFAULT)
+        if self.telemetry_cluster_enabled and not self.telemetry_enabled:
+            raise ValueError(
+                "DeepSpeedConfig: telemetry.cluster.enabled requires "
+                "telemetry.enabled — the heartbeat rides the end_step record "
+                "the telemetry session produces")
+        self.telemetry_cluster_heartbeat_interval = get_scalar_param(
+            cl_dict, CLUSTER_HEARTBEAT_INTERVAL, CLUSTER_HEARTBEAT_INTERVAL_DEFAULT)
+        hb = self.telemetry_cluster_heartbeat_interval
+        if isinstance(hb, bool) or not isinstance(hb, int) or hb < 1:
+            raise ValueError(
+                "DeepSpeedConfig: telemetry.cluster.heartbeat_interval must be "
+                f"an int >= 1, got {hb!r}")
+        self.telemetry_cluster_hang_deadline_s = get_scalar_param(
+            cl_dict, CLUSTER_HANG_DEADLINE_S, CLUSTER_HANG_DEADLINE_S_DEFAULT)
+        dl = self.telemetry_cluster_hang_deadline_s
+        if isinstance(dl, bool) or not isinstance(dl, (int, float)) or dl < 0:
+            raise ValueError(
+                "DeepSpeedConfig: telemetry.cluster.hang_deadline_s must be a "
+                f"number >= 0 (0 = watchdog off), got {dl!r}")
+        self.telemetry_cluster_hang_deadline_s = float(dl)
+        self.telemetry_cluster_dump_dir = get_scalar_param(
+            cl_dict, CLUSTER_DUMP_DIR, CLUSTER_DUMP_DIR_DEFAULT)
+        self.telemetry_cluster_straggler_threshold = get_scalar_param(
+            cl_dict, CLUSTER_STRAGGLER_THRESHOLD, CLUSTER_STRAGGLER_THRESHOLD_DEFAULT)
+        st = self.telemetry_cluster_straggler_threshold
+        if isinstance(st, bool) or not isinstance(st, (int, float)) or st <= 1:
+            raise ValueError(
+                "DeepSpeedConfig: telemetry.cluster.straggler_threshold must be "
+                f"a number > 1, got {st!r}")
+        self.telemetry_cluster_straggler_threshold = float(st)
+        self.telemetry_cluster_signal_peers = get_scalar_param(
+            cl_dict, CLUSTER_SIGNAL_PEERS, CLUSTER_SIGNAL_PEERS_DEFAULT)
+        self.telemetry_cluster_warmup_steps = get_scalar_param(
+            cl_dict, CLUSTER_WARMUP_STEPS, CLUSTER_WARMUP_STEPS_DEFAULT)
+        wu = self.telemetry_cluster_warmup_steps
+        if isinstance(wu, bool) or not isinstance(wu, int) or wu < 0:
+            raise ValueError(
+                "DeepSpeedConfig: telemetry.cluster.warmup_steps must be an "
+                f"int >= 0 (steps before the watchdog arms / stragglers are "
+                f"named — the compile steps), got {wu!r}")
+
         num_dict = param_dict.get(NUMERICS, {})
         self._warn_unknown_nested(NUMERICS, num_dict, NUMERICS_CONFIG_KEYS)
         self.numerics_enabled = get_scalar_param(num_dict, NUMERICS_ENABLED, NUMERICS_ENABLED_DEFAULT)

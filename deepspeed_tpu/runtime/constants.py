@@ -216,6 +216,27 @@ PIPELINE_TRACE_CAPACITY_DEFAULT = 64
 PIPELINE_TRACE_DUMP_DIR = "dump_dir"
 PIPELINE_TRACE_DUMP_DIR_DEFAULT = ""
 
+# telemetry.cluster sub-block: cross-host observability plane — heartbeat
+# aggregation over the host CPU world, straggler naming, hang watchdog,
+# merged post-mortems (docs/cluster.md)
+TELEMETRY_CLUSTER = "cluster"
+CLUSTER_ENABLED = "enabled"
+CLUSTER_ENABLED_DEFAULT = False
+CLUSTER_HEARTBEAT_INTERVAL = "heartbeat_interval"
+CLUSTER_HEARTBEAT_INTERVAL_DEFAULT = 1
+CLUSTER_HANG_DEADLINE_S = "hang_deadline_s"
+CLUSTER_HANG_DEADLINE_S_DEFAULT = 0.0  # 0 = watchdog off
+CLUSTER_DUMP_DIR = "dump_dir"
+CLUSTER_DUMP_DIR_DEFAULT = ""
+CLUSTER_STRAGGLER_THRESHOLD = "straggler_threshold"
+CLUSTER_STRAGGLER_THRESHOLD_DEFAULT = 3.0
+CLUSTER_SIGNAL_PEERS = "signal_peers"
+CLUSTER_SIGNAL_PEERS_DEFAULT = True
+# steps before the watchdog arms / stragglers are named: the first step(s)
+# pay multi-second compiles, which would false-fire any sane deadline
+CLUSTER_WARMUP_STEPS = "warmup_steps"
+CLUSTER_WARMUP_STEPS_DEFAULT = 1
+
 #############################################
 # Numerics observatory (TPU-native health layer on top of telemetry; no
 # reference key — in-graph per-subtree anomaly sentinel, loss-scale event
@@ -500,6 +521,7 @@ TELEMETRY_CONFIG_KEYS = frozenset({
     TELEMETRY_JOB_NAME,
     TELEMETRY_PIPELINE_TRACE,
     TELEMETRY_ANATOMY,
+    TELEMETRY_CLUSTER,
 })
 
 ANATOMY_CONFIG_KEYS = frozenset({
@@ -515,6 +537,16 @@ PIPELINE_TRACE_CONFIG_KEYS = frozenset({
     PIPELINE_TRACE_ENABLED,
     PIPELINE_TRACE_CAPACITY,
     PIPELINE_TRACE_DUMP_DIR,
+})
+
+CLUSTER_CONFIG_KEYS = frozenset({
+    CLUSTER_ENABLED,
+    CLUSTER_HEARTBEAT_INTERVAL,
+    CLUSTER_HANG_DEADLINE_S,
+    CLUSTER_DUMP_DIR,
+    CLUSTER_STRAGGLER_THRESHOLD,
+    CLUSTER_SIGNAL_PEERS,
+    CLUSTER_WARMUP_STEPS,
 })
 
 NUMERICS_CONFIG_KEYS = frozenset({

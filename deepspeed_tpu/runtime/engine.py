@@ -34,6 +34,7 @@ from ..ops import lamb as lamb_opt
 from ..ops import sgd as sgd_opt
 from ..parallel.mesh import DATA_AXIS, build_mesh, mesh_from_mpu
 from ..utils import ThroughputTimer, SynchronizedWallClockTimer, log_dist, logger
+from ..utils.cluster import named_scope as ds_named_scope
 from .config import DeepSpeedConfig
 from .constants import (ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER,
                         SGD_OPTIMIZER, ROUTE_TRAIN,
@@ -552,6 +553,37 @@ class DeepSpeedEngine:
                 consecutive_skip_trigger=self.config.numerics_consecutive_skip_trigger,
                 trigger_on_nonfinite_loss=self.config.numerics_trigger_on_nonfinite_loss)
 
+        # ---- cluster observatory (docs/cluster.md): cross-host heartbeat
+        # aggregation, straggler naming, hang watchdog. Entirely host-side —
+        # the step programs stay HLO-instruction-identical with this block
+        # enabled (tested), same as every other observatory.
+        self._cluster = None
+        if self.telemetry is not None and self.config.telemetry_cluster_enabled:
+            from ..utils.cluster import ClusterMonitor
+            cluster_recorder = (self._numerics.recorder
+                                if self._numerics is not None else None)
+            cluster_dump_dir = None
+            if cluster_recorder is None:
+                # no numerics recorder to ride: give the watchdog its own
+                from ..utils.numerics import FlightRecorder
+                cluster_dump_dir = (self.config.telemetry_cluster_dump_dir
+                                    or "cluster_dumps")
+                cluster_recorder = FlightRecorder(
+                    capacity=64, dump_dir=cluster_dump_dir,
+                    telemetry=self.telemetry, host_id=jax.process_index())
+            self._cluster = ClusterMonitor(
+                telemetry=self.telemetry,
+                recorder=cluster_recorder,
+                heartbeat_interval=self.config.telemetry_cluster_heartbeat_interval,
+                hang_deadline_s=self.config.telemetry_cluster_hang_deadline_s,
+                straggler_threshold=self.config.telemetry_cluster_straggler_threshold,
+                signal_peers=self.config.telemetry_cluster_signal_peers,
+                warmup_steps=self.config.telemetry_cluster_warmup_steps,
+                dump_dir=cluster_dump_dir)
+            # heartbeat history + clock offsets ride along in every dump so
+            # cluster-dump / timeline --cluster can merge hosts coherently
+            cluster_recorder.cluster = self._cluster
+
         self._compile_steps()
 
         # ---- resilience (docs/resilience.md): periodic async checkpointing +
@@ -888,7 +920,7 @@ class DeepSpeedEngine:
         def local_loss_and_grad(params, scale, *batch):
             # named_scope is HLO metadata only (zero instructions — asserted by
             # tests/unit/test_telemetry.py), so the trace annotation is unconditional
-            with jax.named_scope("ds_fwd_bwd"):
+            with ds_named_scope("ds_fwd_bwd"):
                 def scaled_loss_fn(p):
                     out = model_fn(p, *batch)
                     loss = out[0] if isinstance(out, (tuple, list)) else out
@@ -1174,7 +1206,7 @@ class DeepSpeedEngine:
         self._acc_dtype = acc_dtype
 
         def accumulate(acc, grads):
-            with jax.named_scope("ds_accumulate"):
+            with ds_named_scope("ds_accumulate"):
                 return jax.tree_util.tree_map(lambda a, g: a + g.astype(acc_dtype), acc, grads)
 
         self._jit_accumulate = self._watch("accumulate", jax.jit(
@@ -1243,7 +1275,7 @@ class DeepSpeedEngine:
             def skip_update(_):
                 return master, opt_state
 
-            with jax.named_scope("ds_apply_update"):
+            with ds_named_scope("ds_apply_update"):
                 new_master, new_opt = jax.lax.cond(overflow, skip_update, do_update, operand=None)
             new_scaler = ls.update(scaler_state, overflow, dynamic=dynamic, scale_window=scale_window,
                                    min_scale=min_scale, hysteresis=hysteresis)
@@ -1311,7 +1343,7 @@ class DeepSpeedEngine:
                     _, new_state = opt_apply(grads, opt_state, None, step, hyper)
                     return new_state
 
-                with jax.named_scope("ds_apply_update"):
+                with ds_named_scope("ds_apply_update"):
                     new_opt = jax.lax.cond(overflow, lambda _: opt_state, do_update,
                                            operand=None)
                 new_scaler = ls.update(scaler_state, overflow, dynamic=dynamic,
@@ -1353,7 +1385,7 @@ class DeepSpeedEngine:
                         _, new_state = opt_apply(grads, opt_state, None, step, hyper)
                         return new_state
 
-                    with jax.named_scope("ds_apply_update"):
+                    with ds_named_scope("ds_apply_update"):
                         new_opt = jax.lax.cond(overflow, lambda _: opt_state, do_update,
                                                operand=None)
                     new_scaler = ls.update(scaler_state, overflow, dynamic=dynamic,
@@ -1700,6 +1732,9 @@ class DeepSpeedEngine:
                 and self.micro_steps % self.gradient_accumulation_steps() == 0):
             # first micro-step of an optimizer-step window: trace-window bookkeeping
             self.telemetry.on_step_begin(self.global_steps)
+            if self._cluster is not None:
+                # arm the hang watchdog deadline around this optimizer step
+                self._cluster.on_step_begin(self.global_steps)
         if self.wall_clock_breakdown():
             self.timers("forward_microstep").start()
         batch = tuple(self.shard_batch(x) if not isinstance(x, jax.Array) else x for x in inputs)
@@ -1805,6 +1840,16 @@ class DeepSpeedEngine:
         return None
 
     def _take_model_step(self):
+        if self.telemetry is not None:
+            # host-local dispatch boundary: every host-side phase of the step
+            # (input pipeline, accumulation, offload prep, injected stalls) is
+            # behind us; everything below — the update program and its grad
+            # collectives, the overflow/loss fetches — can block on peers, and
+            # on a synchronous-dispatch backend does. The cluster observatory
+            # attributes stragglers from the window ENDING here: it measures
+            # how late this host arrived at the step's barrier, which is the
+            # one signal blocking collectives cannot equalise away.
+            self.telemetry.mark_step_dispatched()
         if self.wall_clock_breakdown():
             self.timers("step_microstep").start()
         if self._fused_pending is not None:
@@ -1955,6 +2000,10 @@ class DeepSpeedEngine:
             numerics_host = jax.device_get(self._pending_sentinel)
         if self._numerics is not None:
             self._commit_numerics(numerics_host, overflowed, self._window_losses)
+        if self._cluster is not None:
+            # disarm the watchdog and allgather this step's heartbeat on the
+            # host CPU world; host 0 derives and emits the Cluster/* scalars
+            self._cluster.on_step_end(self.global_steps)
         self._window_losses = []
         interval = self.config.resilience_save_interval
         if (self._resilience is not None and interval > 0

@@ -69,11 +69,18 @@ EV_FIRST_TOKEN = "first_token"
 EV_FINISH = "finish"            # args: n_tokens
 
 
-class StreamingHistogram:
-    """Log-bucketed streaming histogram: O(1) add, bounded memory, percentile
-    read-out at ``growth``-factor relative resolution (default ~3%). Quantiles
-    report the upper bound of the covering bucket, so they never understate a
-    tail — the conservative direction for an SLO read-out."""
+class HistogramSketch:
+    """Log-bucketed streaming histogram sketch: O(1) add, bounded memory,
+    percentile read-out at ``growth``-factor relative resolution (default
+    ~3%). Quantiles report the upper bound of the covering bucket, so they
+    never understate a tail — the conservative direction for an SLO read-out.
+
+    The bin edges are a pure function of ``(growth, min_value)``, identical
+    on every replica, so sketches are EXACTLY mergeable: ``merge_from`` adds
+    integer bin counts, and the merged percentiles equal the percentiles of
+    the concatenated value stream (same covering-bucket read-out over the
+    same total bin counts). ``utils/cluster.fleet_latency_summary`` builds
+    fleet-level rollups on this property."""
 
     def __init__(self, growth=1.03, min_value=1e-3):
         self._min = float(min_value)
@@ -112,6 +119,55 @@ class StreamingHistogram:
     @property
     def mean(self):
         return (self.total / self.count) if self.count else None
+
+    # -- merge / serialization (fleet rollups) ------------------------------
+    def merge_from(self, other):
+        """Fold another sketch into this one. Exact: bin geometry must match
+        (raises ValueError otherwise), then merging is bin-count addition."""
+        if (other._min, other._growth) != (self._min, self._growth):
+            raise ValueError(
+                "histogram sketch geometry mismatch: "
+                f"(min={other._min}, growth={other._growth}) vs "
+                f"(min={self._min}, growth={self._growth})")
+        for idx, n in other._buckets.items():
+            self._buckets[idx] = self._buckets.get(idx, 0) + n
+        self.count += other.count
+        self.total += other.total
+        return self
+
+    def to_dict(self):
+        return {
+            "kind": "histogram_sketch",
+            "growth": self._growth,
+            "min_value": self._min,
+            "buckets": {str(i): self._buckets[i]
+                        for i in sorted(self._buckets)},
+            "count": self.count,
+            "total": self.total,
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        sk = cls(growth=d.get("growth", 1.03),
+                 min_value=d.get("min_value", 1e-3))
+        for i, n in (d.get("buckets") or {}).items():
+            sk._buckets[int(i)] = int(n)
+        sk.count = int(d.get("count", sum(sk._buckets.values())))
+        sk.total = float(d.get("total", 0.0))
+        return sk
+
+    @classmethod
+    def merged(cls, sketches):
+        out = None
+        for sk in sketches:
+            if out is None:
+                out = cls(growth=sk._growth, min_value=sk._min)
+            out.merge_from(sk)
+        return out
+
+
+# Historical name — the sketch started life as a per-host-only histogram.
+StreamingHistogram = HistogramSketch
 
 
 class RequestTracer:
@@ -355,6 +411,11 @@ class RequestTracer:
             "totals": dict(self.totals),
             "counts": {"finished": self.finished, "refused": self.refused,
                        "preemptions": self.preemptions},
+            # mergeable latency sketches: N replica bundles combine exactly
+            # into fleet percentiles (utils/cluster.fleet_latency_summary)
+            "latency_sketches": {m: self.hist[m].to_dict()
+                                 for m in LATENCY_METRICS
+                                 if self.hist[m].count},
         }
 
     def dump(self, path=None):

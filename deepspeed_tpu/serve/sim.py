@@ -113,7 +113,7 @@ def _trace(args):
 
 
 def _report(args, trace, outputs, logs, tracer, waste, slo, failures,
-            cache_stats=None, ttft_compare=None):
+            cache_stats=None, ttft_compare=None, fleet_merge_exact=None):
     """Machine-readable serve-sim report. The ``deterministic`` subtree is a
     pure function of the seeded trace (iteration-domain latencies, token
     counts, waste split — byte-stable across runs on one platform); ``wall``
@@ -156,6 +156,10 @@ def _report(args, trace, outputs, logs, tracer, waste, slo, failures,
         det["prefix_cache"] = cache_stats
     if ttft_compare is not None:
         det["ttft_p50_iters"] = ttft_compare
+    if fleet_merge_exact is not None:
+        # exact-by-construction boolean (sketch merge == single stream), so
+        # it belongs in the byte-stable subtree despite wall-derived inputs
+        det["fleet_merge_exact"] = bool(fleet_merge_exact)
     wall = {}
     if tracer is not None:
         wall["percentiles"] = tracer.percentiles()
@@ -330,6 +334,7 @@ def main(argv=None):
 
     tracer = engine.tracer
     waste = slo = None
+    fleet_merge_exact = None
     if tracer is not None:
         # invariant 4: the ledger's useful/replayed split covers every token
         # the schedule log says was scheduled — exactly, no residue
@@ -357,6 +362,32 @@ def main(argv=None):
                 f"{slo['met'] + slo['violated']} finished requests "
                 f"(attainment {slo['attainment']:.3f}): "
                 f"{', '.join(worst[:8])}")
+        # invariant 6: fleet rollup exactness — shard the finished-request
+        # stream over 4 virtual replicas, rebuild per-replica latency
+        # sketches, merge, and require the fleet percentiles to EQUAL the
+        # single-stream read-out (the HistogramSketch mergeability contract
+        # ROADMAP item 2c's router gates on). Wall-derived values, but the
+        # equality itself is exact by construction, so the boolean is stable.
+        finished_recs = [r for r in tracer.requests
+                         if r.get("status") == "finished"]
+        if finished_recs and len(finished_recs) == tracer.finished:
+            from ..utils.cluster import fleet_latency_summary
+            from .request_trace import HistogramSketch, LATENCY_METRICS
+            replicas = [{m: HistogramSketch() for m in LATENCY_METRICS}
+                        for _ in range(4)]
+            for i, rec in enumerate(finished_recs):
+                for m in LATENCY_METRICS:
+                    replicas[i % 4][m].add(rec.get(m))
+            bundles = [{"latency_sketches":
+                        {m: h[m].to_dict() for m in LATENCY_METRICS
+                         if h[m].count}} for h in replicas]
+            fleet = fleet_latency_summary(bundles, ps=(50, 90, 99))
+            single = tracer.latency_summary(ps=(50, 90, 99))
+            fleet_merge_exact = fleet == single
+            if not fleet_merge_exact:
+                failures.append(
+                    "fleet histogram-sketch merge diverged from the "
+                    "single-stream percentiles")
 
     if args.dump_ledger:
         tracer.dump(args.dump_ledger)
@@ -367,7 +398,8 @@ def main(argv=None):
     if args.json_out:
         report = _report(args, trace, outputs, logs, tracer, waste, slo,
                          failures, cache_stats=cache_stats,
-                         ttft_compare=ttft_compare)
+                         ttft_compare=ttft_compare,
+                         fleet_merge_exact=fleet_merge_exact)
         blob = json.dumps(report, sort_keys=True, separators=(",", ":"))
         if args.json_out == "-":
             print(blob)

@@ -35,6 +35,7 @@ import math
 import os
 import re
 import signal
+import socket
 import time
 from collections import deque
 
@@ -184,12 +185,35 @@ def compare_audit_rows(matrix, names, slice_rows=None):
 # ------------------------------------------------------------ flight recorder
 
 
+def _sanitize_token(s):
+    """Filename-safe token: anything outside [A-Za-z0-9.-] collapses to '-'.
+    Underscores are excluded on purpose — they are the dump-name field
+    separator, so a run id containing one would break the scan regex."""
+    return re.sub(r"[^A-Za-z0-9.-]+", "-", str(s)).strip("-")
+
+
+def default_run_id():
+    """Run identity for dump namespacing when several hosts (or several
+    launches) share one dump_dir. All ranks of one `ds-tpu` launch derive the
+    same id (from the coordinator address the launcher exports), so their
+    dumps group into one run; unrelated launches get distinct ids."""
+    rid = os.environ.get("DS_RUN_ID")
+    if rid:
+        return _sanitize_token(rid)
+    coord = os.environ.get("DS_COORDINATOR_ADDRESS")
+    if coord:
+        return "run-" + _sanitize_token(coord)
+    node = _sanitize_token(socket.gethostname()) or "node"
+    return f"{node}-p{os.getpid()}"
+
+
 class FlightRecorder:
     """Bounded per-host ring buffer of step records + structured events that
     dumps a JSON post-mortem bundle when triggered."""
 
     def __init__(self, capacity=256, dump_dir=None, telemetry=None, host_id=0,
-                 pipeline_trace=None, request_trace=None):
+                 pipeline_trace=None, request_trace=None, run_id=None,
+                 cluster=None):
         self.capacity = int(capacity)
         self.dump_dir = dump_dir
         self.telemetry = telemetry
@@ -199,6 +223,14 @@ class FlightRecorder:
         # optional serving RequestTracer (serve/request_trace.py): same deal,
         # for ``ds-tpu serve-timeline`` on a dead serving host's dump
         self.request_trace = request_trace
+        # optional ClusterMonitor (utils/cluster.py): heartbeat history +
+        # clock-offset estimates ride along so ``ds-tpu cluster-dump`` and
+        # ``ds-tpu timeline --cluster`` can merge per-host dumps coherently
+        self.cluster = cluster
+        # run_id="" keeps the legacy un-namespaced dump names (tests and the
+        # crash-sim write those directly); None picks the launch-wide default
+        self.run_id = _sanitize_token(run_id) if run_id is not None \
+            else default_run_id()
         self.host_id = int(host_id)
         self.steps = deque(maxlen=self.capacity)
         self.events = deque(maxlen=max(self.capacity * 4, 64))
@@ -252,10 +284,14 @@ class FlightRecorder:
             "events": list(self.events),
             "compile_records": compile_records,
         }
+        if self.run_id:
+            out["run"] = self.run_id
         if self.pipeline_trace is not None:
             out["pipeline_trace"] = self.pipeline_trace.bundle()
         if self.request_trace is not None:
             out["serving_request_trace"] = self.request_trace.bundle()
+        if self.cluster is not None:
+            out["cluster"] = self.cluster.bundle()
         return out
 
     # -- triggering --------------------------------------------------------
@@ -264,9 +300,11 @@ class FlightRecorder:
             return None
         try:
             os.makedirs(self.dump_dir, exist_ok=True)
+            prefix = f"numerics_dump_{self.run_id}_" if self.run_id \
+                else "numerics_dump_"
             path = os.path.join(
                 self.dump_dir,
-                f"numerics_dump_host{self.host_id}_{self.dump_count}.json")
+                f"{prefix}host{self.host_id}_{self.dump_count}.json")
             with open(path, "w") as f:
                 json.dump(self.bundle(reason, detail), f, default=float)
             self.dump_count += 1
@@ -484,27 +522,96 @@ class NumericsMonitor:
 # ---------------------------------------------------------------- inspector
 
 
-def scan_dump_dir(dump_dir):
-    """Newest flight-recorder bundle in ``dump_dir`` (by host, then dump
-    index — the recorder numbers dumps monotonically per host), or None when
-    the dir holds none. Pure host file I/O — the auto-resume path
-    (resilience/auto_resume.py) calls this before any engine exists."""
+# Both the legacy name (numerics_dump_host0_0.json) and the run-namespaced
+# name (numerics_dump_<run>_host0_0.json) parse; legacy dumps group under the
+# empty run key "". The run token never contains '_' (see _sanitize_token).
+DUMP_NAME_RE = re.compile(
+    r"numerics_dump_(?:(?P<run>[^_]+)_)?host(?P<host>\d+)_(?P<idx>\d+)\.json$")
+
+
+def scan_dump_dir_runs(dump_dir):
+    """Group the flight-recorder dumps in ``dump_dir`` by run.
+
+    Returns ``{run_key: [entry, ...]}`` where each entry is
+    ``{"host", "index", "path", "mtime"}`` and each run's entries are sorted
+    by (index, host). Legacy un-namespaced dumps land under run key ``""``.
+    Pure host file I/O."""
+    runs = {}
     if not dump_dir or not os.path.isdir(dump_dir):
-        return None
-    best = None
-    best_key = None
+        return runs
     for name in os.listdir(dump_dir):
-        m = re.match(r"numerics_dump_host(\d+)_(\d+)\.json$", name)
+        m = DUMP_NAME_RE.match(name)
         if not m:
             continue
-        key = (int(m.group(2)), int(m.group(1)))
-        if best_key is None or key > best_key:
-            best_key = key
-            best = os.path.join(dump_dir, name)
-    if best is None:
+        path = os.path.join(dump_dir, name)
+        try:
+            mtime = os.stat(path).st_mtime
+        except OSError:
+            continue
+        runs.setdefault(m.group("run") or "", []).append({
+            "host": int(m.group("host")),
+            "index": int(m.group("idx")),
+            "path": path,
+            "mtime": mtime,
+        })
+    for entries in runs.values():
+        entries.sort(key=lambda e: (e["index"], e["host"]))
+    return runs
+
+
+def load_run_bundles(dump_dir, run=None):
+    """Load the newest bundle per host for one run of a shared dump_dir.
+
+    Picks the most recently written run when ``run`` is None. Returns
+    ``(run_key, {host: bundle})``; torn dumps are skipped (an older intact
+    dump from the same host wins, if any)."""
+    runs = scan_dump_dir_runs(dump_dir)
+    if not runs:
+        return run, {}
+    if run is None:
+        run = max(runs, key=lambda k: max(e["mtime"] for e in runs[k]))
+    elif run not in runs:
+        return run, {}
+    by_host = {}
+    for entry in runs[run]:  # ascending (index, host): last intact one wins
+        try:
+            with open(entry["path"]) as f:
+                by_host[entry["host"]] = json.load(f)
+        except (OSError, ValueError):
+            continue
+    return run, by_host
+
+
+def merge_first_bad(bundles_by_host):
+    """Merged (first_bad_step, first_bad_host) over per-host bundles: the
+    minimum first bad step across the fleet, ties broken by lowest host.
+    Returns (None, None) when no host recorded a bad step."""
+    best = None
+    for host in sorted(bundles_by_host):
+        s = summarize_dump(bundles_by_host[host])
+        step = s.get("first_bad_step")
+        if step is None:
+            continue
+        key = (step, host)
+        if best is None or key < best:
+            best = key
+    return best if best is not None else (None, None)
+
+
+def scan_dump_dir(dump_dir):
+    """Newest flight-recorder bundle in ``dump_dir``, or None when the dir
+    holds none. Dumps are grouped by run (see scan_dump_dir_runs); the most
+    recently written run wins, then the highest (dump index, host) within it —
+    the recorder numbers dumps monotonically per host. Pure host file I/O —
+    the auto-resume path (resilience/auto_resume.py) calls this before any
+    engine exists."""
+    runs = scan_dump_dir_runs(dump_dir)
+    if not runs:
         return None
+    run = max(runs, key=lambda k: max(e["mtime"] for e in runs[k]))
+    best = runs[run][-1]  # entries sorted by (index, host)
     try:
-        with open(best) as f:
+        with open(best["path"]) as f:
             return json.load(f)
     except (OSError, ValueError):
         return None  # a torn dump must not block resume
@@ -539,15 +646,53 @@ def summarize_dump(bundle):
     }
 
 
+def _inspect_dump_dir(dump_dir, run, as_json):
+    """Directory mode: merge the newest run's per-host dumps into one view."""
+    run_key, by_host = load_run_bundles(dump_dir, run=run)
+    if not by_host:
+        print(f"no flight-recorder dumps in {dump_dir}"
+              + (f" for run '{run}'" if run else ""))
+        return 2
+    fb_step, fb_host = merge_first_bad(by_host)
+    summaries = {h: summarize_dump(by_host[h]) for h in sorted(by_host)}
+    if as_json:
+        print(json.dumps({
+            "run": run_key,
+            "hosts": {str(h): summaries[h] for h in summaries},
+            "first_bad_step": fb_step,
+            "first_bad_host": fb_host,
+        }, indent=2, default=float))
+        return 0
+    print(f"numerics post-mortem: {dump_dir} "
+          f"(run '{run_key}', {len(by_host)} host(s))")
+    print(f"  first bad step : {fb_step}")
+    print(f"  first bad host : {fb_host}")
+    for h in sorted(summaries):
+        s = summaries[h]
+        print(f"  host {h:<4}: reason={s['reason']} "
+              f"first_bad_step={s['first_bad_step']} "
+              f"subtree={s['offending_subtree']} "
+              f"steps={s['steps_recorded']} events={s['events_recorded']}")
+    return 0
+
+
 def inspect_dump_main(argv=None):
-    """Entry point for `ds-tpu inspect-dump <dump.json>`."""
+    """Entry point for `ds-tpu inspect-dump <dump.json | dump_dir>`."""
     parser = argparse.ArgumentParser(
         prog="ds-tpu inspect-dump",
-        description="Summarize a numerics flight-recorder post-mortem bundle.")
-    parser.add_argument("dump", help="path to a numerics_dump_*.json bundle")
+        description="Summarize a numerics flight-recorder post-mortem bundle, "
+                    "or merge a directory of per-host dumps.")
+    parser.add_argument("dump", help="path to a numerics_dump_*.json bundle, "
+                                     "or a dump directory of per-host bundles")
+    parser.add_argument("--run", default=None,
+                        help="directory mode: inspect this run instead of the "
+                             "newest one")
     parser.add_argument("--json", action="store_true",
                         help="print the machine-readable summary instead")
     args = parser.parse_args(argv)
+
+    if os.path.isdir(args.dump):
+        return _inspect_dump_dir(args.dump, args.run, args.json)
 
     with open(args.dump) as f:
         bundle = json.load(f)

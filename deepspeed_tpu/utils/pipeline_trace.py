@@ -611,10 +611,26 @@ def timeline_main(argv=None):
         description="Convert a pipeline_trace span bundle (or a flight-recorder "
                     "dump that embeds one) into Perfetto/Chrome trace_event JSON "
                     "viewable at ui.perfetto.dev or chrome://tracing.")
-    parser.add_argument("bundle", help="path to the span bundle / dump JSON")
+    parser.add_argument("bundle", help="path to the span bundle / dump JSON "
+                                       "(with --cluster: a shared dump dir)")
     parser.add_argument("-o", "--output", default=None,
                         help="output path (default: <bundle>.trace.json)")
+    parser.add_argument("--cluster", action="store_true",
+                        help="treat BUNDLE as a shared dump directory and "
+                             "merge one run's per-host bundles onto per-host "
+                             "track groups, aligned by heartbeat-estimated "
+                             "clock offsets")
+    parser.add_argument("--run", default=None,
+                        help="with --cluster: merge this run instead of the "
+                             "newest one")
     args = parser.parse_args(argv)
+
+    if args.cluster:
+        from .cluster import cluster_timeline
+        out = args.output
+        if out is None:
+            out = os.path.join(args.bundle, "cluster.trace.json")
+        return cluster_timeline(args.bundle, out, run=args.run)
 
     try:
         bundle = _load_bundle(args.bundle)

@@ -309,9 +309,12 @@ class TelemetrySession:
         self.steps_recorded = 0
         self.last_mfu = None
         self.last_step_ms = None
+        self.last_dispatch_ms = None
+        self._dispatch_base = None
         self.last_wire_bytes = 0
         self.last_wire_bytes_ici = 0
         self.last_wire_bytes_dcn = 0
+        self._dispatch_mark = None
         self._window = deque(maxlen=max(int(mfu_window), 1))  # (dt, flops)
         self._last_end = time.perf_counter()
         self._last_flops = 0.0
@@ -396,6 +399,25 @@ class TelemetrySession:
         self._trace_done = True
 
     # ------------------------------------------------------------- step metrics
+    def mark_step_dispatched(self):
+        """Host-local step boundary: the engine calls this when every
+        host-side phase of the step is done and it is about to dispatch the
+        final update program — i.e. when this host ARRIVES at the step's
+        barrier. end_step turns it into ``last_dispatch_ms``. The cluster
+        observatory attributes stragglers from this window: collectives (and
+        the fetches behind them) equalise the end-to-end step wall across
+        hosts, so only how LATE a host reached the barrier shows which host
+        was actually slow."""
+        self._dispatch_mark = time.perf_counter()
+
+    def rebase_dispatch_window(self):
+        """Restart the host-local dispatch window NOW. The cluster observatory
+        calls this right after its heartbeat allgather: the allgather is
+        itself a cross-host rendezvous, so time spent waiting in it belongs to
+        the slow peer — charging it to THIS host's next dispatch window would
+        re-equalise exactly the signal the window exists to separate."""
+        self._dispatch_base = time.perf_counter()
+
     def end_step(self, global_step: int, samples_per_step: int, pending=None,
                  numerics=None, goodput=None, serving=None):
         """Close one optimizer step's metrics. The ONLY blocking operation is a
@@ -417,6 +439,12 @@ class TelemetrySession:
         summary (serve/request_trace.RequestTracer.latency_summary — e.g.
         ``ttft_ms_p99``); emitted as ``Serving/Latency/*`` scalars, again
         host-computed so scalars only."""
+        # dispatch boundary: set by mark_step_dispatched (engine, pre-fetch);
+        # a caller that never marks gets "now", i.e. dispatch wall == step wall
+        fetch_start = self._dispatch_mark
+        if fetch_start is None:
+            fetch_start = time.perf_counter()
+        self._dispatch_mark = None
         numerics_host = None
         try:
             if pending:
@@ -428,6 +456,10 @@ class TelemetrySession:
         now = time.perf_counter()
         compiles = self.watchdog.compiles()
         dt = now - self._last_end
+        dispatch_base = (self._dispatch_base if self._dispatch_base is not None
+                         else self._last_end)
+        dispatch_dt = fetch_start - dispatch_base
+        self._dispatch_base = None
         flops_d = self.flops_executed - self._last_flops
         wire_d = self.wire_bytes_executed - self._last_wire
         wire_ici_d = self.wire_ici_executed - self._last_wire_ici
@@ -449,6 +481,7 @@ class TelemetrySession:
         samples = global_step * samples_per_step
         mon = self.monitor
         self.last_step_ms = dt * 1000.0
+        self.last_dispatch_ms = max(dispatch_dt, 0.0) * 1000.0
         self.last_wire_bytes = wire_d
         self.last_wire_bytes_ici = wire_ici_d
         self.last_wire_bytes_dcn = wire_dcn_d

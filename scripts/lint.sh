@@ -85,10 +85,24 @@ timeout -k 10 600 "$REPO/bin/ds-tpu" crash-sim --json /tmp/_crash_sim.json \
 && cmp "$REPO/tests/unit/golden/crash_sim_transcript.json" \
        /tmp/_crash_sim.json
 crash_rc=$?
+# hang-sim: deterministic two-host hang/watchdog rehearsal — host 1 stalls in
+# a grad-bucket scope, host 0 can only dump via the peer marker; transcript is
+# byte-compared against the committed golden, and the merged two-host Perfetto
+# timeline (clock-offset-corrected) against its golden, so any drift in
+# detection, cross-host signalling, or the merge/export path fails CI
+timeout -k 10 120 "$REPO/bin/ds-tpu" hang-sim --json /tmp/_hang_sim.json \
+    --dump-dir /tmp/_hang_sim_dumps \
+&& cmp "$REPO/tests/unit/golden/hang_sim_transcript.json" /tmp/_hang_sim.json \
+&& timeout -k 10 60 "$REPO/bin/ds-tpu" timeline --cluster /tmp/_hang_sim_dumps \
+    --run hangsim -o /tmp/_cluster_timeline.trace.json \
+&& cmp "$REPO/tests/unit/golden/cluster_timeline_2host.trace.json" \
+       /tmp/_cluster_timeline.trace.json
+hang_rc=$?
 [ "$lint_rc" -ne 0 ] && exit "$lint_rc"
 [ "$comm_rc" -ne 0 ] && exit "$comm_rc"
 [ "$serve_rc" -ne 0 ] && exit "$serve_rc"
 [ "$cache_rc" -ne 0 ] && exit "$cache_rc"
 [ "$shard_rc" -ne 0 ] && exit "$shard_rc"
 [ "$anatomy_rc" -ne 0 ] && exit "$anatomy_rc"
-exit "$crash_rc"
+[ "$crash_rc" -ne 0 ] && exit "$crash_rc"
+exit "$hang_rc"

@@ -171,6 +171,80 @@ def run_hierarchical_rehearsal(tmp, repo_root, timeout=420):
     return a, b, c, d
 
 
+def run_cluster_observatory_rehearsal(tmp, repo_root, timeout=420):
+    """Cluster-observatory multi-process rehearsal, shared by
+    test_launcher.py and __graft_entry__'s multichip dry run. Two
+    launcher-spawned jax.distributed processes with ``telemetry.cluster``
+    enabled (docs/cluster.md):
+
+    (A) straggler phase — rank 1 sleeps 150 ms inside every step's dispatch
+        window; rank 0's heartbeat aggregation must NAME host 1 as the
+        straggler (the end-to-end wall is collective-equalised, so this
+        exercises the host-local dispatch column end to end);
+    (B) stall phase — rank 1 sleeps 2 s inside one armed step against a
+        0.5 s hang deadline; BOTH hosts must write flight-recorder dumps
+        (rank 1 by deadline expiry, rank 0 either by its own expiry while
+        blocked in the stalled collective or by the peer marker), and
+        ``ds-tpu cluster-dump`` must assemble them into one report naming a
+        stalled host and the scope it died in.
+    Returns the two result dicts (rank 0's, per phase)."""
+    import base64
+    import subprocess
+
+    def clean_env(**extra):
+        return clean_spawn_env(PYTHONPATH=repo_root, **extra)
+
+    worker = os.path.abspath(__file__)
+    world_info = base64.urlsafe_b64encode(
+        json.dumps({"localhost": [0, 1]}).encode()).decode()
+    two_dev = "--xla_force_host_platform_device_count=2"
+
+    def launch_two(out, *extra):
+        port = free_port()
+        return subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+             "--node_rank=0", "--master_addr=127.0.0.1",
+             f"--master_port={port}", f"--world_info={world_info}", worker,
+             f"--out={out}", "--cluster", *extra],
+            env=clean_env(XLA_FLAGS=two_dev), capture_output=True, text=True,
+            timeout=timeout)
+
+    # (A) straggler: per-step sleep on rank 1, generous 5-step window
+    out_a = os.path.join(tmp, "cluster_a.json")
+    r = launch_two(out_a, "--steps=5", "--straggle_ms=150")
+    assert r.returncode == 0, \
+        f"straggler phase failed:\n{r.stdout[-800:]}\n{r.stderr[-1500:]}"
+    a = json.load(open(out_a))
+    assert a["world"] == 2, a
+    ca = a["cluster"]
+    assert ca["hosts"] == 2 and ca["heartbeats"] >= 5, ca
+    assert ca["straggler_host"] == 1, \
+        f"rank 1 slept 150ms/step but straggler naming said {ca!r}"
+    assert ca["straggler_events"] >= 1 and ca["watchdog_fired"] == 0, ca
+
+    # (B) stall: one 2s sleep inside an armed step vs a 0.5s deadline
+    out_b = os.path.join(tmp, "cluster_b.json")
+    dumps = os.path.join(tmp, "cluster_dumps")
+    r = launch_two(out_b, "--steps=4", "--hang_deadline_s=0.5",
+                   "--stall_step=2", "--stall_ms=2000",
+                   f"--cluster_dump_dir={dumps}")
+    assert r.returncode == 0, \
+        f"stall phase failed:\n{r.stdout[-800:]}\n{r.stderr[-1500:]}"
+    b = json.load(open(out_b))
+    assert b["cluster"]["watchdog_fired"] >= 1, b["cluster"]
+
+    from deepspeed_tpu.utils.cluster import assemble_cluster_report
+    from deepspeed_tpu.utils.numerics import load_run_bundles
+    run_key, by_host = load_run_bundles(dumps)
+    assert sorted(by_host) == [0, 1], \
+        f"expected dumps from both hosts in {dumps}, got {sorted(by_host)}"
+    report = assemble_cluster_report(by_host, run_key)
+    stall = report["first_stall"]
+    assert stall is not None and stall["host"] in (0, 1), report
+    assert stall["step"] == 2 and stall["scope"], report
+    return a, b
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--local_rank", type=int, default=0)
@@ -195,6 +269,21 @@ def main():
                         choices=["adam", "onebit"],
                         help="onebit = OneBitAdam(freeze_step=2): warmup is the "
                              "uncompressed mean, later steps 1-bit compressed")
+    parser.add_argument("--cluster", action="store_true",
+                        help="enable telemetry + telemetry.cluster (heartbeat "
+                             "aggregation, straggler naming, hang watchdog)")
+    parser.add_argument("--cluster_dump_dir", type=str, default="",
+                        help="shared hang-dump dir (also carries the peer "
+                             "hang markers)")
+    parser.add_argument("--hang_deadline_s", type=float, default=0.0,
+                        help="per-step watchdog deadline; 0 = watchdog off")
+    parser.add_argument("--straggle_ms", type=float, default=0.0,
+                        help="rank 1 sleeps this long inside every step's "
+                             "dispatch window (straggler injection)")
+    parser.add_argument("--stall_step", type=int, default=-1,
+                        help="rank 1 sleeps --stall_ms once at this step, "
+                             "while the watchdog is armed (hang injection)")
+    parser.add_argument("--stall_ms", type=float, default=0.0)
     args = parser.parse_args()
 
     import deepspeed_tpu
@@ -217,6 +306,16 @@ def main():
                             "params": {"lr": 1e-2, "freeze_step": 2}}
     if args.comm_mode:
         cfg["comm"] = {"mode": args.comm_mode}
+    if args.cluster:
+        cfg["telemetry"] = {
+            "enabled": True,
+            "cluster": {"enabled": True, "heartbeat_interval": 1,
+                        "hang_deadline_s": args.hang_deadline_s,
+                        "dump_dir": args.cluster_dump_dir,
+                        "straggler_threshold": 3.0,
+                        # steps 0-1 compile loss_and_grad + apply_update;
+                        # their walls are compile jitter, not signal
+                        "warmup_steps": 2}}
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
                                                config_params=cfg)
     if args.load:
@@ -225,11 +324,21 @@ def main():
         engine.load_checkpoint(args.ckpt_dir)
     data = random_dataset(8 * (args.data_offset + args.steps), hidden, seed=42)
     losses = []
+    import time as _time
     for i in range(args.data_offset, args.data_offset + args.steps):
         xs = np.stack([data[i * 8 + j][0] for j in range(8)])
         ys = np.stack([data[i * 8 + j][1] for j in range(8)])
         loss = engine(xs, ys)
         engine.backward(loss)
+        # cluster-observatory fault injection: sleeps land between backward
+        # and step, i.e. inside this host's dispatch window while the hang
+        # watchdog is armed — exactly where a slow input pipeline or a wedged
+        # host-side stage would stall a real run
+        if jax.process_index() == 1:
+            if args.straggle_ms > 0:
+                _time.sleep(args.straggle_ms / 1000.0)
+            if args.stall_ms > 0 and (i - args.data_offset) == args.stall_step:
+                _time.sleep(args.stall_ms / 1000.0)
         engine.step()
         losses.append(float(jax.device_get(loss)))
 
@@ -237,6 +346,13 @@ def main():
               "devices": jax.device_count(),
               "num_slices": engine._comm_topo.num_slices,
               "slice_size": engine._comm_topo.slice_size}
+    if args.cluster and engine._cluster is not None:
+        # give a stalled peer's watchdog time to finish its dump before this
+        # process exits (the launcher reaps children on first exit)
+        if args.stall_ms > 0:
+            _time.sleep(0.5)
+        result["cluster"] = engine._cluster.summary()
+        engine._cluster.stop()
     if args.ckpt_dir and not args.load:
         # every process writes its offload regions; process 0 writes the rest
         engine.save_checkpoint(args.ckpt_dir, tag="t0")

@@ -112,6 +112,32 @@ SWEEP = {
          ("attr", "telemetry_anatomy_dcn_gbps", 25.0)),
         ({"anatomy": {"enabled": True, "peak_tflops": -1}}, ("raise", ValueError)),
         ({"anatomy": {"enabled": True, "hbm_gbps": True}}, ("raise", ValueError)),
+        ({"enabled": True, "cluster": {"enabled": True}},
+         ("attr", "telemetry_cluster_enabled", True)),
+        ({"enabled": True, "cluster": {"enabled": True, "heartbeat_interval": 5}},
+         ("attr", "telemetry_cluster_heartbeat_interval", 5)),
+        ({"enabled": True, "cluster": {"enabled": True, "hang_deadline_s": 90}},
+         ("attr", "telemetry_cluster_hang_deadline_s", 90.0)),
+        ({"enabled": True, "cluster": {"enabled": True, "dump_dir": "/tmp/cl"}},
+         ("attr", "telemetry_cluster_dump_dir", "/tmp/cl")),
+        ({"enabled": True, "cluster": {"enabled": True, "straggler_threshold": 2.5}},
+         ("attr", "telemetry_cluster_straggler_threshold", 2.5)),
+        ({"enabled": True, "cluster": {"enabled": True, "signal_peers": False}},
+         ("attr", "telemetry_cluster_signal_peers", False)),
+        ({"enabled": True, "cluster": {"enabled": True, "warmup_steps": 3}},
+         ("attr", "telemetry_cluster_warmup_steps", 3)),
+        # the heartbeat rides the telemetry end_step record — no telemetry, no cluster
+        ({"cluster": {"enabled": True}}, ("raise", ValueError)),
+        ({"enabled": True, "cluster": {"enabled": True, "heartbeat_interval": 0}},
+         ("raise", ValueError)),
+        ({"enabled": True, "cluster": {"enabled": True, "hang_deadline_s": -1}},
+         ("raise", ValueError)),
+        ({"enabled": True, "cluster": {"enabled": True, "straggler_threshold": 1.0}},
+         ("raise", ValueError)),
+        ({"enabled": True, "cluster": {"enabled": True, "warmup_steps": -1}},
+         ("raise", ValueError)),
+        ({"enabled": True, "cluster": {"enabled": True, "warmup_steps": True}},
+         ("raise", ValueError)),
     ),
     "numerics": (
         ({"enabled": True, "audit_interval": 7}, ("attr", "numerics_audit_interval", 7)),
@@ -260,6 +286,14 @@ def test_unknown_anatomy_key_warns(capture):
     assert "chip" in capture.text    # the known-keys hint points at the fix
 
 
+def test_unknown_cluster_key_warns(capture):
+    _cfg(telemetry={"enabled": True,
+                    "cluster": {"enabled": True, "hang_deadline": 60}})
+    assert "unknown telemetry.cluster config key" in capture.text
+    assert "hang_deadline" in capture.text
+    assert "hang_deadline_s" in capture.text  # the known-keys hint points at the fix
+
+
 def test_unknown_serving_key_warns(capture):
     _cfg(serving={"enabled": True, "blok_size": 8})
     assert "unknown serving config key" in capture.text
@@ -317,7 +351,11 @@ def test_known_nested_keys_do_not_warn(capture):
     _cfg(telemetry={"enabled": True, "trace_steps": [2, 5],
                     "pipeline_trace": {"enabled": True, "capacity": 7},
                     "anatomy": {"enabled": True, "chip": "tpu-v4",
-                                "dcn_gbps": 25.0}},
+                                "dcn_gbps": 25.0},
+                    "cluster": {"enabled": True, "heartbeat_interval": 2,
+                                "hang_deadline_s": 120.0, "dump_dir": "/tmp/cl",
+                                "straggler_threshold": 3.0,
+                                "signal_peers": True, "warmup_steps": 2}},
          numerics={"enabled": True, "audit_interval": 3},
          serving={"request_trace": {"enabled": True, "capacity": 64,
                                     "slo": {"ttft_ms": 250.0, "tpot_ms": 40.0}}},

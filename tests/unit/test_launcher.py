@@ -355,6 +355,22 @@ def test_two_process_hierarchical_comm_loss_parity(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_cluster_observatory(tmp_path):
+    """Cluster observatory across REAL process boundaries (docs/cluster.md):
+    2 launcher-spawned jax.distributed processes with ``telemetry.cluster``
+    enabled. An injected 150 ms/step sleep on rank 1 must be NAMED as the
+    straggler by rank 0's heartbeat aggregation (exercises the host-local
+    dispatch column — the end-to-end wall is collective-equalised and can't
+    attribute), and an injected 2 s stall against a 0.5 s hang deadline must
+    produce flight-recorder dumps on BOTH hosts that ``cluster-dump``
+    assembles into one report naming a stalled host and its scope. Shares
+    the implementation with __graft_entry__'s dry run."""
+    from launcher_worker import run_cluster_observatory_rehearsal
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+    run_cluster_observatory_rehearsal(str(tmp_path), repo_root)
+
+
+@pytest.mark.slow
 def test_two_process_offload_region_checkpoint(tmp_path):
     """Multi-host ZeRO-Offload end-to-end: 2 real jax.distributed processes train with
     partitioned host-tier Adam, each writes ITS OWN region file on save, and a fresh
