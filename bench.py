@@ -93,6 +93,10 @@ REGRESSION_KEYS = (
     # restart TTFT ratio (docs/resilience.md) — both lower-is-better
     "extra.resilience.checkpoint_stall_ms",
     "extra.resilience.restore_warm_vs_cold_ttft",
+    # run-lifecycle goodput (docs/goodput.md): productive share of run wall,
+    # and the checkpoint-fence share of it (lower-is-better)
+    "extra.goodput.goodput_fraction",
+    "extra.goodput.badput_checkpoint_pct",
 )
 
 # keys where LOWER is better (latency): a regression is a RISE past the
@@ -102,6 +106,7 @@ LOWER_IS_BETTER_KEYS = frozenset(
     if k.endswith("_ms_p50") or k.endswith("_ms_p95")) | frozenset({
         "extra.resilience.checkpoint_stall_ms",
         "extra.resilience.restore_warm_vs_cold_ttft",
+        "extra.goodput.badput_checkpoint_pct",
     })
 
 
@@ -795,6 +800,44 @@ def bench_resilience_smoke():
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_goodput_smoke():
+    """Run-lifecycle goodput smoke (docs/goodput.md): a short engine run with
+    the badput ledger on and periodic async saves, reporting the goodput
+    fraction and the checkpoint-fence share of run wall — the two
+    run-efficiency numbers the round ledger tracks (the checkpoint share is
+    lower-is-better). Runs OUTSIDE the headline window like the other
+    smokes."""
+    import shutil
+    import tempfile
+
+    from deepspeed_tpu.resilience.crash_sim import (_goodput_trainer,
+                                                    _train_batches)
+
+    workdir = tempfile.mkdtemp(prefix="ds_bench_goodput_")
+    try:
+        engine = _goodput_trainer(0, os.path.join(workdir, "led"),
+                                  {"enabled": True,
+                                   "save_dir": os.path.join(workdir, "ckpt"),
+                                   "save_interval": 3})
+        for x, y in _train_batches(9, 0):
+            loss = engine(x, y)
+            engine.backward(loss)
+            engine.step()
+        engine._resilience.wait()
+        summary = engine._goodput.finalize()
+        wall = summary["wall_s"] or 1.0
+        cs = summary["class_seconds"]
+        return {"goodput_fraction": round(summary["goodput_fraction"], 4),
+                "badput_checkpoint_pct":
+                    round(100.0 * cs["checkpoint_stall"] / wall, 3),
+                "badput_init_pct": round(100.0 * cs["init"] / wall, 3),
+                "badput_compile_pct": round(100.0 * cs["compile"] / wall, 3),
+                "steps": int(summary["steps"]),
+                "checkpoint_stalls": int(summary["checkpoint_stalls"])}
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_serving_420m():
     """TPU serving path: GPT-2 420M bf16, 32-request mixed trace."""
     import jax.numpy as jnp
@@ -1080,7 +1123,7 @@ def _pipeline_goodput_probe(stages=4, micro=8, steps=2):
     gen = it()
     for _ in range(steps + 1):  # first batch carries the stage-fn compiles
         eng.train_batch(gen)
-    g = eng.pipe_trace.last_goodput
+    g = eng.pipe_trace.last_schedule_goodput
     t_fwd, t_bwd = measured_costs(eng.pipe_trace.steps[-1])
     sim = simulate_schedule(micro, stages, "train", t_fwd=t_fwd, t_bwd=t_bwd)
     return {"stages": stages, "micro_batches": micro,
@@ -1192,6 +1235,10 @@ def main():
             resilience = bench_resilience_smoke()
         except Exception as e:
             resilience = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            goodput = bench_goodput_smoke()
+        except Exception as e:
+            goodput = {"error": f"{type(e).__name__}: {e}"}
         anatomy = telemetry.get("anatomy") or {}
         result = {"metric": "gpt2_tokens_per_sec_per_chip_cpu_smoke",
                   "value": round(tps, 1), "unit": "tokens/s", "vs_baseline": 0.0,
@@ -1206,7 +1253,8 @@ def main():
                             "serving": serving,
                             "serving_prefix_cache": serving_prefix,
                             "serving_sharded": serving_sharded,
-                            "resilience": resilience}}
+                            "resilience": resilience,
+                            "goodput": goodput}}
         result["extra"]["regression_vs_previous_round"] = \
             regression_vs_previous_round(result)
         print(json.dumps(result))
@@ -1264,6 +1312,10 @@ def main():
         extra["serving_420m_sharded"] = bench_serving_420m_sharded()
     except Exception as e:
         extra["serving_420m_sharded"] = {"error": f"{type(e).__name__}: {e}"}
+    try:  # run-lifecycle goodput fraction + checkpoint badput share
+        extra["goodput"] = bench_goodput_smoke()
+    except Exception as e:
+        extra["goodput"] = {"error": f"{type(e).__name__}: {e}"}
     mp = max_params_offload()
     extra["max_trainable_params_per_chip_zero_offload"] = int(mp)
     if os.environ.get("DS_BENCH_SKIP_WORKLOADS", "0") != "1":

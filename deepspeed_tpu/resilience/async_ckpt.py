@@ -37,7 +37,8 @@ class AsyncCheckpointer:
     caller-visible cost of the most recent ``save()`` (snapshot + join of the
     previous writer) — the number bench.py reports as ``checkpoint_stall_ms``."""
 
-    def __init__(self, engine, save_dir: str, save_latest: bool = True):
+    def __init__(self, engine, save_dir: str, save_latest: bool = True,
+                 fence_delay_s: float = 0.0):
         self.engine = engine
         self.save_dir = save_dir
         self.save_latest = save_latest
@@ -46,6 +47,10 @@ class AsyncCheckpointer:
         self.last_stall_ms = 0.0
         self.saves_started = 0
         self.saves_committed = 0
+        # fault-injection hook (ds-tpu crash-sim goodput attribution): a known
+        # extra stall inside the snapshot fence, so the run ledger's
+        # checkpoint_stall attribution can be checked against ground truth
+        self.fence_delay_s = float(fence_delay_s)
 
     def _commit(self, snapshot):
         try:
@@ -62,6 +67,8 @@ class AsyncCheckpointer:
         device→host copy (and any previous still-running commit)."""
         t0 = time.perf_counter()
         self.wait()
+        if self.fence_delay_s > 0.0:
+            time.sleep(self.fence_delay_s)
         snapshot = snapshot_checkpoint(self.engine, tag=tag,
                                        client_state=client_state)
         self.saves_started += 1

@@ -324,6 +324,234 @@ def _serve_scenario(kill_point, seed, workdir):
                        and cold_match and waste_identity)}
 
 
+# --------------------------------------------------- goodput attribution
+# ``ds-tpu crash-sim --goodput``: every injected stall carries a known
+# ground-truth duration, and the run-lifecycle goodput ledger
+# (utils/goodput.py) must attribute it to the correct badput class within
+# GOODPUT_REL_TOL relative tolerance. The transcript holds only booleans,
+# ints, and the injected constants — never measured wall-clock — so CI
+# byte-pins it (tests/unit/golden/goodput_attribution.json, scripts/lint.sh).
+
+GOODPUT_REL_TOL = 0.10
+FENCE_DELAY_S = 0.8     # injected checkpoint snapshot-fence stall, per save
+REPLAY_STEP_S = 0.4     # injected per-step cost, so replay badput is known
+HANG_STALL_S = 0.6      # injected stall under an armed hang watchdog
+SKEW_MS = 80.0          # injected dispatch lag above the fleet median
+
+
+def _within(attributed, truth, rel=GOODPUT_REL_TOL):
+    """Ground-truth check: the ledger may bill the real (small) overhead on
+    top of the injection, but never less than the injection and never more
+    than ``rel`` above it."""
+    return bool(truth <= attributed <= truth * (1.0 + rel))
+
+
+def _partition_exact(ledger):
+    """The taxonomy partition invariant: class seconds sum to the run wall."""
+    return bool(abs(ledger.accounted_seconds() - ledger.wall_seconds()) < 0.01)
+
+
+def _goodput_trainer(seed, ledger_dir, resilience, numerics=None):
+    import jax
+
+    import deepspeed_tpu
+    model = _MLP()
+    params = model.init(jax.random.PRNGKey(seed))
+    cfg = {"train_batch_size": BATCH, "steps_per_print": 1 << 30,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "telemetry": {"enabled": True,
+                         "goodput": {"enabled": True,
+                                     "ledger_dir": ledger_dir}},
+           "resilience": resilience}
+    if numerics is not None:
+        cfg["numerics"] = numerics
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config_params=cfg)
+    return engine
+
+
+def _train_slow(engine, batches, sleep_s):
+    """Drive steps whose wall-clock is dominated by a known injected sleep,
+    so per-step badput has a ground truth independent of machine speed. The
+    sleep sits AFTER the forward: the ledger's first step interval opens at
+    the first forward dispatch (everything before it is init), so a sleep
+    ahead of it would be billed to init, not the step."""
+    import time as _time
+    for x, y in batches:
+        loss = engine(x, y)
+        _time.sleep(sleep_s)
+        engine.backward(loss)
+        engine.step()
+
+
+def _goodput_fence_scenario(seed, workdir):
+    """Injected checkpoint fence: every periodic save sleeps FENCE_DELAY_S
+    inside the snapshot fence (AsyncCheckpointer.fence_delay_s); the ledger
+    must bill each to ``checkpoint_stall``, not the productive step."""
+    save_dir = os.path.join(workdir, "gp_fence_ckpt")
+    ledger_dir = os.path.join(workdir, "gp_fence_ledger")
+    engine = _goodput_trainer(
+        seed, ledger_dir,
+        {"enabled": True, "save_dir": save_dir, "save_interval": SAVE_STEP})
+    engine._resilience.fence_delay_s = FENCE_DELAY_S  # the fault injection
+    _train(engine, _train_batches(TRAIN_STEPS, seed))
+    engine._resilience.wait()
+    led = engine._goodput
+    led.finalize(persist=True)
+    saves = int(engine._resilience.saves_started)   # steps 3 and 6 of 8
+    truth = saves * FENCE_DELAY_S
+    attributed = led.class_seconds["checkpoint_stall"]
+    within = _within(attributed, truth)
+    counted = led.checkpoint_stalls == saves
+    return {"injected_class": "checkpoint_stall",
+            "injected_s": truth, "saves": saves,
+            "stalls_counted": bool(counted),
+            "attributed_within_tolerance": within,
+            "partition_exact": _partition_exact(led),
+            "ok": bool(saves == 2 and counted and within
+                       and _partition_exact(led))}
+
+
+def _goodput_replay_scenario(seed, workdir):
+    """Kill/restore replay: the victim dies after KILL_STEP with a committed
+    checkpoint at SAVE_STEP and a flight-recorder dump whose span header
+    prices its steps. The restarted engine re-runs steps SAVE_STEP+1..
+    KILL_STEP — each carrying a known injected cost — and the ledger must
+    bill exactly those to ``restart_replay``."""
+    save_dir = os.path.join(workdir, "gp_replay_ckpt")
+    dump_dir = os.path.join(workdir, "gp_replay_dumps")
+    ledger_dir = os.path.join(workdir, "gp_replay_ledger")
+    # async saves: the commit rides a background thread, so the victim's
+    # dump span prices the steps themselves, not checkpoint file I/O
+    resilience = {"enabled": True, "save_dir": save_dir,
+                  "save_interval": SAVE_STEP, "auto_resume": True}
+    numerics = {"enabled": True, "dump_dir": dump_dir}
+    batches = _train_batches(TRAIN_STEPS, seed)
+
+    victim = _goodput_trainer(seed, ledger_dir, resilience, numerics)
+    _train_slow(victim, batches[:KILL_STEP], REPLAY_STEP_S)
+    victim._resilience.wait()   # the kill must land AFTER the commit
+    # clean preemption: dump the post-mortem (span header included), die
+    victim._numerics.recorder.trigger("preempt", {"sim": "goodput"},
+                                      quiet=True)
+
+    restarted = _goodput_trainer(seed + 1000, ledger_dir, resilience,
+                                 numerics)
+    _train_slow(restarted, batches[SAVE_STEP:], REPLAY_STEP_S)
+    led = restarted._goodput
+    led.finalize(persist=True)
+
+    expected_replay = KILL_STEP - SAVE_STEP
+    truth = expected_replay * REPLAY_STEP_S
+    attributed = led.class_seconds["restart_replay"]
+    within = _within(attributed, truth)
+    steps_match = led.replay_steps == expected_replay
+
+    # offline pricing from the dump alone (satellite of the same taxonomy):
+    # the victim's span header must reproduce the replay cost
+    from ..utils.goodput import estimate_replay_seconds
+    from ..utils.numerics import scan_dump_dir
+    est_steps, est_s = estimate_replay_seconds(
+        scan_dump_dir(dump_dir) or {}, SAVE_STEP)
+    est_close = bool(truth > 0
+                     and abs(est_s - truth) / truth <= 0.25)
+    return {"injected_class": "restart_replay",
+            "injected_s": truth, "replay_steps": expected_replay,
+            "replay_steps_match": bool(steps_match),
+            "attributed_within_tolerance": within,
+            "offline_estimate_steps": int(est_steps),
+            "offline_estimate_close": est_close,
+            "partition_exact": _partition_exact(led),
+            "ok": bool(steps_match and within
+                       and est_steps == expected_replay and est_close
+                       and _partition_exact(led))}
+
+
+def _goodput_hang_scenario():
+    """Watchdog hang: a step stalls HANG_STALL_S under an armed HangWatchdog
+    with a much shorter deadline. The engine's billing rule — a step during
+    which the watchdog fired bills its whole remainder to ``hang`` (a stalled
+    step produced nothing) — must attribute the stall."""
+    import time as _time
+
+    from ..utils.cluster import HangWatchdog
+    from ..utils.goodput import RunLedger
+
+    led = RunLedger(run_id="gpattr", host=0)
+    led.close("init")
+    _time.sleep(0.05)
+    led.close_step(1)                      # a healthy step first
+    wd = HangWatchdog(deadline_s=0.2, signal_peers=False, poll_s=0.05,
+                      run_id="gpattr")
+    wd.arm(2)
+    _time.sleep(HANG_STALL_S)              # the injected stall
+    wd.disarm()
+    fired = len(wd.fired) > 0
+    led.close_step(2, hang=fired)          # the engine's rule, verbatim
+    wd.stop()
+    led.finalize(persist=False)
+    attributed = led.class_seconds["hang"]
+    within = _within(attributed, HANG_STALL_S)
+    return {"injected_class": "hang", "injected_s": HANG_STALL_S,
+            "watchdog_fired": bool(fired),
+            "hang_steps": int(led.hang_steps),
+            "attributed_within_tolerance": within,
+            "partition_exact": _partition_exact(led),
+            "ok": bool(fired and led.hang_steps == 1 and within
+                       and _partition_exact(led))}
+
+
+def _goodput_skew_scenario():
+    """Rank sleep: this host really sleeps through its step while the
+    injected heartbeat matrix shows its dispatch SKEW_MS above the fleet
+    median — the amount the ledger must carve to ``straggler_skew``."""
+    import time as _time
+
+    from ..utils.cluster import ClusterMonitor
+    from ..utils.goodput import RunLedger
+
+    mon = ClusterMonitor(heartbeat_interval=1, host_id=1, n_hosts=2,
+                         hang_deadline_s=0, warmup_steps=0,
+                         allgather=lambda row: [row])
+    led = RunLedger(run_id="gpattr", host=1)
+    led.close("init")
+    _time.sleep(SKEW_MS / 1000.0 + 0.05)   # the rank's real lag + step work
+    mon.ingest([[1.0, 1000.0, 12.0, 9.0, 1024.0, 2048.0, 0.0],
+                [1.0, 1000.0, 12.0, 9.0 + SKEW_MS, 1024.0, 2048.0, 0.0]], 1)
+    truth = SKEW_MS / 1000.0
+    led.close_step(1, {"straggler_skew": mon.last_local_skew_s})
+    led.finalize(persist=False)
+    attributed = led.class_seconds["straggler_skew"]
+    within = _within(attributed, truth)
+    integral_seen = abs(mon.skew_integral_s - truth) < 1e-9
+    return {"injected_class": "straggler_skew", "injected_s": truth,
+            "skew_integral_seen": bool(integral_seen),
+            "attributed_within_tolerance": within,
+            "partition_exact": _partition_exact(led),
+            "ok": bool(integral_seen and within and _partition_exact(led))}
+
+
+def run_goodput_attribution(seed=0, workdir=None):
+    """All four injected-stall attributions. Deterministic transcript."""
+    own_dir = workdir is None
+    if own_dir:
+        workdir = tempfile.mkdtemp(prefix="ds_tpu_goodput_attr_")
+    try:
+        scenarios = {
+            "checkpoint_fence": _goodput_fence_scenario(seed, workdir),
+            "restart_replay": _goodput_replay_scenario(seed, workdir),
+            "watchdog_hang": _goodput_hang_scenario(),
+            "rank_sleep_skew": _goodput_skew_scenario(),
+        }
+        return {"version": 1, "kind": "goodput_attribution",
+                "seed": int(seed), "tolerance_rel": GOODPUT_REL_TOL,
+                "scenarios": scenarios,
+                "ok": all(s["ok"] for s in scenarios.values())}
+    finally:
+        if own_dir:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
 KILL_POINTS = ("mid_save", "between_shards", "auto_resume", "mid_decode",
                "post_preempt")
 
@@ -364,7 +592,31 @@ def main(argv=None):
                         help="write the deterministic recovery transcript")
     parser.add_argument("--workdir", default=None,
                         help="keep checkpoints here instead of a tmp dir")
+    parser.add_argument("--goodput", action="store_true",
+                        help="run the goodput-attribution sweep instead: "
+                             "every injected stall (checkpoint fence, "
+                             "kill/restore replay, watchdog hang, rank "
+                             "sleep) must land in the correct badput class "
+                             "within tolerance")
     args = parser.parse_args(argv)
+
+    if args.goodput:
+        transcript = run_goodput_attribution(seed=args.seed,
+                                             workdir=args.workdir)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(transcript, f, indent=2, sort_keys=True)
+                f.write("\n")
+        print(f"crash-sim --goodput seed={args.seed} "
+              f"(rel tolerance {transcript['tolerance_rel']})")
+        for name, s in transcript["scenarios"].items():
+            status = "PASS" if s["ok"] else "FAIL"
+            print(f"  {status} {name}: {s['injected_s']:.2f}s injected -> "
+                  f"{s['injected_class']}")
+        print("crash-sim: every injected stall attributed"
+              if transcript["ok"]
+              else "crash-sim: GOODPUT MISATTRIBUTION", flush=True)
+        return 0 if transcript["ok"] else 1
 
     kps = (KILL_POINTS if args.kill_points == "all"
            else tuple(args.kill_points.split(",")))

@@ -339,6 +339,37 @@ class DeepSpeedConfig:
                 f"int >= 0 (steps before the watchdog arms / stragglers are "
                 f"named — the compile steps), got {wu!r}")
 
+        gp_dict = tel_dict.get(TELEMETRY_GOODPUT, {}) or {}
+        self._warn_unknown_nested(f"{TELEMETRY}.{TELEMETRY_GOODPUT}",
+                                  gp_dict, GOODPUT_CONFIG_KEYS)
+        self.telemetry_goodput_enabled = get_scalar_param(gp_dict, GOODPUT_ENABLED,
+                                                          GOODPUT_ENABLED_DEFAULT)
+        if self.telemetry_goodput_enabled and not self.telemetry_enabled:
+            raise ValueError(
+                "DeepSpeedConfig: telemetry.goodput.enabled requires "
+                "telemetry.enabled — the ledger closes its step intervals on "
+                "the end_step record the telemetry session produces")
+        self.telemetry_goodput_ledger_dir = get_scalar_param(
+            gp_dict, GOODPUT_LEDGER_DIR, GOODPUT_LEDGER_DIR_DEFAULT)
+        if not isinstance(self.telemetry_goodput_ledger_dir, str):
+            raise ValueError(
+                "DeepSpeedConfig: telemetry.goodput.ledger_dir must be a string "
+                f"path (\"\" = beside the flight-recorder dumps), got "
+                f"{self.telemetry_goodput_ledger_dir!r}")
+        self.telemetry_goodput_emit_scalars = get_scalar_param(
+            gp_dict, GOODPUT_EMIT_SCALARS, GOODPUT_EMIT_SCALARS_DEFAULT)
+        if not isinstance(self.telemetry_goodput_emit_scalars, bool):
+            raise ValueError(
+                "DeepSpeedConfig: telemetry.goodput.emit_scalars must be a "
+                f"bool, got {self.telemetry_goodput_emit_scalars!r}")
+        self.telemetry_goodput_eval_tag = get_scalar_param(
+            gp_dict, GOODPUT_EVAL_TAG, GOODPUT_EVAL_TAG_DEFAULT)
+        if (not isinstance(self.telemetry_goodput_eval_tag, str)
+                or not self.telemetry_goodput_eval_tag):
+            raise ValueError(
+                "DeepSpeedConfig: telemetry.goodput.eval_tag must be a "
+                f"non-empty string, got {self.telemetry_goodput_eval_tag!r}")
+
         num_dict = param_dict.get(NUMERICS, {})
         self._warn_unknown_nested(NUMERICS, num_dict, NUMERICS_CONFIG_KEYS)
         self.numerics_enabled = get_scalar_param(num_dict, NUMERICS_ENABLED, NUMERICS_ENABLED_DEFAULT)

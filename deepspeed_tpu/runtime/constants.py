@@ -237,6 +237,25 @@ CLUSTER_SIGNAL_PEERS_DEFAULT = True
 CLUSTER_WARMUP_STEPS = "warmup_steps"
 CLUSTER_WARMUP_STEPS_DEFAULT = 1
 
+# telemetry.goodput sub-block: run-lifecycle goodput/badput ledger — classifies
+# every wall-clock interval of the run into a closed badput taxonomy (init,
+# compile, productive_step, checkpoint_stall, restart_replay, hang,
+# straggler_skew, eval, host_gap) with an exact-partition invariant
+# (docs/goodput.md). Host-side only; the lowered step program is
+# HLO-instruction-identical with the block on or off.
+TELEMETRY_GOODPUT = "goodput"
+GOODPUT_ENABLED = "enabled"
+GOODPUT_ENABLED_DEFAULT = False
+# where the per-run ledger JSON lands; "" falls back to the flight-recorder
+# dump_dir (numerics.dump_dir) so the ledger sits beside the dumps it prices
+GOODPUT_LEDGER_DIR = "ledger_dir"
+GOODPUT_LEDGER_DIR_DEFAULT = ""
+GOODPUT_EMIT_SCALARS = "emit_scalars"
+GOODPUT_EMIT_SCALARS_DEFAULT = True
+# tag used for eval intervals in the ledger (and the Run/Goodput scalar name)
+GOODPUT_EVAL_TAG = "eval_tag"
+GOODPUT_EVAL_TAG_DEFAULT = "eval"
+
 #############################################
 # Numerics observatory (TPU-native health layer on top of telemetry; no
 # reference key — in-graph per-subtree anomaly sentinel, loss-scale event
@@ -522,6 +541,7 @@ TELEMETRY_CONFIG_KEYS = frozenset({
     TELEMETRY_PIPELINE_TRACE,
     TELEMETRY_ANATOMY,
     TELEMETRY_CLUSTER,
+    TELEMETRY_GOODPUT,
 })
 
 ANATOMY_CONFIG_KEYS = frozenset({
@@ -547,6 +567,13 @@ CLUSTER_CONFIG_KEYS = frozenset({
     CLUSTER_STRAGGLER_THRESHOLD,
     CLUSTER_SIGNAL_PEERS,
     CLUSTER_WARMUP_STEPS,
+})
+
+GOODPUT_CONFIG_KEYS = frozenset({
+    GOODPUT_ENABLED,
+    GOODPUT_LEDGER_DIR,
+    GOODPUT_EMIT_SCALARS,
+    GOODPUT_EVAL_TAG,
 })
 
 NUMERICS_CONFIG_KEYS = frozenset({

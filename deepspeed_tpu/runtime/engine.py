@@ -584,6 +584,44 @@ class DeepSpeedEngine:
             # cluster-dump / timeline --cluster can merge hosts coherently
             cluster_recorder.cluster = self._cluster
 
+        # ---- run-lifecycle goodput ledger (docs/goodput.md): classifies the
+        # run's entire wall-clock into a closed badput taxonomy (init, compile,
+        # productive_step, checkpoint_stall, restart_replay, hang,
+        # straggler_skew, eval, host_gap) with an exact-partition invariant.
+        # Opened HERE, before _compile_steps, so construction-time compiles
+        # land in the ledger. Pure host arithmetic over timestamps the other
+        # observatories already took — the step programs stay
+        # HLO-instruction-identical with this block enabled (tested).
+        self._goodput = None
+        if self.telemetry is not None and self.config.telemetry_goodput_enabled:
+            from ..utils.goodput import RunLedger
+            gp_recorder = (self._numerics.recorder
+                           if self._numerics is not None else None)
+            if gp_recorder is None and self._cluster is not None:
+                gp_recorder = self._cluster.recorder
+            ledger_dir = (self.config.telemetry_goodput_ledger_dir
+                          or (gp_recorder.dump_dir
+                              if gp_recorder is not None else None)
+                          or "goodput_ledgers")
+            if gp_recorder is not None:
+                run_id = gp_recorder.run_id
+            else:
+                from ..utils.numerics import default_run_id
+                run_id = default_run_id()
+            self._goodput = RunLedger(
+                run_id=run_id, host=jax.process_index(),
+                ledger_dir=ledger_dir,
+                eval_tag=self.config.telemetry_goodput_eval_tag)
+            # carve-out baselines: compile seconds, watchdog fires, and
+            # checkpoint saves are cumulative counters; the ledger bills
+            # per-step deltas
+            self._goodput_compile_base = 0.0
+            self._goodput_hang_base = 0
+            self._goodput_saves_base = 0
+            self._goodput_init_open = True
+            if self._cluster is not None:
+                self._cluster.goodput = self._goodput
+
         self._compile_steps()
 
         # ---- resilience (docs/resilience.md): periodic async checkpointing +
@@ -598,7 +636,25 @@ class DeepSpeedEngine:
                 self, self.config.resilience_save_dir)
             if self.config.resilience_auto_resume:
                 from ..resilience.auto_resume import auto_resume
-                auto_resume(self, self.config.resilience_save_dir)
+                _, _, resume_info = auto_resume(
+                    self, self.config.resilience_save_dir)
+                if self._goodput is not None and resume_info is not None:
+                    # restart-replay billing: steps between the restore point
+                    # and the pre-crash step are work the run already paid for
+                    # once. The pre-crash step is the flight recorder's first
+                    # bad step (exclusive — re-running IT is new work) or,
+                    # after a clean preemption, the dump's last recorded step.
+                    stop = resume_info.get("first_bad_step")
+                    if stop is not None:
+                        stop = int(stop) - 1
+                    elif self._numerics is not None:
+                        from ..utils.numerics import scan_dump_dir
+                        bundle = scan_dump_dir(
+                            self._numerics.recorder.dump_dir) or {}
+                        span = bundle.get("span") or {}
+                        stop = span.get("last_step")
+                    if stop is not None:
+                        self._goodput.set_replay_until(int(stop))
 
         if self.config.dump_state:
             self.config.print("DeepSpeedEngine configuration")
@@ -1735,6 +1791,8 @@ class DeepSpeedEngine:
             if self._cluster is not None:
                 # arm the hang watchdog deadline around this optimizer step
                 self._cluster.on_step_begin(self.global_steps)
+            # goodput: construction -> first train step is the init interval
+            self._goodput_close_init()
         if self.wall_clock_breakdown():
             self.timers("forward_microstep").start()
         batch = tuple(self.shard_batch(x) if not isinstance(x, jax.Array) else x for x in inputs)
@@ -1780,8 +1838,10 @@ class DeepSpeedEngine:
                 self._pending_grads = grads
                 self._pending_loss = loss
         else:
+            self._goodput_begin_eval()
             loss = self._jit_eval(self.params, *batch)
             self._pending_grads = None
+            self._goodput_end_eval()
         if self.wall_clock_breakdown():
             self.timers("forward_microstep").stop()
         return loss
@@ -1995,7 +2055,8 @@ class DeepSpeedEngine:
             # when no monitor is attached) — no extra barrier enters the step
             numerics_host = self.telemetry.end_step(
                 self.global_steps, self.train_batch_size(),
-                pending=self._window_losses, numerics=self._pending_sentinel)
+                pending=self._window_losses, numerics=self._pending_sentinel,
+                run_goodput=self._goodput_scalars())
         elif self._pending_sentinel is not None:
             numerics_host = jax.device_get(self._pending_sentinel)
         if self._numerics is not None:
@@ -2014,6 +2075,9 @@ class DeepSpeedEngine:
             self._resilience.save(tag=f"global_step{self.global_steps}")
             if not self.config.resilience_async_save:
                 self._resilience.wait()
+        # goodput: close this step's wall-clock interval AFTER the save hook,
+        # so its snapshot fence is carved out of this step, not the next
+        self._goodput_close_train_step()
         if self.wall_clock_breakdown():
             self.timers("step_microstep").stop()
             self.timers.log(["forward_microstep", "backward_microstep", "step_microstep"],
@@ -2044,6 +2108,83 @@ class DeepSpeedEngine:
                                    grad_norm=gn)
         if self._numerics.audit_due(self.global_steps):
             self._desync_audit()
+
+    # ------------------------------------------------------------------ goodput
+    # Run-lifecycle ledger hooks (docs/goodput.md). All pure host arithmetic
+    # over counters the other observatories already maintain — nothing here
+    # touches a device value, so the no-host-sync guard and the HLO-identity
+    # tests hold with the block enabled.
+
+    def _goodput_scalars(self):
+        """Run/Goodput/* scalar dict for end_step — the ledger's state through
+        the PREVIOUS step boundary (this step's interval closes after the
+        save hook below)."""
+        if self._goodput is None \
+                or not self.config.telemetry_goodput_emit_scalars:
+            return None
+        return dict(self._goodput.scalar_items())
+
+    def _goodput_compile_delta(self):
+        """Compile seconds accrued since the last carve, from the compile
+        watchdog's cumulative record wall."""
+        if self.telemetry is None or self.telemetry.watchdog is None:
+            return 0.0
+        comp = self.telemetry.watchdog.compile_seconds()
+        delta = comp - self._goodput_compile_base
+        self._goodput_compile_base = comp
+        return max(delta, 0.0)
+
+    def _goodput_close_init(self):
+        """Close the construction -> first-step interval as init, with the
+        construction-time compiles (_compile_steps) carved out."""
+        if self._goodput is None or not self._goodput_init_open:
+            return
+        self._goodput_init_open = False
+        self._goodput.close("init",
+                            {"compile": self._goodput_compile_delta()})
+
+    def _goodput_begin_eval(self):
+        """The span between the last boundary and eval dispatch is host gap,
+        not eval — classify it before the eval interval opens."""
+        if self._goodput is None:
+            return
+        self._goodput_close_init()
+        self._goodput.close("host_gap")
+
+    def _goodput_end_eval(self):
+        if self._goodput is None:
+            return
+        self._goodput.close("eval",
+                            {"compile": self._goodput_compile_delta()})
+
+    def _goodput_close_train_step(self):
+        """Close one train step's interval: carve compile, the checkpoint
+        snapshot fence (when a save ran this step), and this host's dispatch
+        skew above the fleet median; a step during which the hang watchdog
+        fired bills its remainder to hang, a replayed step to restart_replay,
+        everything else to productive_step."""
+        if self._goodput is None:
+            return
+        self._goodput_close_init()
+        carve = {"compile": self._goodput_compile_delta()}
+        if self._resilience is not None:
+            started = self._resilience.saves_started
+            if started != self._goodput_saves_base:
+                self._goodput_saves_base = started
+                carve["checkpoint_stall"] = \
+                    self._resilience.last_stall_ms / 1000.0
+        hang = False
+        if self._cluster is not None:
+            skew = self._cluster.last_local_skew_s
+            if skew > 0.0:
+                carve["straggler_skew"] = skew
+                # consumed: a skipped-heartbeat step must not re-bill it
+                self._cluster.last_local_skew_s = 0.0
+            if self._cluster.watchdog is not None:
+                fired = len(self._cluster.watchdog.fired)
+                hang = fired != self._goodput_hang_base
+                self._goodput_hang_base = fired
+        self._goodput.close_step(self.global_steps, carve, hang=hang)
 
     def _desync_audit(self):
         """Cross-rank replica-consistency audit (docs/numerics.md §audit): one

@@ -654,6 +654,8 @@ class PipelineEngine(DeepSpeedEngine):
 
         if self.telemetry is not None:
             self.telemetry.on_step_begin(self.global_steps)
+        # goodput: construction -> first train step is the init interval
+        self._goodput_close_init()
         tracer = self.pipe_trace
         mb = self.micro_batches
         S = self.num_stages
@@ -813,13 +815,15 @@ class PipelineEngine(DeepSpeedEngine):
             numerics_host = self.telemetry.end_step(
                 self.global_steps, self.train_batch_size(),
                 pending=pending_losses, numerics=self._pending_sentinel,
-                goodput=goodput)
+                schedule_goodput=goodput,
+                run_goodput=self._goodput_scalars())
         elif self._pending_sentinel is not None:
             numerics_host = jax.device_get(self._pending_sentinel)
         if self._numerics is not None:
             self._commit_numerics(numerics_host,
                                   getattr(self, "_pipe_overflowed", False),
                                   pending_losses or [])
+        self._goodput_close_train_step()
         if breakdown:
             self.timers("train_batch").stop()
             if self.global_steps % self.steps_per_print() == 0:
@@ -891,8 +895,12 @@ class PipelineEngine(DeepSpeedEngine):
         evaluates the same jitted pipeline forward loss-only."""
         if self._spmd:
             x, y = self._stack_window(data_iter)
-            return self._jit_eval(self.params, x, y)
+            self._goodput_begin_eval()
+            loss = self._jit_eval(self.params, x, y)
+            self._goodput_end_eval()
+            return loss
         tracer = self.pipe_trace
+        self._goodput_begin_eval()
         mb = self.micro_batches
         S = self.num_stages
         scheds = [schedule.InferenceSchedule(micro_batches=mb, stages=S, stage_id=s)
@@ -968,4 +976,5 @@ class PipelineEngine(DeepSpeedEngine):
         self._run_streams(streams, traced_exec)
         if tracer is not None:
             tracer.end_step()
+        self._goodput_end_eval()
         return jnp.mean(jnp.stack(micro_losses))

@@ -430,6 +430,15 @@ class ClusterMonitor:
         self.heartbeats = deque(maxlen=max(int(heartbeat_capacity), 8))
         self.stragglers = deque(maxlen=64)
         self.last_stats = None
+        # dispatch-skew integral for the goodput ledger: seconds THIS host's
+        # dispatch wall sat above the fleet lower-middle median, sampled at
+        # heartbeat steps (utils/goodput.py bills them as straggler_skew)
+        self.last_local_skew_s = 0.0
+        self.skew_integral_s = 0.0
+        # when the engine's run ledger is attached, every flight-recorder
+        # dump's cluster bundle carries this host's goodput summary, so the
+        # cluster plane can merge a fleet goodput view post-mortem
+        self.goodput = None
         self.watchdog = None
         if hang_deadline_s and float(hang_deadline_s) > 0:
             self.watchdog = HangWatchdog(
@@ -489,6 +498,16 @@ class ClusterMonitor:
             # jitter — naming a straggler from them would be noise
             stats["straggler"] = None
         self.last_stats = stats
+        # goodput's straggler_skew source: this host's dispatch wall above the
+        # fleet lower-middle median (same column and median rule the straggler
+        # namer uses). Warmup steps are excluded for the same reason.
+        self.last_local_skew_s = 0.0
+        if int(step) >= self.warmup_steps and 0 <= self.host_id < len(matrix):
+            dispatch = [row[3] for row in matrix]
+            skew_ms = dispatch[self.host_id] - _median_low(dispatch)
+            if skew_ms > 0:
+                self.last_local_skew_s = skew_ms / 1000.0
+                self.skew_integral_s += self.last_local_skew_s
         strag = stats["straggler"]
         if strag is not None:
             event = {"step": int(step), "host": int(strag["host"]),
@@ -524,7 +543,7 @@ class ClusterMonitor:
         return estimate_clock_offsets(list(self.heartbeats))
 
     def bundle(self):
-        return {
+        out = {
             "version": CLUSTER_BUNDLE_VERSION,
             "kind": CLUSTER_KIND,
             "host": self.host_id,
@@ -534,7 +553,11 @@ class ClusterMonitor:
             "heartbeats": [[list(row) for row in m] for m in self.heartbeats],
             "stragglers": list(self.stragglers),
             "clock_offsets_s": self.clock_offsets(),
+            "skew_integral_s": self.skew_integral_s,
         }
+        if self.goodput is not None:
+            out["goodput"] = self.goodput.summary()
+        return out
 
     def summary(self):
         last = self.last_stats or {}
@@ -709,6 +732,19 @@ def assemble_cluster_report(by_host, run_key=""):
     for g in hangs:
         g.pop("_t", None)
     fb_step, fb_host = merge_first_bad(by_host)
+    # rank-0 fleet goodput: when the per-host cluster bundles (or the dumps
+    # themselves) carry run-ledger summaries, fold them into one fleet view
+    # with the per-host breakdown (utils/goodput.fleet_goodput)
+    goodput_by_host = {}
+    for h in hosts:
+        led = (by_host[h].get("goodput")
+               or (by_host[h].get("cluster") or {}).get("goodput"))
+        if isinstance(led, dict) and led.get("kind") == "goodput":
+            goodput_by_host[h] = led
+    fleet_gp = None
+    if goodput_by_host:
+        from .goodput import fleet_goodput
+        fleet_gp = fleet_goodput(goodput_by_host)
     return {
         "version": 1,
         "kind": "cluster_report",
@@ -722,6 +758,7 @@ def assemble_cluster_report(by_host, run_key=""):
         "first_bad_step": fb_step,
         "first_bad_host": fb_host,
         "stragglers": stragglers,
+        "goodput": fleet_gp,
     }
 
 
@@ -842,6 +879,30 @@ def hang_sim_main(argv=None):
         for h in hosts:
             monitors[h].ingest(matrix, s)
 
+    # per-host goodput ledgers on a FAKE clock (utils/goodput.py): 1s of
+    # init then four 1s steps, host 1's stall step billed to ``hang``. The
+    # ledgers ride the cluster bundles into both dumps, so the merged report
+    # must carry the rank-0 fleet goodput view — with deterministic seconds,
+    # keeping the transcript byte-pinnable.
+    from .goodput import RunLedger
+    ledgers = {}
+    for h in hosts:
+        cell = [0.0]
+
+        def _clock(cell=cell):
+            return cell[0]
+
+        led = RunLedger(run_id=run, host=h, clock=_clock,
+                        wall=lambda: 1000.0)
+        cell[0] = 1.0
+        led.close("init")
+        for s in range(stall_step + 1):
+            cell[0] += 1.0
+            led.close_step(s, hang=(h == 1 and s == stall_step))
+        led.finalize(persist=False)
+        ledgers[h] = led
+        monitors[h].goodput = led
+
     # host 1: short deadline, stalled inside a grad-bucket collective.
     # host 0: un-expirable deadline — only the peer signal can fire it.
     trackers[0].enter("ds_fwd_bwd")
@@ -877,9 +938,19 @@ def hang_sim_main(argv=None):
         and p["waited_s"] is not None
         and p["waited_s"] <= args.deadline + 2.0
         for p in watchdogs[1].fired)
+    # the fleet goodput view must survive the dump -> merge round trip with
+    # the stalled host's hang second attributed (7 productive host-seconds
+    # of 10 total -> 0.7)
+    gp = report.get("goodput")
+    goodput_attributed = bool(
+        gp is not None and gp.get("kind") == "goodput_fleet"
+        and gp.get("n_hosts") == 2 and gp.get("hang_steps") == 1
+        and abs(gp["class_seconds"]["hang"] - 1.0) < 1e-9
+        and abs(gp["goodput_fraction"] - 0.7) < 1e-9)
     ok = (detected
           and len(dumps) == 2
           and all(recorders[h].dump_count >= 1 for h in hosts)
+          and goodput_attributed
           and report["first_stall"] == {"host": 1, "step": stall_step,
                                         "scope": "ds_grad_bucket1",
                                         "origin": "deadline"})
@@ -891,6 +962,7 @@ def hang_sim_main(argv=None):
         "stalled_host": 1,
         "stall_step": stall_step,
         "detected_within_deadline": bool(detected),
+        "goodput_attributed": goodput_attributed,
         "dumps": dumps,
         "report": report,
         "ok": bool(ok),
@@ -905,6 +977,9 @@ def hang_sim_main(argv=None):
     if fs:
         print(f"  cluster-dump: first stall host {fs['host']} in scope "
               f"'{fs['scope']}'")
+    if gp is not None:
+        print(f"  fleet goodput: {gp['goodput_fraction']:.2f} over "
+              f"{gp['n_hosts']} hosts ({gp['hang_steps']} hung step(s))")
     print(f"hang-sim: {'OK' if ok else 'FAILED'}")
 
     if args.json:

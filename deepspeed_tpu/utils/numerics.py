@@ -232,6 +232,11 @@ class FlightRecorder:
         self.run_id = _sanitize_token(run_id) if run_id is not None \
             else default_run_id()
         self.host_id = int(host_id)
+        # wall/monotonic anchor pair taken once: every per-step monotonic
+        # stamp converts to wall-clock as wall0 + (mono - mono0), so the
+        # dump's span fields stay consistent even across NTP slews
+        self._wall0 = time.time()
+        self._mono0 = time.perf_counter()
         self.steps = deque(maxlen=self.capacity)
         self.events = deque(maxlen=max(self.capacity * 4, 64))
         self.dump_count = 0
@@ -241,6 +246,9 @@ class FlightRecorder:
 
     # -- recording ---------------------------------------------------------
     def record_step(self, record):
+        # monotonic stamp per record: the dump's "span" header prices
+        # seconds-per-step for restart-replay badput (utils/goodput.py)
+        record.setdefault("mono", time.perf_counter())
         self.steps.append(record)
 
     def record_event(self, name, payload, step=None):
@@ -284,6 +292,9 @@ class FlightRecorder:
             "events": list(self.events),
             "compile_records": compile_records,
         }
+        span = self._span()
+        if span is not None:
+            out["span"] = span
         if self.run_id:
             out["run"] = self.run_id
         if self.pipeline_trace is not None:
@@ -293,6 +304,33 @@ class FlightRecorder:
         if self.cluster is not None:
             out["cluster"] = self.cluster.bundle()
         return out
+
+    def _span(self):
+        """Monotonic + wall-clock extent of the recorded step ring, or None
+        when no step carries a stamp (records fed in by hand, old callers).
+        ``steps_spanned`` counts step *intervals* — the step-number delta when
+        both ends know their step, else stamped records minus one — so
+        (mono_end - mono_start) / steps_spanned is seconds-per-step; that is
+        how ``goodput.estimate_replay_seconds`` prices restart-replay badput
+        from a dump alone."""
+        stamped = [r for r in self.steps if r.get("mono") is not None]
+        if not stamped:
+            return None
+        first, last = stamped[0], stamped[-1]
+        first_step, last_step = first.get("step"), last.get("step")
+        if first_step is not None and last_step is not None:
+            spanned = int(last_step) - int(first_step)
+        else:
+            spanned = len(stamped) - 1
+        return {
+            "mono_start": float(first["mono"]),
+            "mono_end": float(last["mono"]),
+            "wall_start": self._wall0 + (float(first["mono"]) - self._mono0),
+            "wall_end": self._wall0 + (float(last["mono"]) - self._mono0),
+            "first_step": first_step,
+            "last_step": last_step,
+            "steps_spanned": spanned,
+        }
 
     # -- triggering --------------------------------------------------------
     def trigger(self, reason, detail=None, quiet=False):
@@ -637,6 +675,8 @@ def summarize_dump(bundle):
         "offending_subtree": offending,
         "steps_recorded": len(steps),
         "events_recorded": len(bundle.get("events", [])),
+        # None for legacy dumps written before the span header existed
+        "span": bundle.get("span"),
         "loss_scale_trajectory": bundle.get("loss_scale_trajectory", []),
         "desync": next((e["payload"]["divergence"]
                         for e in bundle.get("events", [])
@@ -711,6 +751,12 @@ def inspect_dump_main(argv=None):
     print(f"  offending subtree : {s['offending_subtree']}")
     print(f"  steps recorded    : {s['steps_recorded']}")
     print(f"  events recorded   : {s['events_recorded']}")
+    if s.get("span"):
+        sp = s["span"]
+        mono = float(sp.get("mono_end", 0.0)) - float(sp.get("mono_start", 0.0))
+        print(f"  step span         : steps {sp.get('first_step')}"
+              f"..{sp.get('last_step')} over {mono:.3f}s "
+              f"({sp.get('steps_spanned')} interval(s))")
     if s["desync"]:
         d = s["desync"]
         print(f"  DESYNC            : subtree '{d.get('subtree')}' on replicas "

@@ -89,7 +89,11 @@ class PipelineTracer:
         self.dump_dir = dump_dir or None
         self.host_id = int(host_id)
         self.steps = deque(maxlen=self.capacity)
-        self.last_goodput = None
+        # the per-step SCHEDULE decomposition (bubble accounting) — distinct
+        # from the run-level Run/Goodput ledger (utils/goodput.py), which is
+        # why the bare "goodput" name is deprecated here (docs/telemetry.md)
+        self.last_schedule_goodput = None
+        self.last_goodput = None   # deprecated alias, kept one release
         self._epoch = time.perf_counter()
         self._cur = None
         self._straggler_warned = 0
@@ -128,9 +132,13 @@ class PipelineTracer:
         t0 = cur.pop("_t0")
         cur["wall_seconds"] = time.perf_counter() - t0
         goodput = goodput_decomposition(cur["spans"], self.stages)
+        cur["schedule_goodput"] = goodput
+        # deprecated alias, kept one release: readers should move to
+        # "schedule_goodput" (the bare name now means the run-level ledger)
         cur["goodput"] = goodput
         self.steps.append(cur)
-        self.last_goodput = goodput
+        self.last_schedule_goodput = goodput
+        self.last_goodput = goodput   # deprecated alias, kept one release
         straggler = goodput.get("straggler")
         if straggler is not None and self._straggler_warned < 3:
             self._straggler_warned += 1
@@ -147,8 +155,9 @@ class PipelineTracer:
         seconds exceed ``threshold`` x the median is named as the straggler."""
         if not self.steps:
             return None
-        return _find_straggler(
-            self.steps[-1]["goodput"]["per_stage_busy_seconds"], threshold)
+        last = self.steps[-1]
+        decomp = last.get("schedule_goodput") or last.get("goodput") or {}
+        return _find_straggler(decomp["per_stage_busy_seconds"], threshold)
 
     # -- bundle / dump -----------------------------------------------------
     def bundle(self, last_n=None):
@@ -528,7 +537,8 @@ def simulated_bundle(micro_batches, stages, schedule="train",
         "spans": spans,
         "wall_seconds": t / 1e6,
     }
-    rec["goodput"] = goodput_decomposition(spans, stages)
+    rec["schedule_goodput"] = goodput_decomposition(spans, stages)
+    rec["goodput"] = rec["schedule_goodput"]   # deprecated alias, one release
     return {
         "version": PIPELINE_TRACE_VERSION,
         "kind": "pipeline_trace",
@@ -560,7 +570,8 @@ def to_trace_events(bundle):
         base = int(rec.get("t0_us", 0))
         train = rec.get("schedule") != "InferenceSchedule"
         occupancy = [0] * stages
-        goodput = rec.get("goodput") or {}
+        # legacy bundles predate the schedule_goodput rename
+        goodput = rec.get("schedule_goodput") or rec.get("goodput") or {}
         if goodput.get("bubble_fraction") is not None:
             events.append(counter_event(
                 0, 0, base, "bubble_fraction",

@@ -419,7 +419,8 @@ class TelemetrySession:
         self._dispatch_base = time.perf_counter()
 
     def end_step(self, global_step: int, samples_per_step: int, pending=None,
-                 numerics=None, goodput=None, serving=None):
+                 numerics=None, goodput=None, serving=None,
+                 schedule_goodput=None, run_goodput=None):
         """Close one optimizer step's metrics. The ONLY blocking operation is a
         device_get of ``pending``'s last loss scalar (already computed; the
         engine fetches it for its monitor anyway) — the step boundary rides that
@@ -431,14 +432,24 @@ class TelemetrySession:
         in the same device_get, so enabling the numerics sentinel adds no host
         sync point. Returns the host-side numerics stats (or None).
 
-        ``goodput`` (optional) is the pipeline tracer's per-step decomposition
-        (utils/pipeline_trace.goodput_decomposition) — already computed from
-        host timestamps, so emitting it here adds scalars only.
+        ``schedule_goodput`` (optional) is the pipeline tracer's per-step
+        schedule decomposition (utils/pipeline_trace.goodput_decomposition) —
+        already computed from host timestamps, so emitting it here adds
+        ``Pipeline/Goodput/*`` scalars only. ``goodput`` is its deprecated
+        alias (one release; the bare name collided with the run-level ledger).
+
+        ``run_goodput`` (optional) is the run-lifecycle ledger's scalar dict
+        (utils/goodput.RunLedger.scalar_items) — emitted verbatim as
+        ``Run/Goodput/*`` scalars. The two fractions measure different
+        things: Pipeline/Goodput is schedule efficiency within one step,
+        Run/Goodput is productive wall over the whole run (docs/goodput.md).
 
         ``serving`` (optional) is the serving request tracer's flat latency
         summary (serve/request_trace.RequestTracer.latency_summary — e.g.
         ``ttft_ms_p99``); emitted as ``Serving/Latency/*`` scalars, again
         host-computed so scalars only."""
+        if schedule_goodput is None:
+            schedule_goodput = goodput
         # dispatch boundary: set by mark_step_dispatched (engine, pre-fetch);
         # a caller that never marks gets "now", i.e. dispatch wall == step wall
         fetch_start = self._dispatch_mark
@@ -532,15 +543,19 @@ class TelemetrySession:
             mon.add_scalar("Anatomy/predicted_floor_ms",
                            rf["predicted_floor_s"] * 1000.0, samples)
             mon.add_scalar("Anatomy/mfu_ceiling", rf["mfu_ceiling"], samples)
-        if goodput:
+        if schedule_goodput:
             for key in ("fwd_seconds", "bwd_seconds", "p2p_seconds", "load_seconds",
                         "reduce_seconds", "opt_seconds", "bubble_seconds",
                         "pipeline_seconds"):
-                if key in goodput:
-                    mon.add_scalar(f"Pipeline/Goodput/{key}", goodput[key], samples)
-            if goodput.get("bubble_fraction") is not None:
+                if key in schedule_goodput:
+                    mon.add_scalar(f"Pipeline/Goodput/{key}",
+                                   schedule_goodput[key], samples)
+            if schedule_goodput.get("bubble_fraction") is not None:
                 mon.add_scalar("Pipeline/Goodput/bubble_fraction",
-                               goodput["bubble_fraction"], samples)
+                               schedule_goodput["bubble_fraction"], samples)
+        if run_goodput:
+            for key in sorted(run_goodput):   # sorted: deterministic order
+                mon.add_scalar(key, run_goodput[key], samples)
         if serving:
             for key in sorted(serving):   # sorted: deterministic scalar order
                 mon.add_scalar(f"Serving/Latency/{key}", serving[key], samples)

@@ -85,6 +85,16 @@ timeout -k 10 600 "$REPO/bin/ds-tpu" crash-sim --json /tmp/_crash_sim.json \
 && cmp "$REPO/tests/unit/golden/crash_sim_transcript.json" \
        /tmp/_crash_sim.json
 crash_rc=$?
+# goodput attribution: fault-injected stalls with known ground-truth
+# durations (checkpoint fence, kill/restore replay, watchdog hang, rank
+# sleep) — the run-lifecycle ledger must bill each to the correct badput
+# class within tolerance, and the boolean transcript is byte-compared
+# against the committed golden so any attribution drift fails CI
+timeout -k 10 300 "$REPO/bin/ds-tpu" crash-sim --goodput \
+    --json /tmp/_goodput_attr.json \
+&& cmp "$REPO/tests/unit/golden/goodput_attribution.json" \
+       /tmp/_goodput_attr.json
+goodput_rc=$?
 # hang-sim: deterministic two-host hang/watchdog rehearsal — host 1 stalls in
 # a grad-bucket scope, host 0 can only dump via the peer marker; transcript is
 # byte-compared against the committed golden, and the merged two-host Perfetto
@@ -105,4 +115,5 @@ hang_rc=$?
 [ "$shard_rc" -ne 0 ] && exit "$shard_rc"
 [ "$anatomy_rc" -ne 0 ] && exit "$anatomy_rc"
 [ "$crash_rc" -ne 0 ] && exit "$crash_rc"
+[ "$goodput_rc" -ne 0 ] && exit "$goodput_rc"
 exit "$hang_rc"

@@ -126,6 +126,23 @@ SWEEP = {
          ("attr", "telemetry_cluster_signal_peers", False)),
         ({"enabled": True, "cluster": {"enabled": True, "warmup_steps": 3}},
          ("attr", "telemetry_cluster_warmup_steps", 3)),
+        ({"enabled": True, "goodput": {"enabled": True}},
+         ("attr", "telemetry_goodput_enabled", True)),
+        ({"enabled": True, "goodput": {"enabled": True, "ledger_dir": "/tmp/gp"}},
+         ("attr", "telemetry_goodput_ledger_dir", "/tmp/gp")),
+        ({"enabled": True, "goodput": {"enabled": True, "emit_scalars": False}},
+         ("attr", "telemetry_goodput_emit_scalars", False)),
+        ({"enabled": True, "goodput": {"enabled": True, "eval_tag": "validation"}},
+         ("attr", "telemetry_goodput_eval_tag", "validation")),
+        # the ledger closes its step intervals on the telemetry end_step
+        # record — no telemetry, no goodput
+        ({"goodput": {"enabled": True}}, ("raise", ValueError)),
+        ({"enabled": True, "goodput": {"enabled": True, "eval_tag": ""}},
+         ("raise", ValueError)),
+        ({"enabled": True, "goodput": {"enabled": True, "emit_scalars": 1}},
+         ("raise", ValueError)),
+        ({"enabled": True, "goodput": {"enabled": True, "ledger_dir": 5}},
+         ("raise", ValueError)),
         # the heartbeat rides the telemetry end_step record — no telemetry, no cluster
         ({"cluster": {"enabled": True}}, ("raise", ValueError)),
         ({"enabled": True, "cluster": {"enabled": True, "heartbeat_interval": 0}},
@@ -286,6 +303,14 @@ def test_unknown_anatomy_key_warns(capture):
     assert "chip" in capture.text    # the known-keys hint points at the fix
 
 
+def test_unknown_goodput_key_warns(capture):
+    _cfg(telemetry={"enabled": True,
+                    "goodput": {"enabled": True, "ledger_dirr": "/tmp/gp"}})
+    assert "unknown telemetry.goodput config key" in capture.text
+    assert "ledger_dirr" in capture.text
+    assert "ledger_dir" in capture.text  # the known-keys hint points at the fix
+
+
 def test_unknown_cluster_key_warns(capture):
     _cfg(telemetry={"enabled": True,
                     "cluster": {"enabled": True, "hang_deadline": 60}})
@@ -352,6 +377,8 @@ def test_known_nested_keys_do_not_warn(capture):
                     "pipeline_trace": {"enabled": True, "capacity": 7},
                     "anatomy": {"enabled": True, "chip": "tpu-v4",
                                 "dcn_gbps": 25.0},
+                    "goodput": {"enabled": True, "ledger_dir": "/tmp/gp",
+                                "emit_scalars": True, "eval_tag": "eval"},
                     "cluster": {"enabled": True, "heartbeat_interval": 2,
                                 "hang_deadline_s": 120.0, "dump_dir": "/tmp/cl",
                                 "straggler_threshold": 3.0,

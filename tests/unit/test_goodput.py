@@ -1,0 +1,373 @@
+"""Run-lifecycle goodput observatory tests (docs/goodput.md).
+
+The load-bearing property is the exact-partition invariant: for ANY event
+stream, the badput class seconds sum to the run wall-clock with no interval
+double-counted. The ledger takes an injectable clock precisely so that
+invariant can be property-tested over seeded random streams here, away from
+real time. The rest covers the billing rules (hang > replay > productive,
+clamped carve-outs), persistence + fleet merge, dump-alone replay pricing,
+the CLI render/diff exit-code contract, and the guarantee every observatory
+in this repo ships with: the compiled step program is HLO-instruction-
+identical with ``telemetry.goodput`` enabled. Ground-truth attribution under
+injected faults lives in ``ds-tpu crash-sim --goodput`` (golden-pinned by
+scripts/lint.sh); these tests stay fast and clock-free where possible.
+"""
+
+import json
+import os
+import random
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.utils.goodput import (
+    BADPUT_CLASSES, RunLedger, diff_goodput, estimate_replay_seconds,
+    fleet_goodput, goodput_main, scan_ledger_dir)
+from deepspeed_tpu.utils.hlo import (collective_counts, instruction_count,
+                                     optimized_hlo)
+from simple_model import SimpleModel, random_dataset, simple_config
+
+HIDDEN = 16
+
+
+def _fake_ledger(**kw):
+    """Ledger on an injected clock: advance with cell[0] += dt."""
+    cell = [100.0]
+    led = RunLedger(clock=lambda: cell[0], wall=lambda: 1000.0, **kw)
+    return led, cell
+
+
+# ------------------------------------------------------- partition invariant
+
+
+def _check_partition(led, cell):
+    wall = cell[0] - led.t0
+    acct = led.accounted_seconds()
+    assert acct == pytest.approx(wall, abs=1e-9)
+    # intervals tile [0, wall] with no gap, no overlap, no zero-length span
+    if led.intervals_dropped == 0 and led.intervals:
+        assert led.intervals[0][0] == pytest.approx(0.0, abs=1e-9)
+        assert led.intervals[-1][1] == pytest.approx(wall, abs=1e-9)
+        for (a0, a1, _), (b0, b1, _) in zip(led.intervals, led.intervals[1:]):
+            assert a1 > a0 and b1 > b0
+            assert b0 == pytest.approx(a1, abs=1e-9)
+        per_cls = {c: 0.0 for c in BADPUT_CLASSES}
+        for t0, t1, cls in led.intervals:
+            per_cls[cls] += t1 - t0
+        for cls in BADPUT_CLASSES:
+            assert per_cls[cls] == pytest.approx(
+                led.class_seconds[cls], abs=1e-9), cls
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_partition_invariant_over_random_event_streams(seed):
+    """The headline invariant, property-tested: random spans, random clamped
+    and over-large carve-outs, hang/replay/eval events in random order —
+    class seconds always sum to wall exactly and the interval list tiles the
+    run."""
+    rng = random.Random(seed)
+    led, cell = _fake_ledger()
+    cell[0] += rng.uniform(0.0, 3.0)
+    led.close("init", {"compile": rng.uniform(0.0, 5.0)})  # may exceed span
+    led.set_replay_until(rng.randint(-1, 3))
+    step = 0
+    for _ in range(rng.randint(1, 40)):
+        ev = rng.random()
+        cell[0] += rng.uniform(0.0, 1.0)    # zero-length spans must be fine
+        if ev < 0.7:
+            step += 1
+            carve = {}
+            if rng.random() < 0.5:
+                carve["checkpoint_stall"] = rng.uniform(0.0, 2.0)
+            if rng.random() < 0.3:
+                carve["compile"] = rng.uniform(0.0, 2.0)
+            if rng.random() < 0.2:
+                carve["straggler_skew"] = rng.uniform(0.0, 2.0)
+            led.close_step(step, carve or None, hang=rng.random() < 0.1)
+        elif ev < 0.85:
+            led.close("host_gap")
+            cell[0] += rng.uniform(0.0, 0.5)
+            led.close_eval()
+        else:
+            led.close("host_gap")
+        _check_partition(led, cell)
+    cell[0] += rng.uniform(0.0, 1.0)
+    summary = led.finalize(persist=False)
+    _check_partition(led, cell)
+    assert summary["wall_s"] == pytest.approx(cell[0] - led.t0, abs=1e-9)
+    # finalize is idempotent: a second call closes nothing new
+    assert led.finalize(persist=False)["wall_s"] == summary["wall_s"]
+
+
+def test_carve_clamped_to_span():
+    """A carve-out larger than the span consumes the whole span and never
+    goes negative — the clamp is what makes the partition unbreakable by a
+    bad (or adversarial) carve estimate."""
+    led, cell = _fake_ledger()
+    cell[0] += 1.0
+    led.close("productive_step", {"checkpoint_stall": 10.0})
+    assert led.class_seconds["checkpoint_stall"] == pytest.approx(1.0)
+    assert led.class_seconds["productive_step"] == 0.0
+    assert led.accounted_seconds() == pytest.approx(1.0)
+
+
+def test_unknown_class_rejected():
+    led, cell = _fake_ledger()
+    cell[0] += 1.0
+    with pytest.raises(ValueError, match="unknown badput class"):
+        led.close("gpu_gap")
+    with pytest.raises(ValueError, match="unknown badput class"):
+        led.close("init", {"nonsense": 1.0})
+
+
+def test_close_step_billing_priority():
+    """hang > restart_replay > productive: a stalled step produced nothing,
+    so the hang rule wins even during replay."""
+    led, cell = _fake_ledger()
+    led.set_replay_until(2)
+    for step, hang, expect in ((1, False, "restart_replay"),
+                               (2, True, "hang"),
+                               (3, False, "productive_step")):
+        before = dict(led.class_seconds)
+        cell[0] += 1.0
+        led.close_step(step, hang=hang)
+        assert led.class_seconds[expect] - before[expect] == pytest.approx(1.0)
+    assert (led.steps, led.replay_steps, led.hang_steps) == (3, 1, 1)
+
+
+def test_scalar_items_surface_eval_under_configured_tag():
+    led, cell = _fake_ledger(eval_tag="validation")
+    cell[0] += 2.0
+    led.close("eval")
+    items = dict(led.scalar_items())
+    assert items["Run/Goodput/validation_seconds"] == pytest.approx(2.0)
+    assert "Run/Goodput/eval_seconds" not in items
+    assert items["Run/Goodput/goodput_fraction"] == 0.0
+    assert items["Run/Goodput/wall_seconds"] == pytest.approx(2.0)
+
+
+# ------------------------------------------------- persistence + fleet merge
+
+
+def _persisted_pair(tmp_path):
+    """Two-host run: host 0 all productive, host 1 half hung."""
+    paths = []
+    for host, hang in ((0, False), (1, True)):
+        led, cell = _fake_ledger(run_id="r1", host=host,
+                                 ledger_dir=str(tmp_path))
+        cell[0] += 1.0
+        led.close_step(1)
+        cell[0] += 1.0
+        led.close_step(2, hang=hang)
+        led.finalize()
+        paths.append(led.ledger_path())
+    return paths
+
+
+def test_persist_scan_fleet_roundtrip(tmp_path):
+    paths = _persisted_pair(tmp_path)
+    assert [os.path.basename(p) for p in paths] == [
+        "goodput_r1_host0.json", "goodput_r1_host1.json"]
+    runs = scan_ledger_dir(str(tmp_path))
+    assert set(runs) == {"r1"} and set(runs["r1"]) == {0, 1}
+    fleet = fleet_goodput(runs["r1"])
+    assert fleet["kind"] == "goodput_fleet"
+    assert fleet["n_hosts"] == 2 and fleet["hosts"] == [0, 1]
+    # host-seconds: 4 s total, 3 s productive, 1 s hang
+    assert fleet["wall_s"] == pytest.approx(4.0)
+    assert fleet["class_seconds"]["hang"] == pytest.approx(1.0)
+    assert fleet["goodput_fraction"] == pytest.approx(0.75)
+    assert fleet["steps"] == 4 and fleet["hang_steps"] == 1
+    # the single bad host stays attributable in the per-host breakdown
+    assert fleet["per_host"]["0"]["goodput_fraction"] == pytest.approx(1.0)
+    assert fleet["per_host"]["1"]["goodput_fraction"] == pytest.approx(0.5)
+
+
+def test_persist_is_deterministic_bytes(tmp_path):
+    led, cell = _fake_ledger(run_id="det", ledger_dir=str(tmp_path))
+    cell[0] += 1.0
+    led.close_step(1)
+    led.finalize()
+    first = open(led.ledger_path(), "rb").read()
+    led.persist()
+    assert open(led.ledger_path(), "rb").read() == first
+
+
+# ------------------------------------------------- dump-alone replay pricing
+
+
+def _dump_bundle(gaps, first_step=1, first_bad=None):
+    mono, step, steps = 50.0, first_step, []
+    steps.append({"step": step, "mono": mono})
+    for g in gaps:
+        mono += g
+        step += 1
+        steps.append({"step": step, "mono": mono})
+    out = {"span": {"mono_start": 50.0, "mono_end": mono,
+                    "first_step": first_step, "last_step": step,
+                    "steps_spanned": step - first_step},
+           "steps": steps}
+    if first_bad is not None:
+        out["first_bad_step"] = first_bad
+    return out
+
+
+def test_estimate_replay_prices_from_median_gap():
+    """One warmup-inflated interval must not skew the per-step price — the
+    estimator uses the median inter-record gap, not the span mean."""
+    bundle = _dump_bundle([0.8, 0.4, 0.4, 0.4])   # steps 1..5, one outlier
+    n, sec = estimate_replay_seconds(bundle, 3)
+    assert n == 2
+    assert sec == pytest.approx(0.8)              # 2 * median(0.4)
+    # span-mean fallback when records carry no stamps
+    bare = {"span": bundle["span"], "steps": [{"step": 1}]}
+    n, sec = estimate_replay_seconds(bare, 3)
+    assert n == 2 and sec == pytest.approx(2 * 2.0 / 4)
+
+
+def test_estimate_replay_stops_at_first_bad_step():
+    bundle = _dump_bundle([0.4, 0.4, 0.4, 0.4], first_bad=4)
+    n, _ = estimate_replay_seconds(bundle, 2)
+    assert n == 2                                  # steps 3..4, not ..5
+    assert estimate_replay_seconds(bundle, 9)[0] == 0
+
+
+def test_estimate_replay_legacy_dump_is_zero():
+    assert estimate_replay_seconds({"steps": [{"step": 1}]}, 0) == (0, 0.0)
+    assert estimate_replay_seconds(None, 0) == (0, 0.0)
+
+
+# ------------------------------------------------------------ CLI + diff
+
+
+def test_diff_names_the_regressing_class():
+    led_a, cell_a = _fake_ledger(run_id="a")
+    cell_a[0] += 4.0
+    led_a.close_step(1)
+    a = led_a.finalize(persist=False)
+    led_b, cell_b = _fake_ledger(run_id="b")
+    cell_b[0] += 3.0
+    led_b.close_step(1, {"checkpoint_stall": 1.0})
+    b = led_b.finalize(persist=False)
+    diff = diff_goodput(a, b, tolerance=0.0)
+    assert diff["regressed"] is True
+    assert diff["regressing_class"] == "checkpoint_stall"
+    assert diff["fraction_delta"] == pytest.approx(2.0 / 3.0 - 1.0)
+    # tolerance wide enough -> same delta, no regression verdict
+    assert diff_goodput(a, b, tolerance=0.5)["regressed"] is False
+    # no-change diff: nothing regresses, no class named
+    clean = diff_goodput(a, a)
+    assert clean["regressed"] is False and clean["regressing_class"] is None
+
+
+def test_goodput_cli_render_diff_and_exit_codes(tmp_path, capsys):
+    _persisted_pair(tmp_path)                     # run r1: fraction 0.75
+    good = str(tmp_path)
+    led, cell = _fake_ledger(run_id="r2", host=0,
+                             ledger_dir=str(tmp_path / "worse"))
+    cell[0] += 1.0
+    led.close_step(1)
+    cell[0] += 3.0
+    led.close_step(2, hang=True)                  # run r2: fraction 0.25
+    led.finalize()
+    worse = str(tmp_path / "worse")
+    # render: directory fleet-merges; exit 0
+    assert goodput_main([good]) == 0
+    out = capsys.readouterr().out
+    assert "hosts=2" in out and "goodput_fraction   0.7500" in out
+    # single ledger file renders too, and --timeline exports its intervals
+    assert goodput_main([led.ledger_path(),
+                         "--timeline", str(tmp_path / "t.trace.json")]) == 0
+    trace = json.load(open(tmp_path / "t.trace.json"))
+    assert any(e.get("name") == "hang" for e in trace["traceEvents"])
+    # diff: regression beyond tolerance exits 1 and names the class
+    rc = goodput_main(["--diff", good, worse,
+                       "--json", str(tmp_path / "d.json")])
+    assert rc == 1
+    diff = json.load(open(tmp_path / "d.json"))
+    assert diff["regressed"] is True and diff["regressing_class"] == "hang"
+    assert "REGRESSED" in capsys.readouterr().out
+    # same diff inside tolerance exits 0
+    assert goodput_main(["--diff", good, worse, "--tolerance", "0.9"]) == 0
+    # bad operands exit 2 (missing ledger, fleet --timeline)
+    assert goodput_main([str(tmp_path / "empty")]) == 2
+    assert goodput_main([good, "--timeline",
+                         str(tmp_path / "t2.trace.json")]) == 2
+
+
+def test_goodput_cli_multi_run_dir_needs_run_key(tmp_path, capsys):
+    _persisted_pair(tmp_path)
+    led, cell = _fake_ledger(run_id="r9", host=0, ledger_dir=str(tmp_path))
+    cell[0] += 1.0
+    led.close_step(1)
+    led.finalize()
+    assert goodput_main([str(tmp_path)]) == 2     # ambiguous without --run
+    assert "--run" in capsys.readouterr().out
+    assert goodput_main([str(tmp_path), "--run", "r9"]) == 0
+    assert "host=0" in capsys.readouterr().out
+
+
+# ------------------------------------------------------------ engine wiring
+
+
+def _build(**overrides):
+    model = SimpleModel(HIDDEN)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+        config_params=simple_config(**overrides))
+    return eng
+
+
+def _batch(n=8, seed=0):
+    data = random_dataset(n, HIDDEN, seed=seed)
+    return (np.stack([d[0] for d in data]), np.stack([d[1] for d in data]))
+
+
+def test_engine_ledger_partitions_real_steps(tmp_path):
+    """End-to-end on a live engine: the ledger opens at construction, bills
+    init + compile before the first step, closes every train step, persists
+    beside the configured ledger_dir, and the Run/Goodput/* scalars ride
+    end_step into the telemetry stream."""
+    eng = _build(telemetry={
+        "enabled": True, "output_path": str(tmp_path), "job_name": "gp",
+        "goodput": {"enabled": True, "ledger_dir": str(tmp_path / "led")}})
+    assert eng._goodput is not None
+    xs, ys = _batch()
+    for _ in range(3):
+        loss = eng(xs, ys)
+        eng.backward(loss)
+        eng.step()
+    led = eng._goodput
+    assert led.steps == 3
+    assert led.class_seconds["init"] > 0.0
+    assert led.class_seconds["productive_step"] > 0.0
+    assert abs(led.accounted_seconds() - led.wall_seconds()) < 0.05
+    summary = led.finalize()
+    assert summary["goodput_fraction"] > 0.0
+    data = json.load(open(led.ledger_path()))
+    assert data["kind"] == "goodput" and data["steps"] == 3
+    eng.telemetry.close()
+    scal = open(os.path.join(str(tmp_path), "gp", "scalars.jsonl")).read()
+    assert "Run/Goodput/goodput_fraction" in scal
+    assert "Run/Goodput/init_seconds" in scal
+
+
+def test_goodput_enabled_is_hlo_identical(tmp_path):
+    """The observatory guarantee: enabling telemetry.goodput changes NOTHING
+    in the compiled step program — the ledger is host-side arithmetic over
+    timestamps other layers already took."""
+    eng_off = _build(telemetry={"enabled": True,
+                                "output_path": str(tmp_path / "off")})
+    eng_on = _build(telemetry={
+        "enabled": True, "output_path": str(tmp_path / "on"),
+        "goodput": {"enabled": True, "ledger_dir": str(tmp_path / "led")}})
+    xs, ys = _batch()
+    hlos = []
+    for eng in (eng_off, eng_on):
+        hlos.append(optimized_hlo(eng._jit_loss_and_grad, eng.params,
+                                  eng.scaler_state.cur_scale, xs, ys))
+    assert instruction_count(hlos[0]) > 0
+    assert instruction_count(hlos[0]) == instruction_count(hlos[1])
+    assert collective_counts(hlos[0]) == collective_counts(hlos[1])

@@ -106,10 +106,13 @@ def test_goodput_scalars_flow_through_telemetry(tmp_path):
     for name in ("Pipeline/Goodput/bubble_fraction", "Pipeline/Goodput/fwd_seconds",
                  "Pipeline/Goodput/bwd_seconds", "Pipeline/Goodput/opt_seconds"):
         assert name in scalars, name
-    g = eng.pipe_trace.last_goodput
+    g = eng.pipe_trace.last_schedule_goodput
     assert g["fwd_seconds"] > 0 and g["bwd_seconds"] > 0
     assert 0.0 <= g["bubble_fraction"] < 1.0
     assert len(g["per_stage_busy_seconds"]) == eng.num_stages
+    # deprecated alias, kept one release (the bare name now means the
+    # run-level goodput ledger — docs/goodput.md)
+    assert eng.pipe_trace.last_goodput is g
 
 
 def _padded(fn, seconds):
@@ -164,7 +167,7 @@ def test_injected_delay_names_the_straggler():
         eng._stage_fwd[2] = slow
     straggler = eng.pipe_trace.divergence(threshold=3.0)
     assert straggler is not None and straggler["stage"] == 2, straggler
-    assert eng.pipe_trace.last_goodput["straggler"]["stage"] == 2
+    assert eng.pipe_trace.last_schedule_goodput["straggler"]["stage"] == 2
 
 
 # --------------------------------------------------------------- HLO identity
