@@ -89,6 +89,13 @@ REGRESSION_KEYS = (
     "extra.serving_420m_prefix_cache.prefix_cache_hit_rate",
     "extra.serving_420m_prefix_cache.ttft_ms_p50",
     "extra.serving_420m_sharded.tok_s",
+    # speculative decoding (docs/serving.md): how often the draft is right,
+    # and how many target program executions each emitted token costs —
+    # target_steps_per_token is lower-is-better (PERF.md defines the metric)
+    "extra.serving_speculative.spec_acceptance_rate",
+    "extra.serving_speculative.target_steps_per_token",
+    "extra.serving_1p5b_spec.spec_acceptance_rate",
+    "extra.serving_1p5b_spec.target_steps_per_token",
     # resilience ledger: caller-thread checkpoint stall and the warm/cold
     # restart TTFT ratio (docs/resilience.md) — both lower-is-better
     "extra.resilience.checkpoint_stall_ms",
@@ -107,6 +114,8 @@ LOWER_IS_BETTER_KEYS = frozenset(
         "extra.resilience.checkpoint_stall_ms",
         "extra.resilience.restore_warm_vs_cold_ttft",
         "extra.goodput.badput_checkpoint_pct",
+        "extra.serving_speculative.target_steps_per_token",
+        "extra.serving_1p5b_spec.target_steps_per_token",
     })
 
 
@@ -608,7 +617,8 @@ def bench_decode_420m():
 def bench_serving_summary(cfg_kwargs, *, n_requests, num_slots, block_size,
                           num_blocks, max_model_len, prefill_chunk,
                           param_dtype=None, seed=11, prefix_cache=False,
-                          sharding=1, shared_prefix=0):
+                          sharding=1, shared_prefix=0, speculate=0,
+                          draft_cfg_kwargs=None):
     """Continuous-batching serving summary (docs/serving.md): replay a seeded
     mixed greedy/beam trace through the InferenceEngine and report tok/s,
     TTFT/TPOT latency percentiles (request-trace ledger), preemption-waste
@@ -630,17 +640,33 @@ def bench_serving_summary(cfg_kwargs, *, n_requests, num_slots, block_size,
     if param_dtype is not None:
         params = jax.tree_util.tree_map(
             lambda p: p.astype(param_dtype) if p.ndim >= 2 else p, params)
+    # speculation: self-draft (same model+params, acceptance ~1) unless a
+    # separate draft config is given — then the real small-drafts-big shape
+    draft_model = draft_params = None
+    if speculate:
+        if draft_cfg_kwargs is None:
+            draft_model, draft_params = model, params
+        else:
+            draft_model = GPT2Model(GPT2Config(**draft_cfg_kwargs))
+            draft_params = draft_model.init(jax.random.PRNGKey(1))
+            if param_dtype is not None:
+                draft_params = jax.tree_util.tree_map(
+                    lambda p: p.astype(param_dtype) if p.ndim >= 2 else p,
+                    draft_params)
     # disabled monitor: the watchdog is wanted, the scalar files are not
     session = TelemetrySession(monitor=SummaryMonitor(enabled=False))
     import deepspeed_tpu
     eng = deepspeed_tpu.init_inference(
         model=model, model_parameters=params, telemetry=session,
+        draft_model=draft_model, draft_parameters=draft_params,
         config_params={"serving": {
             "enabled": True, "max_seqs": num_slots, "block_size": block_size,
             "num_blocks": num_blocks, "max_model_len": max_model_len,
             "prefill_chunk": prefill_chunk,
             "prefix_cache": {"enabled": prefix_cache},
             "sharding": {"model": sharding},
+            "speculation": {"enabled": bool(speculate),
+                            "max_draft_tokens": max(int(speculate), 1)},
             "request_trace": {"enabled": True,
                               "capacity": max(n_requests + 1, 256)}}})
     reqs = synth_trace(n_requests, vocab_size=cfg.vocab_size,
@@ -655,6 +681,16 @@ def bench_serving_summary(cfg_kwargs, *, n_requests, num_slots, block_size,
     recompiles = sum(session.watchdog.recompiles(n)
                      for n in session.watchdog.records
                      if n.startswith("serve:"))
+    spec_extra = {}
+    if speculate:
+        ss = eng.spec_summary()
+        spec_extra = {
+            "spec_k": int(speculate),
+            "spec_acceptance_rate": round(ss["spec_acceptance_rate"], 4),
+            "target_steps_per_token": round(ss["target_steps_per_token"], 4),
+            "drafted_tokens": ss["drafted_tokens"],
+            "accepted_draft_tokens": ss["accepted_tokens"],
+            "wasted_draft_tokens": ss["wasted_draft_tokens"]}
     cache_extra = {}
     if eng.prefix_cache is not None:
         cs = eng.prefix_cache.stats()
@@ -666,7 +702,7 @@ def bench_serving_summary(cfg_kwargs, *, n_requests, num_slots, block_size,
     return {"requests": len(reqs), "finished": len(fin),
             "iterations": len(logs), "wall_s": round(wall, 2),
             **({"sharding_model_ways": sharding} if sharding > 1 else {}),
-            **cache_extra,
+            **cache_extra, **spec_extra,
             # tok_s counts every sampled token (all beam lanes, preempted
             # work included); goodput only tokens of finished requests
             "tok_s": round(eng._tokens_sampled / wall, 1),
@@ -713,6 +749,17 @@ def bench_serving_sharded_smoke():
              loss_chunk=0),
         n_requests=16, num_slots=4, block_size=8, num_blocks=33,
         max_model_len=64, prefill_chunk=16, sharding=2)
+
+
+def bench_serving_speculative_smoke():
+    """Speculative-decoding smoke: the shared-prefix trace with self-draft
+    K=4 speculation — acceptance rate (~1 by construction for self-draft) and
+    target-steps-per-token for the regression ledger (PERF.md)."""
+    return bench_serving_summary(
+        dict(vocab_size=256, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+             loss_chunk=0),
+        n_requests=16, num_slots=4, block_size=8, num_blocks=33,
+        max_model_len=64, prefill_chunk=16, shared_prefix=24, speculate=4)
 
 
 def bench_resilience_smoke():
@@ -873,6 +920,24 @@ def bench_serving_420m_sharded():
         n_requests=32, num_slots=8, block_size=16, num_blocks=513,
         max_model_len=1024, prefill_chunk=128, param_dtype=jnp.bfloat16,
         sharding=2)
+    gc.collect()
+    return out
+
+
+def bench_serving_1p5b_spec():
+    """GPT-2 420M drafts for a 1.5B target (both bf16) — the real-deployment
+    shape of speculative decoding. Acceptance rate prices how often the small
+    model predicts the big one's greedy choice; target_steps_per_token is what
+    the K+1-wide verify amortization actually buys at size."""
+    import jax.numpy as jnp
+    out = bench_serving_summary(
+        dict(vocab_size=50304, n_positions=1024, n_embd=1600, n_layer=48,
+             n_head=25, use_flash_attention=True),
+        n_requests=32, num_slots=8, block_size=16, num_blocks=513,
+        max_model_len=1024, prefill_chunk=128, param_dtype=jnp.bfloat16,
+        shared_prefix=256, speculate=4,
+        draft_cfg_kwargs=dict(vocab_size=50304, n_positions=1024, n_embd=1024,
+                              n_layer=24, n_head=16, use_flash_attention=True))
     gc.collect()
     return out
 
@@ -1232,6 +1297,10 @@ def main():
         except Exception as e:
             serving_sharded = {"error": f"{type(e).__name__}: {e}"}
         try:
+            serving_spec = bench_serving_speculative_smoke()
+        except Exception as e:
+            serving_spec = {"error": f"{type(e).__name__}: {e}"}
+        try:
             resilience = bench_resilience_smoke()
         except Exception as e:
             resilience = {"error": f"{type(e).__name__}: {e}"}
@@ -1253,6 +1322,7 @@ def main():
                             "serving": serving,
                             "serving_prefix_cache": serving_prefix,
                             "serving_sharded": serving_sharded,
+                            "serving_speculative": serving_spec,
                             "resilience": resilience,
                             "goodput": goodput}}
         result["extra"]["regression_vs_previous_round"] = \
@@ -1312,6 +1382,10 @@ def main():
         extra["serving_420m_sharded"] = bench_serving_420m_sharded()
     except Exception as e:
         extra["serving_420m_sharded"] = {"error": f"{type(e).__name__}: {e}"}
+    try:  # 420M-drafts-1.5B speculative serving (docs/serving.md)
+        extra["serving_1p5b_spec"] = bench_serving_1p5b_spec()
+    except Exception as e:
+        extra["serving_1p5b_spec"] = {"error": f"{type(e).__name__}: {e}"}
     try:  # run-lifecycle goodput fraction + checkpoint badput share
         extra["goodput"] = bench_goodput_smoke()
     except Exception as e:

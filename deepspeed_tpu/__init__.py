@@ -54,7 +54,9 @@ def init_inference(model=None,
                    model_parameters=None,
                    config_params=None,
                    telemetry=None,
-                   mirror=False):
+                   mirror=False,
+                   draft_model=None,
+                   draft_parameters=None):
     """Initialize the TPU serving engine (``deepspeed.init_inference``-shaped).
 
     ``model`` is a ``models.gpt2.GPT2Model`` (dense), ``model_parameters`` its
@@ -64,7 +66,10 @@ def init_inference(model=None,
     ``submit()`` requests, drive ``step()`` (or ``run()``) to completion.
     ``telemetry`` is an optional ``utils.telemetry.TelemetrySession`` (compile
     watchdog + Serving/* scalars); ``mirror=True`` runs the dense-cache oracle
-    in bitwise lockstep (tests/serve-sim only — it doubles the work)."""
+    in bitwise lockstep (tests/serve-sim only — it doubles the work).
+    ``serving.speculation.enabled`` additionally needs the live draft here:
+    ``draft_model`` / ``draft_parameters`` (a config file cannot carry a
+    parameter tree; the config's ``draft_model`` string is a report label)."""
     from .serve.engine import InferenceEngine
 
     config_params = config_params if config_params is not None else {}
@@ -95,7 +100,17 @@ def init_inference(model=None,
             "dump_dir": ds_config.serving_request_trace_dump_dir,
             "slo": {"ttft_ms": ds_config.serving_slo_ttft_ms,
                     "tpot_ms": ds_config.serving_slo_tpot_ms},
-        })
+        },
+        speculation={
+            "enabled": True,
+            "draft_model": draft_model,
+            "draft_params": draft_parameters,
+            "label": ds_config.serving_speculation_draft_model,
+            "max_draft_tokens":
+                ds_config.serving_speculation_max_draft_tokens,
+            "draft_pool_blocks":
+                ds_config.serving_speculation_draft_pool_blocks,
+        } if ds_config.serving_speculation_enabled else None)
 
 
 def _add_core_arguments(parser):

@@ -262,6 +262,32 @@ def _build_serving():
     return eng, None
 
 
+def _build_serving_speculative():
+    # speculative decoding: the target-side spec_verify program (K+1-wide
+    # chunked-prefill-shaped verification over the paged pool) plus the
+    # draft-side decode/prefill programs over the draft's own small pool.
+    # Self-draft (same model+params) keeps the builder cheap; the programs
+    # are shape-identical to a real small-draft deployment. Only the spec
+    # programs are captured here — the engine's base decode/prefill/copy
+    # programs are geometry-identical to the ``serving`` entry's and already
+    # linted there; re-lowering them would double the entry's cost for zero
+    # extra coverage
+    from ..serve.engine import InferenceEngine
+    model, params = _tiny_gpt2()
+    eng = InferenceEngine(model, params, num_slots=4, block_size=4,
+                          num_blocks=17, max_model_len=32, prefill_chunk=8,
+                          speculation={"enabled": True, "draft_model": model,
+                                       "draft_params": params,
+                                       "max_draft_tokens": 2})
+
+    class _SpecPrograms:
+        def lint_programs(self, sample_batch=None):
+            return [e for e in eng.lint_programs(sample_batch)
+                    if "spec" in e[0]]
+
+    return _SpecPrograms(), None
+
+
 def _build_serving_sharded():
     # model-axis sharded serving: same programs lowered over a 2-way head
     # shard. The manifests tighten to a collective BUDGET — decode/prefill
@@ -288,6 +314,7 @@ BUILDERS = {
     "pipeline": _build_pipeline,
     "gpt2_decode": _build_gpt2_decode,
     "serving": _build_serving,
+    "serving_speculative": _build_serving_speculative,
     "serving_sharded": _build_serving_sharded,
 }
 

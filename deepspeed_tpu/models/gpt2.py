@@ -563,7 +563,16 @@ class GPT2Model:
         at 1.5B batch-8 decode that is an extra 2x [L, B, nh, max_len, hd]
         (~5.7 GB) held through the prompt-forward activation peak, which is
         what pushed the relay-kill repros (tests/perf/decode_crash_repro.py)
-        over the HBM cliff at execution time."""
+        over the HBM cliff at execution time.
+
+        The serving stack applies the same discipline to its paged pools:
+        serve/paged.py donates the target KV pool through decode/prefill/
+        verify, and the speculative DRAFT model's pool rides the identical
+        builds at the draft's shapes (serve/speculative.py) — a second
+        un-donated pool copy per drafting turn would price the draft model
+        right back out of its speedup. The lint registry's
+        ``serving_speculative`` entry pins all of it (check_unusable +
+        min_undonated_bytes on every spec program)."""
         cache = getattr(self, "_gen_jit_cache", None)
         if cache is None:
             cache = self._gen_jit_cache = {}

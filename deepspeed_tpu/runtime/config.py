@@ -482,6 +482,34 @@ class DeepSpeedConfig:
             pc_dict, SERVING_PREFIX_CACHE_ENABLED,
             SERVING_PREFIX_CACHE_ENABLED_DEFAULT)
 
+        sp_dict = sv_dict.get(SERVING_SPECULATION, {}) or {}
+        self._warn_unknown_nested(f"{SERVING}.{SERVING_SPECULATION}",
+                                  sp_dict, SERVING_SPECULATION_CONFIG_KEYS)
+        self.serving_speculation_enabled = get_scalar_param(
+            sp_dict, SERVING_SPECULATION_ENABLED,
+            SERVING_SPECULATION_ENABLED_DEFAULT)
+        self.serving_speculation_draft_model = get_scalar_param(
+            sp_dict, SERVING_SPECULATION_DRAFT_MODEL,
+            SERVING_SPECULATION_DRAFT_MODEL_DEFAULT)
+        self.serving_speculation_max_draft_tokens = get_scalar_param(
+            sp_dict, SERVING_SPECULATION_MAX_DRAFT_TOKENS,
+            SERVING_SPECULATION_MAX_DRAFT_TOKENS_DEFAULT)
+        self.serving_speculation_draft_pool_blocks = get_scalar_param(
+            sp_dict, SERVING_SPECULATION_DRAFT_POOL_BLOCKS,
+            SERVING_SPECULATION_DRAFT_POOL_BLOCKS_DEFAULT)
+        val = self.serving_speculation_max_draft_tokens
+        if isinstance(val, bool) or not isinstance(val, int) or val < 1:
+            raise ValueError(
+                "DeepSpeedConfig: serving.speculation.max_draft_tokens must "
+                f"be an int >= 1, got {val!r}")
+        val = self.serving_speculation_draft_pool_blocks
+        if isinstance(val, bool) or not isinstance(val, int) or (
+                val != 0 and val < 2):  # block 0 is the reserved null page
+            raise ValueError(
+                "DeepSpeedConfig: serving.speculation.draft_pool_blocks must "
+                "be 0 (inherit serving.num_blocks) or an int >= 2, "
+                f"got {val!r}")
+
         cm_dict = param_dict.get(COMM, {})
         self._warn_unknown_nested(COMM, cm_dict, COMM_CONFIG_KEYS)
         self.comm_mode = get_scalar_param(cm_dict, COMM_MODE, COMM_MODE_DEFAULT)

@@ -354,6 +354,23 @@ SERVING_SHARDING_MODEL_DEFAULT = 1
 SERVING_PREFIX_CACHE = "prefix_cache"
 SERVING_PREFIX_CACHE_ENABLED = "enabled"
 SERVING_PREFIX_CACHE_ENABLED_DEFAULT = False
+# serving.speculation — greedy speculative decoding (Leviathan et al.): a
+# draft model proposes up to max_draft_tokens per scheduler iteration against
+# its own paged pool; the target verifies all K+1 positions in one batched
+# step and a rejection rolls the block table back for free (CoW refcount
+# release). Token-identical to the target's own greedy decode. draft_model is
+# a human-readable label recorded in reports — the live draft model/params
+# arrive via init_inference(draft_model=, draft_parameters=) because a config
+# file cannot hold a parameter tree. draft_pool_blocks=0 inherits num_blocks.
+SERVING_SPECULATION = "speculation"
+SERVING_SPECULATION_ENABLED = "enabled"
+SERVING_SPECULATION_ENABLED_DEFAULT = False
+SERVING_SPECULATION_DRAFT_MODEL = "draft_model"
+SERVING_SPECULATION_DRAFT_MODEL_DEFAULT = ""
+SERVING_SPECULATION_MAX_DRAFT_TOKENS = "max_draft_tokens"
+SERVING_SPECULATION_MAX_DRAFT_TOKENS_DEFAULT = 4
+SERVING_SPECULATION_DRAFT_POOL_BLOCKS = "draft_pool_blocks"
+SERVING_SPECULATION_DRAFT_POOL_BLOCKS_DEFAULT = 0
 
 #############################################
 # Comm (hierarchical ICI+DCN collectives)
@@ -598,6 +615,7 @@ SERVING_CONFIG_KEYS = frozenset({
     SERVING_REQUEST_TRACE,
     SERVING_SHARDING,
     SERVING_PREFIX_CACHE,
+    SERVING_SPECULATION,
 })
 
 SERVING_SHARDING_CONFIG_KEYS = frozenset({
@@ -606,6 +624,13 @@ SERVING_SHARDING_CONFIG_KEYS = frozenset({
 
 SERVING_PREFIX_CACHE_CONFIG_KEYS = frozenset({
     SERVING_PREFIX_CACHE_ENABLED,
+})
+
+SERVING_SPECULATION_CONFIG_KEYS = frozenset({
+    SERVING_SPECULATION_ENABLED,
+    SERVING_SPECULATION_DRAFT_MODEL,
+    SERVING_SPECULATION_MAX_DRAFT_TOKENS,
+    SERVING_SPECULATION_DRAFT_POOL_BLOCKS,
 })
 
 SERVING_REQUEST_TRACE_CONFIG_KEYS = frozenset({

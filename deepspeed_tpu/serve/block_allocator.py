@@ -19,6 +19,11 @@ Design points (vLLM's PagedAttention memory model):
   by incrementing refcounts; a writer that needs an exclusive page calls
   :meth:`ensure_exclusive`, which returns the ``(src, dst)`` page copy the
   caller must mirror on-device (paged.copy_blocks) when the page was shared.
+  Speculative-decoding rollback (serve/speculative.py) is the same machinery
+  run backwards: a rejected draft tail is undone by truncating the block
+  table and :meth:`free`-ing the tail pages — pure refcount bookkeeping, no
+  device work — and because verify writes went through ``ensure_exclusive``
+  first, the rollback can never touch a page another holder still reads.
 - **Cached tier** (SGLang's RadixAttention eviction model): a page registered
   through :meth:`register_cached` parks in an LRU *cached* tier when its last
   reference drops instead of returning to the free list — its KV bytes stay

@@ -40,8 +40,10 @@ class InferenceEngine:
     def __init__(self, model, params, *, num_slots=8, block_size=16,
                  num_blocks=257, max_model_len=256, prefill_chunk=32,
                  use_pallas=False, telemetry=None, mirror=False,
-                 request_trace=None, prefix_cache=False, sharding=None):
+                 request_trace=None, prefix_cache=False, sharding=None,
+                 speculation=None):
         c = model.config
+        spec_cfg = speculation if (speculation or {}).get("enabled") else None
         if max_model_len % block_size != 0:
             raise ValueError(f"max_model_len {max_model_len} not a multiple "
                              f"of block_size {block_size}")
@@ -78,6 +80,20 @@ class InferenceEngine:
                 "prefill chunks whose KV the dense per-slot oracle no longer "
                 "holds (its cache is overwritten on slot reuse) — prove "
                 "bitwise identity on a cache-off engine instead")
+        if spec_cfg is not None and mirror:
+            raise ValueError(
+                "mirror asserts bitwise identity against the dense oracle; "
+                "the K+1-wide spec_verify program fuses the batch differently "
+                "than the 1-wide decode_step (token-identical, not bitwise — "
+                "the sharded-psum precedent) and commits multiple tokens per "
+                "step the per-step oracle cannot follow — run the mirror on a "
+                "speculation-off engine, and pin speculative token identity "
+                "with `ds-tpu serve-sim --compare-speculate` instead")
+        if spec_cfg is not None and tp > 1:
+            raise ValueError(
+                "speculation + serving.sharding.model > 1 is not supported: "
+                "the spec_verify program is single-chip only (shard the "
+                "target OR speculate, not both)")
         self.tp = tp
         self.model = model
         self.params = params
@@ -116,10 +132,36 @@ class InferenceEngine:
                 topo = CommTopology(tp, 1)
                 self.telemetry.set_comm_topology(
                     topo.slice_device_sets(self._mesh))
+        self._spec = None
+        self._verify = None
+        self.spec_k = 0
+        if spec_cfg is not None:
+            from .speculative import SpeculativeDecoder
+            draft_model = spec_cfg.get("draft_model")
+            draft_params = spec_cfg.get("draft_params")
+            if draft_model is None or draft_params is None:
+                raise ValueError(
+                    "serving.speculation.enabled needs a live draft model: "
+                    "pass draft_model= and draft_parameters= to "
+                    "deepspeed.init_inference (the config's draft_model key "
+                    "is a label, not a loader)")
+            self.spec_k = int(spec_cfg.get("max_draft_tokens", 4))
+            self._spec = SpeculativeDecoder(
+                draft_model, draft_params, num_slots=self.num_slots,
+                block_size=self.block_size, max_blocks=self.max_blocks,
+                prefill_chunk=self.prefill_chunk,
+                draft_pool_blocks=(int(spec_cfg.get("draft_pool_blocks") or 0)
+                                   or self.num_blocks),
+                max_draft_tokens=self.spec_k, target_config=c,
+                watch=self._watch)
         self._raw = build_paged_programs(
             model, num_slots=self.num_slots, block_size=self.block_size,
             max_blocks=self.max_blocks, prefill_chunk=self.prefill_chunk,
-            use_pallas=use_pallas, mesh=self._mesh)
+            use_pallas=use_pallas, mesh=self._mesh,
+            verify_width=self.spec_k + 1 if self._spec is not None else 0)
+        if self._spec is not None:
+            self._verify = self._watch("serve:spec_verify",
+                                       self._raw["spec_verify"])
         self._decode = self._watch("serve:decode_step", self._raw["decode_step"])
         self._prefill = self._watch("serve:prefill_chunk",
                                     self._raw["prefill_chunk"])
@@ -160,6 +202,16 @@ class InferenceEngine:
         self._start_wall = None
         self._tokens_sampled = 0            # every appended token
         self._tokens_finished = 0           # tokens of finished requests only
+        # target-model step accounting (speculation's headline number):
+        # _target_steps counts target program executions (prefill chunks,
+        # decode steps, spec verifies); _advance_steps counts per-GROUP
+        # participations in a token-advancing step, so advance/token reads
+        # ~1.0 for plain greedy and ~1/(1+E[accepted]) with speculation
+        self._target_steps = 0
+        self._advance_steps = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_rounds = 0
 
     # ------------------------------------------------------------- plumbing
     def _watch(self, name, fn):
@@ -220,7 +272,13 @@ class InferenceEngine:
         self._run_copies(copies)
 
         log["prefill"] = self._prefill_one(it)
-        log["decode"], log["finished"] = self._decode_all(it)
+        spec_res = self._speculate_all(it) if self._spec is not None else None
+        if spec_res is not None:
+            log["spec"], log["decode"], log["finished"] = spec_res
+        else:
+            if self._spec is not None:
+                log["spec"] = []
+            log["decode"], log["finished"] = self._decode_all(it)
 
         self._scalar("occupancy", sched.occupancy())
         self._scalar("waiting", len(sched.waiting))
@@ -231,6 +289,15 @@ class InferenceEngine:
             self._scalar("PrefixCache/hit_tokens", pc["hit_tokens"])
             self._scalar("PrefixCache/parked_blocks", pc["parked_blocks"])
             self._scalar("PrefixCache/evictions", pc["evictions"])
+        if self._spec is not None:
+            s = self.spec_summary()
+            self._scalar("Spec/acceptance_rate", s["spec_acceptance_rate"])
+            self._scalar("Spec/drafted_tokens", s["drafted_tokens"])
+            self._scalar("Spec/accepted_tokens", s["accepted_tokens"])
+            self._scalar("Spec/wasted_draft_tokens",
+                         s["wasted_draft_tokens"])
+            self._scalar("Spec/target_steps_per_token",
+                         s["target_steps_per_token"])
         elapsed = max(time.perf_counter() - self._start_wall, 1e-9)
         self._scalar("tok_s", self._tokens_sampled / elapsed)
         self._scalar("goodput_tok_s", self._tokens_finished / elapsed)
@@ -292,6 +359,7 @@ class InferenceEngine:
         logits, self.k_pool, self.v_pool = self._prefill(
             self.params, toks, jnp.int32(pos), jnp.int32(n), table,
             self.k_pool, self.v_pool)
+        self._target_steps += 1
         if self._mirror is not None:
             ol, self._okcs, self._ovcs = self._mirror["prefill_chunk"](
                 self.params, toks, jnp.int32(pos), jnp.int32(n),
@@ -334,6 +402,182 @@ class InferenceEngine:
         self._scalar("ttft_ms", ttft_ms)
         self._scalar("ttft_iters", ttft_iters)
 
+    # -------------------------------------------------------- speculation
+    def _extend_target_table(self, g, m, copies):
+        """Cover write positions ``next_pos .. next_pos+m`` in the group's
+        target block table before a verify step: fresh pages past the end,
+        ``ensure_exclusive`` (CoW) for existing shared ones — the same
+        discipline as Scheduler._ensure_group_blocks, widened to the verify
+        window. On pool exhaustion the appended pages go back and the table
+        shrinks to its original length (the group plain-decodes this
+        iteration); CoW swaps that already happened keep their device copy,
+        the pages are genuinely exclusive now (the scheduler precedent)."""
+        from .block_allocator import AllocationError
+        alloc = self.scheduler.allocator
+        BS = self.block_size
+        table = g.tables[0]
+        orig_len = len(table)
+        p0 = g.next_pos(0)
+        try:
+            for bi in range(p0 // BS, (p0 + m) // BS + 1):
+                if bi == len(table):
+                    table.append(alloc.allocate(1)[0])
+                else:
+                    blk, copy = alloc.ensure_exclusive(table[bi])
+                    if copy is not None:
+                        table[bi] = blk
+                        copies.append(copy)
+        except AllocationError:
+            if len(table) > orig_len:
+                alloc.free(table[orig_len:])
+                del table[orig_len:]
+            return False
+        return True
+
+    def _speculate_all(self, it):
+        """One speculative decode round, replacing ``_decode_all`` for the
+        whole iteration: eligible single-lane greedy groups get up to K draft
+        proposals verified at K+1 positions, and EVERY other decode lane
+        (beam lanes, sampled lanes, groups that lost a draft-page race) rides
+        the same ``spec_verify`` execution as a plain ``n_valid=1`` row — so
+        a speculative iteration still executes exactly ONE target
+        decode-domain program, and "strictly fewer target steps" holds at
+        the program-execution level, not just per token.
+
+        Accepted prefixes (plus the target's own next token) commit; the
+        first rejection truncates the block table to the accepted frontier
+        and refcount-releases the tail (free rollback — the kept partial
+        page's garbage tail is never attended and is overwritten next
+        round). Returns ``(spec_log, decode_log, finished)``, or None when
+        no group can draft this iteration (the caller falls back to the
+        cheaper 1-wide ``decode_step``)."""
+        spec, sched, alloc = self._spec, self.scheduler, self.scheduler.allocator
+        spec.sync(sched.running)
+        lanes = [(g, lane, slot) for g, lane, slot in
+                 sched.decode_lanes() if g.entered_decode_it != it]
+        plan, copies = [], []
+        for g, lane, slot in lanes:
+            if lane != 0 or g.lanes != 1 or g.req.temperature > 0.0:
+                continue
+            # never draft past the request budget: m proposals commit at most
+            # m+1 tokens, and the final token must come from a verify row so
+            # the emitted stream matches plain decode's finish check exactly
+            m = min(self.spec_k,
+                    g.req.max_new_tokens - len(g.generated[0]) - 1)
+            if m < 1:
+                continue
+            if not spec.prepare(g, m):
+                continue
+            if not self._extend_target_table(g, m, copies):
+                continue
+            plan.append((g, m))
+        self._run_copies(copies)
+        if not plan:
+            return None
+
+        drafts = spec.propose(plan)
+        plan_groups = {id(g) for g, _ in plan}
+        plain = [(g, lane, slot) for g, lane, slot in lanes
+                 if id(g) not in plan_groups]
+        decode_log = [[g.req.req_id, lane, slot] for g, lane, slot in plain]
+        if self.tracer is not None:
+            traced = set()
+            for g, _, _ in plain:
+                if id(g) in traced:
+                    continue
+                traced.add(id(g))
+                self.tracer.on_decode(
+                    g, it, g.lanes, g.lanes if g.decode_is_replay() else 0)
+
+        S, D = self.num_slots, self.spec_k + 1
+        toks = np.zeros((S, D), np.int32)
+        pos0 = np.zeros(S, np.int32)
+        n_valid = np.zeros(S, np.int32)
+        tables = np.full((S, self.max_blocks), NULL_BLOCK, np.int32)
+        active = np.zeros(S, bool)
+        for g, m in plan:
+            slot = g.slots[0]
+            toks[slot, 0] = g.generated[0][-1]
+            toks[slot, 1:1 + m] = drafts[spec._key(g)]
+            pos0[slot] = g.next_pos(0)
+            n_valid[slot] = m + 1
+            tables[slot] = self._pad_table(g.tables[0])
+            active[slot] = True
+        for g, lane, slot in plain:
+            toks[slot, 0] = g.generated[lane][-1]
+            pos0[slot] = g.next_pos(lane)
+            n_valid[slot] = 1
+            tables[slot] = self._pad_table(g.tables[lane])
+            active[slot] = True
+        logits, self.k_pool, self.v_pool = self._verify(
+            self.params, jnp.asarray(toks), jnp.asarray(pos0),
+            jnp.asarray(n_valid), jnp.asarray(tables), jnp.asarray(active),
+            self.k_pool, self.v_pool)
+        self._target_steps += 1
+        self._advance_steps += len(plan) + len({id(g) for g, _, _ in plain})
+        self._spec_rounds += 1
+        logits_np = np.asarray(logits)
+
+        spec_log, finished = [], []
+        for g, m in plan:
+            slot = g.slots[0]
+            p0 = g.next_pos(0)
+            ds = drafts[spec._key(g)]
+            len_before = len(g.generated[0])
+            eos, L = g.req.eos_token_id, g.req.max_new_tokens
+            committed, a, fin = [], 0, False
+            for i in range(m + 1):
+                t = int(np.argmax(logits_np[slot, i]))
+                committed.append(t)
+                matched = i < m and ds[i] == t
+                if matched:
+                    a += 1
+                # the exact _sample_greedy finish check, applied per token
+                if (len_before + len(committed) >= L
+                        or (eos >= 0 and t == eos)):
+                    fin = True
+                    break
+                if not matched:
+                    break
+            g.generated[0].extend(committed)
+            self._tokens_sampled += len(committed)
+            self._spec_drafted += m
+            self._spec_accepted += a
+            r = min(max(g.replay_decode_hwm - len_before, 0), len(committed))
+            if self.tracer is not None:
+                self.tracer.on_spec(g, it, drafted=m, accepted=a,
+                                    committed=len(committed), replayed=r)
+            spec_log.append([g.req.req_id, m, a, len(committed)])
+            if fin:
+                self._finish(g, g.generated[0], None, finished, it)
+                continue
+            # rollback: the table only needs to cover the committed frontier
+            # (positions <= p0 + a hold valid KV)
+            keep = alloc.blocks_for_tokens(p0 + a + 1)
+            table = g.tables[0]
+            if keep < len(table):
+                alloc.free(table[keep:])
+                del table[keep:]
+            spec.observe(g, p0, a, m)
+
+        # the ride-along lanes sample from verify row 0 — greedy argmax is
+        # token-identical to decode_step's row (the --compare-speculate
+        # contract); beam heads consume the device row like the sharded
+        # engine's psum'd logits (token-identical precedent)
+        logits0_np = logits_np[:, 0]
+        logits0 = None
+        for g in list(sched.running):
+            if (g.phase != "decode" or g.entered_decode_it == it
+                    or id(g) in plan_groups):
+                continue
+            if g.lanes == 1:
+                self._sample_greedy(g, logits0_np, finished, it)
+            else:
+                if logits0 is None:
+                    logits0 = logits[:, 0]
+                self._sample_beam(g, logits0, finished, it)
+        return spec_log, decode_log, finished
+
     def _decode_all(self, it):
         # a group that completed prefill THIS iteration sits out one decode:
         # its first write block is ensured at the NEXT iteration's start
@@ -367,6 +611,8 @@ class InferenceEngine:
             self.params, jnp.asarray(toks), jnp.asarray(pos),
             jnp.asarray(tables), jnp.asarray(active),
             self.k_pool, self.v_pool)
+        self._target_steps += 1
+        self._advance_steps += len({id(g) for g, _, _ in lanes})
         if self._mirror is not None:
             ol, self._okcs, self._ovcs = self._mirror["decode_step"](
                 self.params, jnp.asarray(toks), jnp.asarray(pos),
@@ -473,6 +719,8 @@ class InferenceEngine:
         return g.generated[best], float(final[best])
 
     def _finish(self, g, tokens, score, finished, it):
+        if self._spec is not None:
+            self._spec.release(g)   # draft pages die with the request
         self.scheduler.finish_group(g)
         n = len(tokens)
         self._tokens_finished += n
@@ -507,6 +755,32 @@ class InferenceEngine:
                 f"{float(np.max(np.abs(a - b)))!r}")
         self.mirror_checks += 1
 
+    # ------------------------------------------------------------- metrics
+    @property
+    def target_steps(self):
+        """Target-model program executions so far (prefill chunks + decode
+        steps + spec verifies) — speculation's strict-improvement number."""
+        return self._target_steps
+
+    def spec_summary(self):
+        """Speculation efficiency counters (PERF.md 'target steps per
+        token'): ``target_steps_per_token`` divides per-group participations
+        in token-advancing steps by tokens sampled, so plain greedy reads
+        ~1.0 and speculation ~1/(1 + E[accepted]) — the number the serve-sim
+        ``--spec-steps-budget`` gate thresholds."""
+        drafted, accepted = self._spec_drafted, self._spec_accepted
+        return {
+            "drafted_tokens": drafted,
+            "accepted_tokens": accepted,
+            "wasted_draft_tokens": drafted - accepted,
+            "spec_rounds": self._spec_rounds,
+            "spec_acceptance_rate": accepted / max(drafted, 1),
+            "target_steps": self._target_steps,
+            "advance_steps": self._advance_steps,
+            "target_steps_per_token":
+                self._advance_steps / max(self._tokens_sampled, 1),
+        }
+
     # ------------------------------------------------------- warm restart
     _OUT_FIELDS = ("req_id", "status", "tokens", "score", "refusal",
                    "ttft_iters", "ttft_ms", "finished_it", "preemptions")
@@ -533,6 +807,10 @@ class InferenceEngine:
         re-prefilling (docs/resilience.md)."""
         from .scheduler import pack_request  # noqa: F401  (re-export site)
         self.scheduler.quiesce()
+        if self._spec is not None:
+            # draft state is best-effort: the restored replica re-drafts from
+            # each request's committed context (token-identity is unaffected)
+            self._spec.drop_all()
         return {
             "geometry": self.geometry(),
             "scheduler": self.scheduler.state_dict(),
@@ -631,7 +909,7 @@ class InferenceEngine:
         kp = jnp.zeros(pool_shape, c.compute_dtype)
         vp = jnp.zeros(pool_shape, c.compute_dtype)
         zs = jnp.zeros(S, jnp.int32)
-        return [
+        entries = [
             ("serve_decode_step", self._raw["decode_step"],
              (self.params, zs, zs, jnp.zeros((S, MB), jnp.int32),
               jnp.zeros(S, bool), kp, vp), manifest),
@@ -642,3 +920,12 @@ class InferenceEngine:
              (kp, vp, jnp.zeros(P, jnp.int32), jnp.zeros(P, jnp.int32)),
              copy_manifest),
         ]
+        if self._spec is not None:
+            D = self.spec_k + 1
+            entries.append(
+                ("serve_spec_verify", self._raw["spec_verify"],
+                 (self.params, jnp.zeros((S, D), jnp.int32), zs, zs,
+                  jnp.zeros((S, MB), jnp.int32), jnp.zeros(S, bool),
+                  kp, vp), manifest))
+            entries.extend(self._spec.lint_programs(manifest))
+        return entries

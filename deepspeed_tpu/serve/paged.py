@@ -45,16 +45,36 @@ zero-recompile contract is unchanged.
 """
 
 import math
+import weakref
 
 import jax
 import jax.numpy as jnp
 
 from .block_allocator import NULL_BLOCK
 
+# One program set per (model instance, build geometry), shared by every engine
+# built over it. The jitted programs close over only the model's pure config
+# math and the baked geometry — params and pools arrive as call arguments — so
+# two engines with the same model and geometry would lower byte-identical HLO;
+# rebuilding per engine just recompiles it. Sharing makes engine construction
+# (warm restarts, test fleets, the lint registry's capture engines) pay XLA
+# once per process instead of once per engine. Weak-keyed so a model's
+# programs die with it. Telemetry compile accounting is unaffected: the
+# session's _WatchedJit AOT-compiles per (session, signature) on top of the
+# raw jit, so watched engines still observe their own compiles.
+_BUILD_CACHE = weakref.WeakKeyDictionary()
+
+
+def _mesh_cache_key(mesh):
+    if mesh is None:
+        return None
+    return (tuple(mesh.axis_names), tuple(mesh.devices.shape),
+            tuple(d.id for d in mesh.devices.flat))
+
 
 def build_paged_programs(model, *, num_slots, block_size, max_blocks,
                          prefill_chunk, copy_width=None, use_pallas=False,
-                         mesh=None):
+                         mesh=None, verify_width=0):
     """Jitted program dict for one engine: ``decode_step``, ``prefill_chunk``,
     ``copy_blocks`` plus ``beam_init(K, eos)`` / ``beam_select(K, eos)``
     factories (per-(K, eos) program caches — K is a shape, eos a baked
@@ -62,7 +82,36 @@ def build_paged_programs(model, *, num_slots, block_size, max_blocks,
     ``mesh`` (carrying a ``model`` axis), the pool-touching programs lower
     as head-sharded pjit programs instead; the dict also carries the
     ``pool_sharding`` / ``replicated_sharding`` placements the engine puts
-    its buffers with."""
+    its buffers with.
+
+    ``verify_width = D > 0`` additionally builds ``spec_verify`` — the
+    speculative-decoding verification program: a batched, D-token-wide
+    generalization of ``decode_step`` (one chunked-prefill-shaped pass per
+    slot, per-position logits out) that scores a drafted continuation for
+    every slot in ONE target-model execution. Single-chip only: the engine
+    refuses speculation + sharding, so the sharded build never asks for it."""
+    cache_key = (int(num_slots), int(block_size), int(max_blocks),
+                 int(prefill_chunk), int(copy_width or num_slots),
+                 bool(use_pallas), _mesh_cache_key(mesh), int(verify_width))
+    try:
+        per_model = _BUILD_CACHE.setdefault(model, {})
+    except TypeError:               # model not weak-referenceable: no sharing
+        per_model = None
+    if per_model is not None and cache_key in per_model:
+        return per_model[cache_key]
+    out = _build_paged_programs(
+        model, num_slots=num_slots, block_size=block_size,
+        max_blocks=max_blocks, prefill_chunk=prefill_chunk,
+        copy_width=copy_width, use_pallas=use_pallas, mesh=mesh,
+        verify_width=verify_width)
+    if per_model is not None:
+        per_model[cache_key] = out
+    return out
+
+
+def _build_paged_programs(model, *, num_slots, block_size, max_blocks,
+                          prefill_chunk, copy_width=None, use_pallas=False,
+                          mesh=None, verify_width=0):
     c = model.config
     nh, hd = c.n_head, c.head_dim
     S, BS, MB, C = int(num_slots), int(block_size), int(max_blocks), int(prefill_chunk)
@@ -193,6 +242,53 @@ def build_paged_programs(model, *, num_slots, block_size, max_blocks,
                                      (1, 1, x.shape[-1]))[:, 0]
         return _logits(last, p), pools["k"], pools["v"]
 
+    # ---------------------------------------------------- speculative verify
+    D = int(verify_width)
+
+    def spec_verify(p, toks, pos0, n_valid, tables, active, k_pool, v_pool):
+        """Score a drafted continuation for every slot in one step: ``toks``
+        is [S, D] — row 0 each slot's last committed token, rows 1.. the
+        draft's proposals — at positions ``pos0 + [0, D)``; rows past
+        ``n_valid[s]`` (and all rows of inactive slots) write to the null
+        page and produce garbage logits the host ignores. Returns
+        (logits [S, D, V] f32, k_pool, v_pool): row i's logits are the
+        target's next-token distribution AFTER consuming toks[:, :i+1] —
+        exactly what ``decode_step`` would have produced i steps later, so
+        greedy acceptance against these rows is token-identical to plain
+        decode. Rejected rows leave garbage KV past the accepted frontier;
+        the causal mask (keys <= query position) means it is never attended,
+        and the next round's writes cover the same extent — rollback is a
+        host-side table truncation, no device work."""
+        pools = {"k": k_pool, "v": v_pool}
+        wpe_cap = p["wpe"].shape[0] - 1
+        tp = pos0[:, None] + jnp.arange(D)[None, :]           # [S, D] positions
+        positions = jnp.minimum(tp, wpe_cap)  # pads only; valid rows untouched
+        x = p["wte"][toks].astype(cd) + p["wpe"][positions].astype(cd)
+        valid = (jnp.arange(D)[None, :] < n_valid[:, None]) & active[:, None]
+        wblk = jnp.where(
+            valid,
+            tables[jnp.arange(S)[:, None], jnp.minimum(tp // BS, MB - 1)],
+            NULL_BLOCK)
+        off = tp % BS
+
+        def attn(xin, bp, li):
+            q, k, v = _qkv(xin, bp)                           # [S, nh, D, hd]
+            pools["k"] = pools["k"].at[li, wblk, off].set(
+                k.transpose(0, 2, 1, 3).astype(pools["k"].dtype))
+            pools["v"] = pools["v"].at[li, wblk, off].set(
+                v.transpose(0, 2, 1, 3).astype(pools["v"].dtype))
+            kg = _gather(pools["k"], li, tables)
+            vg = _gather(pools["v"], li, tables)
+            # per-row causal frontier: row i attends keys <= pos0 + i — the
+            # same mask decode_step applies one position at a time
+            mask = (jnp.arange(ML)[None, None, :]
+                    <= tp[:, :, None])[:, None, :, :]
+            return _proj(_attend(q, kg, vg, mask, xin.dtype), bp, xin.dtype)
+
+        x = _blocks_forward(p, x, attn)
+        logits = _logits(x.reshape(S * D, -1), p)
+        return logits.reshape(S, D, V), pools["k"], pools["v"]
+
     # ------------------------------------------------------------ block copy
     def copy_blocks(k_pool, v_pool, src, dst):
         """Copy-on-write page copies, batched to a fixed width ``P`` (pads are
@@ -247,7 +343,7 @@ def build_paged_programs(model, *, num_slots, block_size, max_blocks,
         return beam_cache[key]
 
     if mesh is None:
-        return {
+        out = {
             "decode_step": jax.jit(decode_step, donate_argnums=(5, 6)),
             "prefill_chunk": jax.jit(prefill_chunk_fn, donate_argnums=(5, 6)),
             "copy_blocks": jax.jit(copy_blocks, donate_argnums=(0, 1)),
@@ -255,6 +351,13 @@ def build_paged_programs(model, *, num_slots, block_size, max_blocks,
             "beam_select": beam_select,
             "copy_width": P,
         }
+        if D > 0:
+            out["spec_verify"] = jax.jit(spec_verify, donate_argnums=(6, 7))
+        return out
+
+    if D > 0:
+        raise ValueError("speculative verify is single-chip only (the engine "
+                         "refuses speculation + sharding)")
 
     # ------------------------------------------------- model-axis sharding
     from jax.sharding import NamedSharding, PartitionSpec as PS

@@ -63,6 +63,8 @@ EV_ADMIT = "admit"              # args: lanes, queue_delay_iters
 EV_CACHE_HIT = "cache_hit"      # args: cached_prefix_tokens (prefix reuse)
 EV_PREFILL = "prefill"          # args: pos, n, replayed
 EV_DECODE = "decode"            # args: lanes, replayed
+EV_SPEC_ACCEPT = "spec_accept"  # args: drafted, accepted, committed
+EV_SPEC_REJECT = "spec_reject"  # args: drafted, accepted, committed (a == 0)
 EV_FORK = "fork"                # args: lanes (beam CoW table fork)
 EV_PREEMPT = "preempt"          # args: evicted_blocks
 EV_FIRST_TOKEN = "first_token"
@@ -191,7 +193,8 @@ class RequestTracer:
         self.hist = {m: StreamingHistogram() for m in LATENCY_METRICS}
         self.totals = {"prefill_tokens": 0, "prefill_replayed": 0,
                        "decode_tokens": 0, "decode_replayed": 0,
-                       "cached_prefix_tokens": 0}
+                       "cached_prefix_tokens": 0, "drafted_tokens": 0,
+                       "accepted_draft_tokens": 0, "wasted_draft_tokens": 0}
         self.slo_met = 0
         self.slo_violated = 0
         self.refused = 0
@@ -270,6 +273,34 @@ class RequestTracer:
             self._cur["decode"][1] += int(replayed)
         self.totals["decode_tokens"] += int(lanes)
         self.totals["decode_replayed"] += int(replayed)
+
+    def on_spec(self, g, it, drafted, accepted, committed, replayed):
+        """One speculative round for one request. The ``committed`` tokens
+        enter the decode side of the useful+replayed == scheduled identity
+        (they ARE the tokens plain decode would have scheduled); the draft
+        economics — drafted / accepted / wasted — live OUTSIDE the identity,
+        like ``cached_prefix_tokens``: draft-model work is not target-model
+        schedule, and billing it there would misread speculation as waste."""
+        rec = self.live.get(g.req.req_id)
+        if rec is not None:
+            name = EV_SPEC_ACCEPT if accepted else EV_SPEC_REJECT
+            self._event(rec, name, it, int(drafted), int(accepted),
+                        int(committed))
+            rec["drafted_tokens"] = (
+                rec.get("drafted_tokens", 0) + int(drafted))
+            rec["accepted_tokens"] = (
+                rec.get("accepted_tokens", 0) + int(accepted))
+            rec["wasted_draft_tokens"] = (
+                rec.get("wasted_draft_tokens", 0) + int(drafted)
+                - int(accepted))
+        if self._cur is not None:
+            self._cur["decode"][0] += int(committed) - int(replayed)
+            self._cur["decode"][1] += int(replayed)
+        self.totals["decode_tokens"] += int(committed)
+        self.totals["decode_replayed"] += int(replayed)
+        self.totals["drafted_tokens"] += int(drafted)
+        self.totals["accepted_draft_tokens"] += int(accepted)
+        self.totals["wasted_draft_tokens"] += int(drafted) - int(accepted)
 
     def on_fork(self, g, it):
         rec = self.live.get(g.req.req_id)
@@ -387,6 +418,11 @@ class RequestTracer:
             # than scheduled — by construction OUTSIDE the useful+replayed ==
             # scheduled identity, so reuse is never misread as recomputation
             "cached_prefix_tokens": t["cached_prefix_tokens"],
+            # speculation economics: draft-model work, likewise OUTSIDE the
+            # identity (the committed tokens themselves are counted above)
+            "drafted_tokens": t["drafted_tokens"],
+            "accepted_draft_tokens": t["accepted_draft_tokens"],
+            "wasted_draft_tokens": t["wasted_draft_tokens"],
         }
 
     def slo_summary(self):
@@ -521,6 +557,14 @@ def to_serve_trace_events(bundle, us_per_iter=1000):
                 run[1] = it
                 run[2] += lanes
                 run[3] += replayed
+            elif name in (EV_SPEC_ACCEPT, EV_SPEC_REJECT):
+                # only ever present with speculation on, so speculation-off
+                # exports (the golden-file contract) are unchanged
+                events.append(instant_event(
+                    0, tid, it * U,
+                    "spec accept" if name == EV_SPEC_ACCEPT else "spec reject",
+                    {"drafted": ev[3], "accepted": ev[4],
+                     "committed": ev[5]}))
             elif name == EV_CACHE_HIT:
                 # only ever present with the prefix cache on and hitting, so
                 # cache-off exports (the golden-file contract) are unchanged

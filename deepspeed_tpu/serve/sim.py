@@ -73,7 +73,7 @@ def _p50(values):
     return vals[len(vals) // 2] if vals else None
 
 
-def _build(args, telemetry, prefix_cache=None, sharding=None):
+def _build(args, telemetry, prefix_cache=None, sharding=None, speculate=None):
     import jax
     import jax.numpy as jnp
 
@@ -82,21 +82,34 @@ def _build(args, telemetry, prefix_cache=None, sharding=None):
 
     pc = args.prefix_cache if prefix_cache is None else prefix_cache
     tp = args.sharding if sharding is None else sharding
-    # the dense-cache oracle cannot mirror either mode (skipped prefills /
-    # reduction-order drift), and the engine constructor enforces that
-    mirror = not args.no_mirror and not pc and tp <= 1
+    spec_k = args.speculate if speculate is None else speculate
+    # the dense-cache oracle cannot mirror any of these modes (skipped
+    # prefills / reduction-order drift / multi-token commits), and the
+    # engine constructor enforces that
+    mirror = not args.no_mirror and not pc and tp <= 1 and not spec_k
     cfg = GPT2Config(vocab_size=args.vocab_size, n_positions=args.max_model_len,
                      n_embd=args.n_embd, n_layer=args.n_layer,
                      n_head=args.n_head, compute_dtype=jnp.float32,
                      loss_chunk=0)
     model = GPT2Model(cfg)
     params = model.init(jax.random.PRNGKey(args.seed))
+    speculation = None
+    if spec_k:
+        # self-draft by default (same model + params -> near-total acceptance,
+        # the deterministic upper bound the strict-step gate relies on); a
+        # non-negative --spec-draft-seed re-draws the draft params so the
+        # rejection/rollback path gets exercised too
+        dparams = (model.init(jax.random.PRNGKey(args.spec_draft_seed))
+                   if args.spec_draft_seed >= 0 else params)
+        speculation = {"enabled": True, "draft_model": model,
+                       "draft_params": dparams, "max_draft_tokens": spec_k}
     engine = InferenceEngine(
         model, params, num_slots=args.slots, block_size=args.block_size,
         num_blocks=args.num_blocks, max_model_len=args.max_model_len,
         prefill_chunk=args.prefill_chunk, use_pallas=args.pallas,
         telemetry=telemetry, mirror=mirror, prefix_cache=pc,
         sharding={"model": tp} if tp > 1 else None,
+        speculation=speculation,
         request_trace=None if args.no_trace else {
             "enabled": True,
             "capacity": max(args.requests + 1, 256),
@@ -113,7 +126,8 @@ def _trace(args):
 
 
 def _report(args, trace, outputs, logs, tracer, waste, slo, failures,
-            cache_stats=None, ttft_compare=None, fleet_merge_exact=None):
+            cache_stats=None, ttft_compare=None, fleet_merge_exact=None,
+            spec_summary=None, steps_compare=None):
     """Machine-readable serve-sim report. The ``deterministic`` subtree is a
     pure function of the seeded trace (iteration-domain latencies, token
     counts, waste split — byte-stable across runs on one platform); ``wall``
@@ -143,7 +157,9 @@ def _report(args, trace, outputs, logs, tracer, waste, slo, failures,
                  "prefill_chunk": args.prefill_chunk,
                  "shared_prefix": args.shared_prefix,
                  "sharding": args.sharding,
-                 "prefix_cache": bool(args.prefix_cache)},
+                 "prefix_cache": bool(args.prefix_cache),
+                 "speculate": args.speculate,
+                 "spec_draft_seed": args.spec_draft_seed},
         "n_finished": sum(1 for o in outputs if o.status == "finished"),
         "n_refused": sum(1 for o in outputs if o.status == "refused"),
         "iterations": len(logs),
@@ -154,6 +170,12 @@ def _report(args, trace, outputs, logs, tracer, waste, slo, failures,
     if cache_stats is not None:
         # pure functions of the seeded schedule -> deterministic subtree
         det["prefix_cache"] = cache_stats
+    if spec_summary is not None:
+        # acceptance counters and step ratios are pure functions of the
+        # seeded schedule (host argmax over deterministic logits)
+        det["speculation"] = spec_summary
+    if steps_compare is not None:
+        det["target_steps"] = steps_compare
     if ttft_compare is not None:
         det["ttft_p50_iters"] = ttft_compare
     if fleet_merge_exact is not None:
@@ -198,6 +220,24 @@ def main(argv=None):
                     help="run the trace cache-off AND cache-on, assert token "
                          "identity and a STRICT cache-on p50 TTFT (iters) "
                          "improvement (implies --prefix-cache)")
+    ap.add_argument("--speculate", type=int, nargs="?", const=4, default=0,
+                    metavar="K",
+                    help="speculative decoding with a K-token self-draft "
+                         "(disables the mirror oracle: the K+1-wide verify is "
+                         "token-identical, not bitwise); bare flag = K=4")
+    ap.add_argument("--compare-speculate", action="store_true",
+                    help="run the trace speculation-off AND speculation-on, "
+                         "assert byte-identical tokens and STRICTLY fewer "
+                         "target-model steps (implies --speculate)")
+    ap.add_argument("--spec-draft-seed", type=int, default=-1, metavar="S",
+                    help="re-draw the draft params from seed S instead of "
+                         "self-drafting, to exercise rejection/rollback "
+                         "(-1 = self-draft)")
+    ap.add_argument("--spec-steps-budget", type=float, default=0.0,
+                    metavar="R",
+                    help="with --speculate: fail unless target_steps_per_"
+                         "token < R (0 = not gated; PERF.md defines the "
+                         "metric)")
     ap.add_argument("--sharding", type=int, default=1, metavar="TP",
                     help="shard the KV pool + decode programs over TP model-"
                          "axis devices by attention head (disables the "
@@ -240,15 +280,24 @@ def main(argv=None):
                  "(they need the ledger)")
     if args.compare_prefix_cache:
         args.prefix_cache = True
+    if args.compare_speculate and not args.speculate:
+        args.speculate = 4
+    if args.speculate < 0:
+        ap.error("--speculate must be >= 1 (or omitted)")
+    if args.spec_steps_budget and not args.speculate:
+        ap.error("--spec-steps-budget needs --speculate")
+    if args.speculate and args.sharding > 1:
+        ap.error("--speculate is single-chip only (the spec_verify program "
+                 "does not shard)")
     if args.verify_unsharded and args.sharding <= 1:
         ap.error("--verify-unsharded needs --sharding > 1")
     if args.sharding < 1:
         ap.error("--sharding must be >= 1")
     mirror_on = not args.no_mirror and not args.prefix_cache \
-        and args.sharding <= 1
+        and args.sharding <= 1 and not args.speculate
     if not args.no_mirror and not mirror_on:
         print("serve-sim: note: mirror oracle disabled "
-              "(incompatible with --prefix-cache / --sharding)")
+              "(incompatible with --prefix-cache / --sharding / --speculate)")
 
     from ..utils.telemetry import TelemetrySession
 
@@ -332,6 +381,34 @@ def main(argv=None):
                 f"prefix cache did not strictly improve p50 TTFT: "
                 f"cache-on {p50_on} vs cache-off {p50_off} iters")
 
+    # invariant 8 (optional): speculation is a schedule optimization, not a
+    # sampling change — byte-identical emitted tokens on the same trace with
+    # STRICTLY fewer target-model program executions (the headline number)
+    steps_compare = None
+    if args.compare_speculate:
+        eng_plain = _build(args, None, speculate=0)
+        outs_plain, _ = eng_plain.run(_trace(args))
+        t_on = {o.req_id: (o.status, o.tokens) for o in outputs}
+        t_off = {o.req_id: (o.status, o.tokens) for o in outs_plain}
+        if t_on != t_off:
+            bad = sorted(r for r in t_on if t_on[r] != t_off.get(r))
+            failures.append(
+                f"speculation changed tokens on {len(bad)} request(s): "
+                f"{', '.join(bad[:8])}")
+        steps_compare = {"speculative": engine.target_steps,
+                         "plain": eng_plain.target_steps}
+        if not engine.target_steps < eng_plain.target_steps:
+            failures.append(
+                f"speculation did not strictly reduce target-model steps: "
+                f"{engine.target_steps} vs plain {eng_plain.target_steps}")
+    spec_summary = engine.spec_summary() if args.speculate else None
+    if args.spec_steps_budget:
+        ratio = spec_summary["target_steps_per_token"]
+        if not ratio < args.spec_steps_budget:
+            failures.append(
+                f"target_steps_per_token {ratio:.4f} is not under the "
+                f"--spec-steps-budget {args.spec_steps_budget}")
+
     tracer = engine.tracer
     waste = slo = None
     fleet_merge_exact = None
@@ -340,7 +417,11 @@ def main(argv=None):
         # the schedule log says was scheduled — exactly, no residue
         waste = tracer.waste_summary()
         sched_prefill = sum(l["prefill"][2] for l in logs if l["prefill"])
-        sched_decode = sum(len(l["decode"]) for l in logs)
+        # speculative rounds commit tokens outside the per-lane decode list;
+        # their log entries carry the committed count in slot 3 (the "spec"
+        # key only exists with speculation on, so spec-off logs are unchanged)
+        sched_decode = (sum(len(l["decode"]) for l in logs)
+                        + sum(e[3] for l in logs for e in l.get("spec", [])))
         if (waste["prefill_tokens"] != sched_prefill
                 or waste["decode_tokens"] != sched_decode):
             failures.append(
@@ -399,7 +480,9 @@ def main(argv=None):
         report = _report(args, trace, outputs, logs, tracer, waste, slo,
                          failures, cache_stats=cache_stats,
                          ttft_compare=ttft_compare,
-                         fleet_merge_exact=fleet_merge_exact)
+                         fleet_merge_exact=fleet_merge_exact,
+                         spec_summary=spec_summary,
+                         steps_compare=steps_compare)
         blob = json.dumps(report, sort_keys=True, separators=(",", ":"))
         if args.json_out == "-":
             print(blob)
@@ -435,6 +518,17 @@ def main(argv=None):
     if ttft_compare is not None:
         print(f"  TTFT p50 iters   : cache-on {ttft_compare['cache_on']} vs "
               f"cache-off {ttft_compare['cache_off']}")
+    if spec_summary is not None:
+        print(f"  speculation      : K={args.speculate}, acceptance "
+              f"{spec_summary['spec_acceptance_rate']:.1%} "
+              f"({spec_summary['accepted_tokens']} of "
+              f"{spec_summary['drafted_tokens']} drafts), "
+              f"{spec_summary['target_steps_per_token']:.3f} "
+              f"target steps/token")
+    if steps_compare is not None:
+        print(f"  target steps     : speculative "
+              f"{steps_compare['speculative']} vs plain "
+              f"{steps_compare['plain']} (token-identical)")
     if args.replay:
         print("  replay           : byte-identical schedule + outputs")
     if waste is not None:

@@ -50,6 +50,17 @@ timeout -k 10 300 "$REPO/bin/ds-tpu" serve-sim --shared-prefix 96 \
     --json /tmp/_serve_prefix_cache.json \
     --output /tmp/_serve_prefix_cache_telemetry
 cache_rc=$?
+# speculative-decoding gate: the same seeded shared-prefix trace run
+# speculation-off AND speculation-on (self-draft) — emitted tokens must be
+# byte-identical, the speculative run must execute STRICTLY fewer target-model
+# steps with target_steps_per_token under the 0.75 budget (PERF.md defines the
+# metric), and every spec program must compile exactly once
+timeout -k 10 300 "$REPO/bin/ds-tpu" serve-sim --shared-prefix 96 \
+    --compare-speculate --spec-steps-budget 0.75 \
+    --slo-ttft-ms 60000 --slo-tpot-ms 60000 \
+    --json /tmp/_serve_spec.json \
+    --output /tmp/_serve_spec_telemetry
+spec_rc=$?
 # sharded-decode gate: the same seeded 64-request trace (greedy + beam)
 # through the 2-way model-axis head-sharded engine AND a single-chip engine —
 # outputs must be token-identical and every sharded program must still
@@ -112,6 +123,7 @@ hang_rc=$?
 [ "$comm_rc" -ne 0 ] && exit "$comm_rc"
 [ "$serve_rc" -ne 0 ] && exit "$serve_rc"
 [ "$cache_rc" -ne 0 ] && exit "$cache_rc"
+[ "$spec_rc" -ne 0 ] && exit "$spec_rc"
 [ "$shard_rc" -ne 0 ] && exit "$shard_rc"
 [ "$anatomy_rc" -ne 0 ] && exit "$anatomy_rc"
 [ "$crash_rc" -ne 0 ] && exit "$crash_rc"

@@ -194,6 +194,18 @@ SWEEP = {
         ({"sharding": {"model": True}}, ("raise", ValueError)),
         ({"prefix_cache": {"enabled": True}},
          ("attr", "serving_prefix_cache_enabled", True)),
+        ({"speculation": {"enabled": True}},
+         ("attr", "serving_speculation_enabled", True)),
+        ({"speculation": {"draft_model": "gpt2-124m"}},
+         ("attr", "serving_speculation_draft_model", "gpt2-124m")),
+        ({"speculation": {"max_draft_tokens": 6}},
+         ("attr", "serving_speculation_max_draft_tokens", 6)),
+        ({"speculation": {"draft_pool_blocks": 65}},
+         ("attr", "serving_speculation_draft_pool_blocks", 65)),
+        ({"speculation": {"max_draft_tokens": 0}}, ("raise", ValueError)),
+        ({"speculation": {"max_draft_tokens": True}}, ("raise", ValueError)),
+        # block 0 is the reserved null page: 1 usable block can't exist
+        ({"speculation": {"draft_pool_blocks": 1}}, ("raise", ValueError)),
     ),
     "resilience": (
         ({"enabled": True, "save_dir": "/tmp/ckpt"},
@@ -350,6 +362,13 @@ def test_unknown_prefix_cache_key_warns(capture):
     assert "unknown serving.prefix_cache config key" in capture.text
     assert "enabeld" in capture.text
     assert "enabled" in capture.text     # the known-keys hint points at the fix
+
+
+def test_unknown_speculation_key_warns(capture):
+    _cfg(serving={"speculation": {"enabled": True, "max_draft_tokns": 4}})
+    assert "unknown serving.speculation config key" in capture.text
+    assert "max_draft_tokns" in capture.text
+    assert "max_draft_tokens" in capture.text  # known-keys hint has the fix
 
 
 def test_unknown_comm_key_warns(capture):

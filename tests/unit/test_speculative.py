@@ -1,0 +1,309 @@
+"""Speculative decoding over the paged KV pool: identity and rollback.
+
+Speculation must be a *step-count* change, not an output change: a request
+served with draft-model drafting + one-step batched verification emits the
+SAME tokens as plain greedy decode (which itself matches the model's own
+monolithic ``generate``), while executing strictly fewer target-model
+programs. Also pinned here: the pure acceptance rule, rejection rollback as
+a free block-table truncation (with a CoW no-alias proof — forked snapshot
+pages stay bitwise frozen while the speculating writer advances), rejection
+at position 0 degenerating to exactly one committed token, prefix-cache
+interaction, preemption/warm-restart transparency, the zero-recompile
+contract for all three spec programs, and the mirror-oracle refusal.
+
+Engines are expensive to build (each compiles its program set), so the
+standard-geometry speculative and plain engines are module-scoped and shared
+by the tests that can reuse them; step/acceptance counters are compared as
+deltas. Reference prompts stick to max_new_tokens=6 so ``generate`` compiles
+one decode program per prompt length for the whole module.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.serve.engine import InferenceEngine
+from deepspeed_tpu.serve.scheduler import Request
+from deepspeed_tpu.serve.sim import synth_trace
+from deepspeed_tpu.serve.speculative import accept_greedy
+from deepspeed_tpu.utils.telemetry import TelemetrySession
+
+ML = 32
+L = 6          # shared max_new_tokens: one generate decode program per shape
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = GPT2Config(vocab_size=64, n_positions=ML, n_embd=16, n_layer=2,
+                     n_head=2, compute_dtype=jnp.float32, loss_chunk=0)
+    model = GPT2Model(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _engine(model_and_params, *, speculate=True, draft_seed=None, spec_k=4,
+            **kw):
+    """Engine factory; ``draft_seed=None`` self-drafts (acceptance ~1 by
+    construction), an int redraws draft params so verification rejects."""
+    model, params = model_and_params
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 33)
+    kw.setdefault("max_model_len", ML)
+    kw.setdefault("prefill_chunk", 8)
+    if speculate:
+        dparams = (params if draft_seed is None
+                   else model.init(jax.random.PRNGKey(draft_seed)))
+        kw["speculation"] = {"enabled": True, "draft_model": model,
+                             "draft_params": dparams,
+                             "max_draft_tokens": spec_k}
+    return InferenceEngine(model, params, **kw)
+
+
+@pytest.fixture(scope="module")
+def spec_engine(model_and_params):
+    """Shared standard-geometry self-draft engine — every test that uses it
+    drains it back to idle."""
+    return _engine(model_and_params)
+
+
+@pytest.fixture(scope="module")
+def plain_engine(model_and_params):
+    return _engine(model_and_params, speculate=False)
+
+
+def _prompt(seed, n):
+    return np.random.RandomState(seed).randint(
+        0, 64, size=n).astype(np.int32).tolist()
+
+
+def _reference(model_and_params, prompt, max_new=L):
+    model, params = model_and_params
+    ref = model.generate(params, jnp.asarray([prompt], jnp.int32), max_new)
+    return np.asarray(ref)[0, len(prompt):].tolist()
+
+
+def test_accept_greedy_rule():
+    # full accept: all m drafts match, the bonus row commits too -> m+1 tokens
+    committed, a = accept_greedy([5, 6, 7, 8, 9], [5, 6, 7, 8])
+    assert committed == [5, 6, 7, 8, 9] and a == 4
+    # first mismatch stops the walk; the mismatching row's argmax still
+    # commits (it IS the plain-decode token at that position)
+    committed, a = accept_greedy([5, 9, 7], [5, 6])
+    assert committed == [5, 9] and a == 1
+    # rejection at position 0 degenerates to plain decode: one token, row 0
+    committed, a = accept_greedy([7, 1, 2], [5, 6])
+    assert committed == [7] and a == 0
+    # no drafts: the rule is exactly one plain decode step
+    committed, a = accept_greedy([3], [])
+    assert committed == [3] and a == 0
+
+
+def test_self_draft_matches_generate_with_strictly_fewer_steps(
+        model_and_params, spec_engine, plain_engine):
+    reqs = [Request(f"sd{i}", _prompt(50 + i, 7 + i), L) for i in range(4)]
+    steps0, ss0 = spec_engine.target_steps, spec_engine.spec_summary()
+    outs_spec, _ = spec_engine.run([Request(r.req_id, list(r.prompt), L)
+                                    for r in reqs])
+    psteps0 = plain_engine.target_steps
+    outs_plain, _ = plain_engine.run([Request(r.req_id, list(r.prompt), L)
+                                      for r in reqs])
+
+    for r, o in zip(reqs, outs_spec[-4:]):
+        assert o.status == "finished"
+        assert o.tokens == _reference(model_and_params,
+                                      list(r.prompt)), r.req_id
+    assert ([o.tokens for o in outs_spec[-4:]]
+            == [o.tokens for o in outs_plain[-4:]])
+    # the headline contract: token-identical output from strictly fewer
+    # target-model program executions (deltas — the engines are shared)
+    assert (spec_engine.target_steps - steps0
+            < plain_engine.target_steps - psteps0)
+    ss = spec_engine.spec_summary()
+    drafted = ss["drafted_tokens"] - ss0["drafted_tokens"]
+    accepted = ss["accepted_tokens"] - ss0["accepted_tokens"]
+    assert drafted == accepted > 0                    # self-draft: all accept
+    assert ss["spec_acceptance_rate"] == 1.0
+    assert ss["target_steps_per_token"] < 1.0
+
+
+def test_rejections_roll_back_tables_and_stay_token_identical(
+        model_and_params):
+    """A draft with different weights gets rejected: every rejection must
+    truncate the target block table back to the committed frontier (the
+    invariant below fails if the tail pages leak), at least one round must
+    reject at position 0 (committing exactly one token — plain decode's
+    step), and the emitted streams must still match ``model.generate``."""
+    reqs = [Request(f"r{i}", _prompt(60 + i, 7 + i), L) for i in range(4)]
+    eng = _engine(model_and_params, draft_seed=2)
+    alloc = eng.scheduler.allocator
+    for r in reqs:
+        eng.submit(Request(r.req_id, list(r.prompt), L))
+    spec_entries = []
+    while not eng.scheduler.idle:
+        log = eng.step()
+        spec_entries.extend(log.get("spec") or [])
+        for g in eng.scheduler.running:
+            if g.phase != "decode":
+                continue
+            for ln in range(g.lanes):
+                # the table never covers past the next write block: rollback
+                # released every page beyond the accepted frontier
+                assert len(g.tables[ln]) <= alloc.blocks_for_tokens(
+                    g.next_pos(ln) + 1)
+
+    assert any(a < m for _, m, a, _ in spec_entries), "no rejection occurred"
+    assert any(a == m for _, m, a, _ in spec_entries), "no full accept"
+    assert any(a == 0 and c == 1 for _, m, a, c in spec_entries), \
+        "no position-0 rejection (should commit exactly the plain token)"
+    for r in reqs:
+        assert eng.outputs[r.req_id].tokens == _reference(
+            model_and_params, list(r.prompt)), r.req_id
+    ss = eng.spec_summary()
+    assert 0 < ss["spec_acceptance_rate"] < 1.0
+    assert ss["wasted_draft_tokens"] == (ss["drafted_tokens"]
+                                         - ss["accepted_tokens"]) > 0
+    # both pools drain: rollback freed the rejected tails, finish freed the rest
+    assert alloc.num_used == 0
+    assert eng._spec.pool_stats()["used"] == 0
+
+
+def test_cow_rollback_never_aliases_a_forked_snapshot(model_and_params,
+                                                      spec_engine):
+    """Fork a mid-decode request's block table (an external share-holder,
+    e.g. a warm-restart snapshot) and keep decoding speculatively: every
+    verify write into the shared extent must go through ensure_exclusive
+    (CoW), so the forked pages' KV bytes stay bitwise frozen while the
+    request's own stream is unaffected — rollback and commit operate on
+    copies, never in place."""
+    prompt = _prompt(70, 9)
+    eng = spec_engine
+    alloc = eng.scheduler.allocator
+    eng.submit(Request("f0", list(prompt), L))
+    for _ in range(12):
+        eng.step()
+        running = [g for g in eng.scheduler.running if g.phase == "decode"]
+        if running and len(running[0].generated[0]) >= 1:
+            break
+    else:
+        pytest.fail("request never observed mid-decode")
+    g = running[0]
+    snap = alloc.fork(g.tables[0])          # share every page, incl. partial
+    cow_before = alloc.cow_copies
+    before = np.asarray(eng.k_pool)[:, snap].copy()
+    while not eng.scheduler.idle:
+        eng.step()
+    after = np.asarray(eng.k_pool)[:, snap]
+    assert np.array_equal(before, after), \
+        "a verify/decode write mutated a shared (forked) KV page in place"
+    assert alloc.cow_copies > cow_before    # the share forced real copies
+    assert eng.outputs["f0"].tokens == _reference(model_and_params, prompt)
+    alloc.free(snap)
+    assert alloc.num_used == 0
+
+
+def test_prefix_cache_interaction(model_and_params, plain_engine):
+    """Speculation composes with the prefix cache: blocks filled under
+    speculative commits still park/register on release, a second wave with
+    the same system prompt hits them, and outputs stay identical to a
+    cache-off, speculation-off engine."""
+    shared = _prompt(80, 12)
+    def wave(tag):
+        return [Request(f"{tag}{i}", shared + _prompt(90 + i, 3), 5)
+                for i in range(3)]
+    eng = _engine(model_and_params, prefix_cache=True)
+    eng.run(wave("a"))
+    eng.run(wave("b"))
+    assert eng.prefix_cache.stats()["hit_tokens"] > 0
+
+    plain_engine.run(wave("a"))
+    for i in range(3):
+        assert (eng.outputs[f"a{i}"].tokens
+                == plain_engine.outputs[f"a{i}"].tokens)
+        assert eng.outputs[f"b{i}"].tokens == eng.outputs[f"a{i}"].tokens
+
+
+def test_preemption_mid_burst_restores_identical_tokens(model_and_params,
+                                                        plain_engine):
+    """Starving the pool preempts speculating requests mid-burst (draft state
+    dropped, target pages released, full-restart recompute) — outputs must
+    equal an un-starved speculation-off engine's exactly."""
+    reqs = [Request(f"p{i}", _prompt(100 + i, 9), L) for i in range(4)]
+    small = _engine(model_and_params, num_blocks=13)
+    outs_small, _ = small.run([Request(r.req_id, list(r.prompt), L)
+                               for r in reqs])
+    plain_engine.run([Request(r.req_id, list(r.prompt), L) for r in reqs])
+    assert sum(o.preemptions for o in outs_small) > 0
+    for r in reqs:
+        assert (small.outputs[r.req_id].tokens
+                == plain_engine.outputs[r.req_id].tokens), r.req_id
+    assert small._spec.pool_stats()["used"] == 0
+
+
+def test_warm_restart_mid_burst_token_identity(model_and_params, spec_engine,
+                                               plain_engine):
+    """state_dict() mid-burst drops draft state (best-effort by design); the
+    restored replica re-drafts from committed context and the outputs still
+    match a speculation-off run."""
+    reqs = [Request(f"w{i}", _prompt(110 + i, 7), L) for i in range(4)]
+    a = _engine(model_and_params)
+    for r in reqs:
+        a.submit(Request(r.req_id, list(r.prompt), L))
+    for _ in range(6):                      # mid-burst: some decode progress
+        a.step()
+    state = a.state_dict()
+    assert a._spec.pool_stats()["used"] == 0    # drop_all ran
+
+    b = spec_engine                         # same geometry; idle, reusable
+    b.load_state_dict(state)
+    while not b.scheduler.idle:
+        b.step()
+    plain_engine.run([Request(r.req_id, list(r.prompt), L) for r in reqs])
+    for r in reqs:
+        assert (b.outputs[r.req_id].tokens
+                == plain_engine.outputs[r.req_id].tokens), r.req_id
+
+
+def test_zero_recompiles_all_spec_programs(model_and_params):
+    """The mixed greedy/beam/sampled trace exercises drafting, verification
+    and the ride-along lanes — every spec program (target verify + draft
+    decode/prefill) must compile exactly once."""
+    from deepspeed_tpu.utils.monitor import SummaryMonitor
+    session = TelemetrySession(monitor=SummaryMonitor(enabled=False))
+    eng = _engine(model_and_params, telemetry=session)
+    reqs = synth_trace(10, vocab_size=64, max_model_len=ML, seed=3)
+    outs, _ = eng.run(reqs)
+    assert all(o.status == "finished" for o in outs)
+    assert eng.spec_summary()["spec_rounds"] > 0
+
+    served = [n for n in session.watchdog.records if n.startswith("serve:")]
+    for name in ("serve:spec_verify", "serve:spec_draft_decode",
+                 "serve:spec_draft_prefill"):
+        assert name in served, name
+    for name in served:
+        assert session.watchdog.compiles(name) == 1, name
+        assert session.watchdog.recompiles(name) == 0, name
+
+
+def test_paged_program_cache_shared_across_engines(model_and_params,
+                                                   spec_engine, plain_engine):
+    """Engines over the same model and geometry share one program set (the
+    build memo in serve/paged.py) — a warm restart or test fleet pays XLA
+    once per process. Different geometry (here: speculation on/off, which
+    changes verify_width) still builds its own."""
+    eng = _engine(model_and_params)
+    assert eng._raw is spec_engine._raw
+    assert eng._raw is not plain_engine._raw
+    model, params = model_and_params
+    other = _engine((model, params), speculate=False)
+    assert other._raw is plain_engine._raw
+
+
+def test_mirror_oracle_refused_with_speculation(model_and_params):
+    """The D-wide verify is argmax-identical but not bitwise-identical to the
+    1-wide decode step (ulp fusion drift), so the bitwise mirror oracle and
+    speculation are mutually exclusive — refuse loudly, don't fail the
+    bitwise assert mysteriously later."""
+    with pytest.raises(ValueError, match="mirror"):
+        _engine(model_and_params, mirror=True)
