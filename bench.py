@@ -96,6 +96,12 @@ REGRESSION_KEYS = (
     "extra.serving_speculative.target_steps_per_token",
     "extra.serving_1p5b_spec.spec_acceptance_rate",
     "extra.serving_1p5b_spec.target_steps_per_token",
+    # fleet router (docs/serving.md): merged tail latency across replicas,
+    # shed share under the seeded burst, and the merged goodput fraction
+    # after the scripted warm failover — p99/shed lower-is-better
+    "extra.serving_fleet.fleet_p99_ttft_ms",
+    "extra.serving_fleet.shed_rate",
+    "extra.serving_fleet.goodput_fleet_fraction",
     # resilience ledger: caller-thread checkpoint stall and the warm/cold
     # restart TTFT ratio (docs/resilience.md) — both lower-is-better
     "extra.resilience.checkpoint_stall_ms",
@@ -116,6 +122,8 @@ LOWER_IS_BETTER_KEYS = frozenset(
         "extra.goodput.badput_checkpoint_pct",
         "extra.serving_speculative.target_steps_per_token",
         "extra.serving_1p5b_spec.target_steps_per_token",
+        "extra.serving_fleet.fleet_p99_ttft_ms",
+        "extra.serving_fleet.shed_rate",
     })
 
 
@@ -762,6 +770,94 @@ def bench_serving_speculative_smoke():
         max_model_len=64, prefill_chunk=16, shared_prefix=24, speculate=4)
 
 
+def bench_serving_fleet_summary(cfg_kwargs, *, replicas, n_requests, num_slots,
+                                block_size, num_blocks, max_model_len,
+                                prefill_chunk, param_dtype=None, seed=11,
+                                shared_prefix=0, max_queue_depth=0, kills=()):
+    """Fleet-router serving summary (docs/serving.md): N replicas sharing one
+    model/params object behind the prefix-affinity FleetRouter, a seeded
+    shared-prefix trace routed through it, and a scripted warm failover —
+    reports the fleet-MERGED TTFT/TPOT percentiles (exact sketch fold), the
+    shed rate under the queue-depth bound, and the merged goodput_fleet
+    fraction after the kills bill their restart_replay badput. Runs OUTSIDE
+    the headline windows like the single-replica serving smokes."""
+    import shutil
+    import tempfile
+
+    import jax
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.serve.engine import InferenceEngine
+    from deepspeed_tpu.serve.router import FleetRouter
+    from deepspeed_tpu.serve.sim import synth_trace
+    from deepspeed_tpu.utils.monitor import SummaryMonitor
+    from deepspeed_tpu.utils.telemetry import TelemetrySession
+
+    cfg = GPT2Config(**cfg_kwargs)
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    if param_dtype is not None:
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(param_dtype) if p.ndim >= 2 else p, params)
+    # disabled monitor: the recompile watchdog is wanted, scalar files are not
+    session = TelemetrySession(monitor=SummaryMonitor(enabled=False))
+
+    def build(slot, telemetry=None):
+        return InferenceEngine(
+            model, params, num_slots=num_slots, block_size=block_size,
+            num_blocks=num_blocks, max_model_len=max_model_len,
+            prefill_chunk=prefill_chunk, prefix_cache=True,
+            telemetry=telemetry,
+            request_trace={"enabled": True,
+                           "capacity": max(n_requests + 1, 256),
+                           "host_id": slot})
+
+    engines = [build(s, session if s == 0 else None) for s in range(replicas)]
+    snap = tempfile.mkdtemp(prefix="ds_bench_fleet_") if kills else None
+    router = FleetRouter(
+        engines, max_queue_depth=max_queue_depth,
+        kill_schedule=list(kills), snapshot_dir=snap,
+        build_replacement=(lambda slot: build(slot)) if kills else None,
+        telemetry=session, run_id=f"bench_fleet{replicas}")
+    reqs = synth_trace(n_requests, vocab_size=cfg.vocab_size,
+                       max_model_len=max_model_len, seed=seed,
+                       shared_prefix_len=shared_prefix)
+    t0 = time.time()
+    outs, _ = router.run(reqs)
+    wall = max(time.time() - t0, 1e-9)
+    if snap:
+        shutil.rmtree(snap, ignore_errors=True)
+    summary = router.fleet_summary()
+    lat = summary["latency"]
+    fin = [o for o in outs if o.status == "finished"]
+    recompiles = sum(session.watchdog.recompiles(n)
+                     for n in session.watchdog.records
+                     if n.startswith("serve:"))
+    return {"replicas": replicas, "requests": len(reqs),
+            "finished": len(fin), "shed": summary["shed"],
+            "kills": summary["kills"], "wall_s": round(wall, 2),
+            "goodput_tok_s": round(sum(len(o.tokens) for o in fin) / wall, 1),
+            **{f"fleet_{k}": round(v, 2) for k, v in lat.items()},
+            "fleet_p99_ttft_ms": round(lat.get("ttft_ms_p99", 0.0), 2),
+            "shed_rate": round(summary["shed"] / max(len(reqs), 1), 4),
+            "goodput_fleet_fraction": round(
+                summary["goodput_fleet"]["goodput_fraction"], 4),
+            "prefill_chunks": summary["prefill_chunks"],
+            "total_prefill_chunks": summary["total_prefill_chunks"],
+            "decode_recompiles_after_warmup": recompiles}
+
+
+def bench_serving_fleet_smoke():
+    """CPU smoke of the fleet summary: 3 tiny replicas, a shared-prefix
+    trace, one scripted warm kill, and a queue-depth bound tight enough to
+    exercise (but not saturate) the shed path."""
+    return bench_serving_fleet_summary(
+        dict(vocab_size=256, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+             loss_chunk=0),
+        replicas=3, n_requests=16, num_slots=4, block_size=8, num_blocks=33,
+        max_model_len=64, prefill_chunk=16, shared_prefix=24,
+        max_queue_depth=8, kills=((6, 0),))
+
+
 def bench_resilience_smoke():
     """Resilience smoke (docs/resilience.md): measures what the async
     checkpointer actually costs the step — median step wall time with a
@@ -938,6 +1034,21 @@ def bench_serving_1p5b_spec():
         shared_prefix=256, speculate=4,
         draft_cfg_kwargs=dict(vocab_size=50304, n_positions=1024, n_embd=1024,
                               n_layer=24, n_head=16, use_flash_attention=True))
+    gc.collect()
+    return out
+
+
+def bench_serving_420m_fleet():
+    """420M bf16 fleet: 3 replicas behind the prefix-affinity router, a
+    shared-system-prompt trace, and one scripted warm failover — the fleet
+    tail-latency / shed-rate / goodput_fleet row of the regression ledger."""
+    import jax.numpy as jnp
+    out = bench_serving_fleet_summary(
+        dict(vocab_size=50304, n_positions=1024, n_embd=1024, n_layer=24,
+             n_head=16, use_flash_attention=True),
+        replicas=3, n_requests=32, num_slots=8, block_size=16, num_blocks=513,
+        max_model_len=1024, prefill_chunk=128, param_dtype=jnp.bfloat16,
+        shared_prefix=256, max_queue_depth=16, kills=((8, 0),))
     gc.collect()
     return out
 
@@ -1301,6 +1412,10 @@ def main():
         except Exception as e:
             serving_spec = {"error": f"{type(e).__name__}: {e}"}
         try:
+            serving_fleet = bench_serving_fleet_smoke()
+        except Exception as e:
+            serving_fleet = {"error": f"{type(e).__name__}: {e}"}
+        try:
             resilience = bench_resilience_smoke()
         except Exception as e:
             resilience = {"error": f"{type(e).__name__}: {e}"}
@@ -1323,6 +1438,7 @@ def main():
                             "serving_prefix_cache": serving_prefix,
                             "serving_sharded": serving_sharded,
                             "serving_speculative": serving_spec,
+                            "serving_fleet": serving_fleet,
                             "resilience": resilience,
                             "goodput": goodput}}
         result["extra"]["regression_vs_previous_round"] = \
@@ -1386,6 +1502,10 @@ def main():
         extra["serving_1p5b_spec"] = bench_serving_1p5b_spec()
     except Exception as e:
         extra["serving_1p5b_spec"] = {"error": f"{type(e).__name__}: {e}"}
+    try:  # 3-replica fleet router: merged tails, shed rate, goodput_fleet
+        extra["serving_fleet"] = bench_serving_420m_fleet()
+    except Exception as e:
+        extra["serving_fleet"] = {"error": f"{type(e).__name__}: {e}"}
     try:  # run-lifecycle goodput fraction + checkpoint badput share
         extra["goodput"] = bench_goodput_smoke()
     except Exception as e:

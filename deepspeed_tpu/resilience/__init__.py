@@ -24,8 +24,8 @@ from .async_ckpt import AsyncCheckpointer
 from .auto_resume import auto_resume, find_resume_point
 from .elastic import restore_comm_ef
 from .serve_restart import (restore_server, save_server, server_state_dict,
-                            load_server_state)
+                            load_server_state, failover_server)
 
 __all__ = ["AsyncCheckpointer", "auto_resume", "find_resume_point",
            "restore_comm_ef", "restore_server", "save_server",
-           "server_state_dict", "load_server_state"]
+           "server_state_dict", "load_server_state", "failover_server"]

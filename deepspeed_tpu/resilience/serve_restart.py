@@ -49,8 +49,11 @@ def save_server(engine, save_dir: str, tag: str = "serve") -> str:
     """Snapshot ``engine`` and commit it under ``save_dir/tag/`` atomically.
     Returns the committed directory path."""
     state = server_state_dict(engine)
-    k_pool = state.pop("k_pool")
-    v_pool = state.pop("v_pool")
+    # npz can't round-trip ml_dtypes pools (bfloat16 loads back as raw V2);
+    # store widened to float32 — exact for every serving compute dtype, a
+    # no-op for float32 pools — and let load_state_dict cast back down
+    k_pool = np.asarray(state.pop("k_pool"), np.float32)
+    v_pool = np.asarray(state.pop("v_pool"), np.float32)
     final_dir = os.path.join(save_dir, tag)
     tmp_dir = final_dir + TMP_SUFFIX
     if os.path.isdir(tmp_dir):
@@ -109,3 +112,21 @@ def restore_server(engine, ckpt_dir: str) -> bool:
                 f"{ckpt_dir} (it={engine._it}, "
                 f"{len(engine.scheduler.waiting)} requests requeued)")
     return True
+
+
+def failover_server(engine, build_replacement, save_dir: str,
+                    tag: str = "serve"):
+    """Fleet warm failover: snapshot ``engine`` (quiescing it — in-flight
+    prefill frontiers park in the prefix cache), build a replacement replica
+    via ``build_replacement()``, and restore the snapshot into it, so the
+    successor rejoins with the KV pool and requeued requests intact. Returns
+    the restored replacement. Raises RuntimeError if the just-written
+    snapshot is refused (torn mid-failover means the host is failing, not
+    the request stream — the router must not silently drop work)."""
+    ckpt_dir = save_server(engine, save_dir, tag=tag)
+    replacement = build_replacement()
+    if not restore_server(replacement, ckpt_dir):
+        raise RuntimeError(
+            f"fleet failover: snapshot {ckpt_dir} refused immediately after "
+            "commit — aborting instead of dropping in-flight requests")
+    return replacement

@@ -510,6 +510,58 @@ class DeepSpeedConfig:
                 "be 0 (inherit serving.num_blocks) or an int >= 2, "
                 f"got {val!r}")
 
+        fl_dict = sv_dict.get(SERVING_FLEET, {}) or {}
+        self._warn_unknown_nested(f"{SERVING}.{SERVING_FLEET}",
+                                  fl_dict, SERVING_FLEET_CONFIG_KEYS)
+        self.serving_fleet_replicas = get_scalar_param(
+            fl_dict, SERVING_FLEET_REPLICAS, SERVING_FLEET_REPLICAS_DEFAULT)
+        self.serving_fleet_policy = get_scalar_param(
+            fl_dict, SERVING_FLEET_POLICY, SERVING_FLEET_POLICY_DEFAULT)
+        self.serving_fleet_affinity_weight = get_scalar_param(
+            fl_dict, SERVING_FLEET_AFFINITY_WEIGHT,
+            SERVING_FLEET_AFFINITY_WEIGHT_DEFAULT)
+        self.serving_fleet_max_queue_depth = get_scalar_param(
+            fl_dict, SERVING_FLEET_MAX_QUEUE_DEPTH,
+            SERVING_FLEET_MAX_QUEUE_DEPTH_DEFAULT)
+        self.serving_fleet_occupancy_cap = get_scalar_param(
+            fl_dict, SERVING_FLEET_OCCUPANCY_CAP,
+            SERVING_FLEET_OCCUPANCY_CAP_DEFAULT)
+        self.serving_fleet_goodput_floor = get_scalar_param(
+            fl_dict, SERVING_FLEET_GOODPUT_FLOOR,
+            SERVING_FLEET_GOODPUT_FLOOR_DEFAULT)
+        val = self.serving_fleet_replicas
+        if isinstance(val, bool) or not isinstance(val, int) or val < 1:
+            raise ValueError(
+                "DeepSpeedConfig: serving.fleet.replicas must be an int >= 1 "
+                f"(1 = no fleet, a single replica), got {val!r}")
+        if self.serving_fleet_policy not in SERVING_FLEET_POLICIES:
+            raise ValueError(
+                f"DeepSpeedConfig: serving.fleet.policy must be one of "
+                f"{SERVING_FLEET_POLICIES}, got "
+                f"{self.serving_fleet_policy!r}")
+        val = self.serving_fleet_affinity_weight
+        if isinstance(val, bool) or not isinstance(val, (int, float)) or val < 0:
+            raise ValueError(
+                "DeepSpeedConfig: serving.fleet.affinity_weight must be a "
+                f"number >= 0 (0 = pure least-loaded), got {val!r}")
+        val = self.serving_fleet_max_queue_depth
+        if isinstance(val, bool) or not isinstance(val, int) or val < 0:
+            raise ValueError(
+                "DeepSpeedConfig: serving.fleet.max_queue_depth must be an "
+                f"int >= 0 (0 = unbounded), got {val!r}")
+        val = self.serving_fleet_occupancy_cap
+        if isinstance(val, bool) or not isinstance(val, (int, float)) or (
+                not 0.0 < val <= 1.0):
+            raise ValueError(
+                "DeepSpeedConfig: serving.fleet.occupancy_cap must be a "
+                f"number in (0, 1] (1 = occupancy shedding off), got {val!r}")
+        val = self.serving_fleet_goodput_floor
+        if isinstance(val, bool) or not isinstance(val, (int, float)) or (
+                not 0.0 <= val <= 1.0):
+            raise ValueError(
+                "DeepSpeedConfig: serving.fleet.goodput_floor must be a "
+                f"number in [0, 1] (0 = not gated), got {val!r}")
+
         cm_dict = param_dict.get(COMM, {})
         self._warn_unknown_nested(COMM, cm_dict, COMM_CONFIG_KEYS)
         self.comm_mode = get_scalar_param(cm_dict, COMM_MODE, COMM_MODE_DEFAULT)

@@ -371,6 +371,36 @@ SERVING_SPECULATION_MAX_DRAFT_TOKENS = "max_draft_tokens"
 SERVING_SPECULATION_MAX_DRAFT_TOKENS_DEFAULT = 4
 SERVING_SPECULATION_DRAFT_POOL_BLOCKS = "draft_pool_blocks"
 SERVING_SPECULATION_DRAFT_POOL_BLOCKS_DEFAULT = 0
+# serving.fleet — the N-replica serving front end (serve/router.py): one
+# deterministic router owns "replicas" engine replicas and schedules every
+# arrival. "policy" picks the routing rule — prefix-affinity (longest
+# cached-prefix match, SGLang's cache-aware-routing insight, weighted against
+# load by "affinity_weight"), pure least-loaded, or round-robin (the
+# comparison baseline). "max_queue_depth" bounds each replica's waiting queue
+# (0 = unbounded) and "occupancy_cap" caps its KV-pool used fraction; an
+# arrival no replica can admit under those caps is SHED — a RequestOutput
+# with status "shed", recorded in the request trace, never a crash.
+# "goodput_floor" gates the merged fleet goodput fraction in `ds-tpu
+# serve-sim --fleet` (0 = not gated).
+SERVING_FLEET = "fleet"
+SERVING_FLEET_REPLICAS = "replicas"
+SERVING_FLEET_REPLICAS_DEFAULT = 1
+SERVING_FLEET_POLICY = "policy"
+SERVING_FLEET_POLICY_AFFINITY = "affinity"
+SERVING_FLEET_POLICY_LEAST_LOADED = "least_loaded"
+SERVING_FLEET_POLICY_ROUND_ROBIN = "round_robin"
+SERVING_FLEET_POLICIES = (SERVING_FLEET_POLICY_AFFINITY,
+                          SERVING_FLEET_POLICY_LEAST_LOADED,
+                          SERVING_FLEET_POLICY_ROUND_ROBIN)
+SERVING_FLEET_POLICY_DEFAULT = SERVING_FLEET_POLICY_AFFINITY
+SERVING_FLEET_AFFINITY_WEIGHT = "affinity_weight"
+SERVING_FLEET_AFFINITY_WEIGHT_DEFAULT = 1.0
+SERVING_FLEET_MAX_QUEUE_DEPTH = "max_queue_depth"
+SERVING_FLEET_MAX_QUEUE_DEPTH_DEFAULT = 0
+SERVING_FLEET_OCCUPANCY_CAP = "occupancy_cap"
+SERVING_FLEET_OCCUPANCY_CAP_DEFAULT = 1.0
+SERVING_FLEET_GOODPUT_FLOOR = "goodput_floor"
+SERVING_FLEET_GOODPUT_FLOOR_DEFAULT = 0.0
 
 #############################################
 # Comm (hierarchical ICI+DCN collectives)
@@ -616,6 +646,16 @@ SERVING_CONFIG_KEYS = frozenset({
     SERVING_SHARDING,
     SERVING_PREFIX_CACHE,
     SERVING_SPECULATION,
+    SERVING_FLEET,
+})
+
+SERVING_FLEET_CONFIG_KEYS = frozenset({
+    SERVING_FLEET_REPLICAS,
+    SERVING_FLEET_POLICY,
+    SERVING_FLEET_AFFINITY_WEIGHT,
+    SERVING_FLEET_MAX_QUEUE_DEPTH,
+    SERVING_FLEET_OCCUPANCY_CAP,
+    SERVING_FLEET_GOODPUT_FLOOR,
 })
 
 SERVING_SHARDING_CONFIG_KEYS = frozenset({

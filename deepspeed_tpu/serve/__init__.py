@@ -27,6 +27,7 @@ tests and ``ds-tpu serve-sim`` bit-compare the paged path against.
 _EXPORTS = {
     "AllocationError": ".block_allocator",
     "BlockAllocator": ".block_allocator",
+    "FleetRouter": ".router",
     "InferenceEngine": ".engine",
     "Request": ".scheduler",
     "RequestOutput": ".scheduler",

@@ -781,6 +781,35 @@ class InferenceEngine:
                 self._advance_steps / max(self._tokens_sampled, 1),
         }
 
+    # ------------------------------------------------------- fleet hooks
+    def prefix_peek(self, prompt):
+        """Read-only fleet-router probe: ``(hit_blocks, hit_tokens)`` this
+        replica's prefix cache would serve for ``prompt`` — no stats are
+        touched, no blocks are revived, so peeking every replica per arrival
+        is free. ``(0, 0)`` when the cache is disabled."""
+        if self.prefix_cache is None:
+            return (0, 0)
+        blocks, hit_tokens = self.prefix_cache.peek(prompt)
+        return (len(blocks), hit_tokens)
+
+    def load_view(self) -> dict:
+        """Host-side load snapshot for fleet admission/balance decisions:
+        queue depth, lane usage, and pool headroom, all exact counters the
+        scheduler already maintains (no device sync)."""
+        return {"waiting": len(self.scheduler.waiting),
+                "running": len(self.scheduler.running),
+                "free_slots": len(self.scheduler.free_slots),
+                "free_blocks": self.scheduler.allocator.num_free,
+                "num_blocks": self.num_blocks,
+                "it": self._it}
+
+    def fast_forward(self, it: int):
+        """Advance the iteration clock without stepping — the fleet router
+        keeps all replicas on one timebase, so a cold replacement joining at
+        router iteration ``it`` must not restart from 0 (its arrivals and
+        latency iteration-counts would otherwise be skewed)."""
+        self._it = max(self._it, int(it))
+
     # ------------------------------------------------------- warm restart
     _OUT_FIELDS = ("req_id", "status", "tokens", "score", "refusal",
                    "ttft_iters", "ttft_ms", "finished_it", "preemptions")

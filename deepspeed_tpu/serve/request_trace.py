@@ -59,6 +59,7 @@ SLO_METRICS = ("ttft_ms", "tpot_ms")
 # [name, iteration, rel_us, *args] (iteration -1 = outside the step loop)
 EV_SUBMIT = "submit"
 EV_REFUSED = "refused"          # args: reason
+EV_SHED = "shed"                # args: reason (fleet router load shedding)
 EV_ADMIT = "admit"              # args: lanes, queue_delay_iters
 EV_CACHE_HIT = "cache_hit"      # args: cached_prefix_tokens (prefix reuse)
 EV_PREFILL = "prefill"          # args: pos, n, replayed
@@ -198,6 +199,7 @@ class RequestTracer:
         self.slo_met = 0
         self.slo_violated = 0
         self.refused = 0
+        self.shed = 0
         self.finished = 0
         self.preemptions = 0
         self._epoch = time.perf_counter()
@@ -234,6 +236,18 @@ class RequestTracer:
         self._event(rec, EV_REFUSED, -1, reason)
         rec["status"] = "refused"
         self.refused += 1
+        self.requests.append(rec)
+        return rec
+
+    def on_shed(self, req, reason):
+        # fleet-router admission control: same refusal-not-crash ledger shape
+        # as on_refused, but counted separately — shedding is a routing-policy
+        # outcome (fleet saturated), not an engine capacity error
+        rec = self.live.pop(req.req_id, None) or self.on_submit(req)
+        self.live.pop(req.req_id, None)
+        self._event(rec, EV_SHED, -1, reason)
+        rec["status"] = "shed"
+        self.shed += 1
         self.requests.append(rec)
         return rec
 
@@ -446,7 +460,7 @@ class RequestTracer:
             "iterations": list(self.iterations),
             "totals": dict(self.totals),
             "counts": {"finished": self.finished, "refused": self.refused,
-                       "preemptions": self.preemptions},
+                       "shed": self.shed, "preemptions": self.preemptions},
             # mergeable latency sketches: N replica bundles combine exactly
             # into fleet percentiles (utils/cluster.fleet_latency_summary)
             "latency_sketches": {m: self.hist[m].to_dict()
@@ -584,6 +598,11 @@ def to_serve_trace_events(bundle, us_per_iter=1000):
             elif name == EV_REFUSED:
                 events.append(instant_event(0, tid, ts_of(it, rec["arrival"]),
                                             "refused", {"reason": ev[3]}))
+            elif name == EV_SHED:
+                # only ever present in fleet-router front-door ledgers, so
+                # single-engine exports (the golden-file contract) are unchanged
+                events.append(instant_event(0, tid, ts_of(it, rec["arrival"]),
+                                            "shed", {"reason": ev[3]}))
         flush_run()
 
     sched_tokens = 0

@@ -107,7 +107,7 @@ class RequestOutput:
                  ttft_iters=None, ttft_ms=None, finished_it=None,
                  preemptions=0):
         self.req_id = req_id
-        self.status = status            # "finished" | "refused"
+        self.status = status            # "finished" | "refused" | "shed"
         self.tokens = tokens or []      # generated tokens (best beam)
         self.score = score              # beam: GNMT-normalized score
         self.refusal = refusal          # refusal reason when status=="refused"

@@ -31,14 +31,18 @@ import sys
 
 
 def synth_trace(n, *, vocab_size, max_model_len, seed, beam_every=7,
-                include_infeasible=False, shared_prefix_len=0):
+                include_infeasible=False, shared_prefix_len=0,
+                arrival_scale=1.0):
     """Seeded mixed trace: prompts 1..~ML/2, generations 1..~ML/4, arrivals
     staggered 0-2 iterations apart, every ``beam_every``-th request beam-4.
 
     With ``shared_prefix_len > 0`` every prompt starts with the SAME seeded
     ``shared_prefix_len``-token system prompt followed by a per-request tail —
-    the canonical prefix-cache workload. The default path draws nothing extra,
-    so existing seeded traces (and their goldens) are untouched."""
+    the canonical prefix-cache workload. ``arrival_scale`` scales the seeded
+    inter-arrival gaps (0.0 = every request arrives at once, the
+    past-saturation fleet workload) without perturbing the RNG stream. The
+    default path draws nothing extra, so existing seeded traces (and their
+    goldens) are untouched."""
     import numpy as np
     from .scheduler import Request
 
@@ -50,7 +54,7 @@ def synth_trace(n, *, vocab_size, max_model_len, seed, beam_every=7,
     system_prompt = rng.randint(0, vocab_size, size=P).tolist() if P else []
     reqs, arrival = [], 0
     for i in range(n):
-        arrival += int(rng.randint(0, 3))
+        arrival += int(int(rng.randint(0, 3)) * arrival_scale)
         T0 = P + int(rng.randint(1, max(2, (max_model_len - P) // 2)))
         L = int(rng.randint(1, max(2, max_model_len // 4)))
         if T0 + L > max_model_len:          # keep the trace feasible
@@ -73,11 +77,26 @@ def _p50(values):
     return vals[len(vals) // 2] if vals else None
 
 
-def _build(args, telemetry, prefix_cache=None, sharding=None, speculate=None):
+def _model_params(args):
+    """Build the sim model + params once — fleet replicas must SHARE the
+    model object so the paged program set builds (and compiles) once for the
+    whole fleet (the serve/paged.py build memo keys on it)."""
     import jax
     import jax.numpy as jnp
 
     from ..models.gpt2 import GPT2Config, GPT2Model
+
+    cfg = GPT2Config(vocab_size=args.vocab_size, n_positions=args.max_model_len,
+                     n_embd=args.n_embd, n_layer=args.n_layer,
+                     n_head=args.n_head, compute_dtype=jnp.float32,
+                     loss_chunk=0)
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(args.seed))
+    return model, params
+
+
+def _build(args, telemetry, prefix_cache=None, sharding=None, speculate=None,
+           model_params=None, host_id=0):
     from .engine import InferenceEngine
 
     pc = args.prefix_cache if prefix_cache is None else prefix_cache
@@ -87,14 +106,12 @@ def _build(args, telemetry, prefix_cache=None, sharding=None, speculate=None):
     # prefills / reduction-order drift / multi-token commits), and the
     # engine constructor enforces that
     mirror = not args.no_mirror and not pc and tp <= 1 and not spec_k
-    cfg = GPT2Config(vocab_size=args.vocab_size, n_positions=args.max_model_len,
-                     n_embd=args.n_embd, n_layer=args.n_layer,
-                     n_head=args.n_head, compute_dtype=jnp.float32,
-                     loss_chunk=0)
-    model = GPT2Model(cfg)
-    params = model.init(jax.random.PRNGKey(args.seed))
+    model, params = (model_params if model_params is not None
+                     else _model_params(args))
     speculation = None
     if spec_k:
+        import jax
+
         # self-draft by default (same model + params -> near-total acceptance,
         # the deterministic upper bound the strict-step gate relies on); a
         # non-negative --spec-draft-seed re-draws the draft params so the
@@ -114,6 +131,7 @@ def _build(args, telemetry, prefix_cache=None, sharding=None, speculate=None):
             "enabled": True,
             "capacity": max(args.requests + 1, 256),
             "slo": {"ttft_ms": args.slo_ttft_ms, "tpot_ms": args.slo_tpot_ms},
+            "host_id": host_id,
         })
     return engine
 
@@ -122,7 +140,8 @@ def _trace(args):
     return synth_trace(args.requests, vocab_size=args.vocab_size,
                        max_model_len=args.max_model_len, seed=args.seed,
                        include_infeasible=args.include_infeasible,
-                       shared_prefix_len=args.shared_prefix)
+                       shared_prefix_len=args.shared_prefix,
+                       arrival_scale=args.arrival_scale)
 
 
 def _report(args, trace, outputs, logs, tracer, waste, slo, failures,
@@ -189,6 +208,300 @@ def _report(args, trace, outputs, logs, tracer, waste, slo, failures,
     return {"version": 1, "kind": "serve_sim_report",
             "deterministic": det, "wall": wall,
             "failures": list(failures)}
+
+
+def _parse_kill(ap, spec, fleet):
+    try:
+        it_s, slot_s = spec.split(":")
+        it, slot = int(it_s), int(slot_s)
+    except ValueError:
+        ap.error(f"--kill wants IT:REPLICA, got {spec!r}")
+    if not fleet:
+        ap.error("--kill needs --fleet N")
+    if not 0 <= slot < fleet:
+        ap.error(f"--kill replica {slot} out of range for --fleet {fleet}")
+    if it < 0:
+        ap.error(f"--kill iteration must be >= 0, got {it}")
+    return (it, slot)
+
+
+def _run_fleet(args, session, model_params, *, policy, cold_failover,
+               snapshot_dir):
+    """One fleet pass over the seeded trace: N fresh replicas sharing one
+    model/params (one program build for the whole fleet), replica 0 carrying
+    the telemetry session (a second replica registering the same program
+    signature would read as a recompile to the watchdog)."""
+    from .request_trace import RequestTracer
+    from .router import FleetRouter
+
+    engines = [_build(args, session if slot == 0 else None,
+                      prefix_cache=True, model_params=model_params,
+                      host_id=slot)
+               for slot in range(args.fleet)]
+
+    def build_replacement(slot):
+        return _build(args, None, prefix_cache=True,
+                      model_params=model_params, host_id=slot)
+
+    front = RequestTracer(capacity=max(args.requests + 1, 256),
+                          host_id=args.fleet)
+    router = FleetRouter(
+        engines, policy=policy, affinity_weight=args.affinity_weight,
+        max_queue_depth=args.max_queue_depth,
+        occupancy_cap=args.occupancy_cap, kill_schedule=args.kill,
+        build_replacement=build_replacement, snapshot_dir=snapshot_dir,
+        cold_failover=cold_failover, telemetry=session, tracer=front,
+        run_id=f"fleet_seed{args.seed}")
+    outputs, transcript = router.run(_trace(args))
+    return router, outputs, transcript
+
+
+def _fleet_single_stream(bundles, ps=(50, 95, 99)):
+    """Percentiles of ONE sketch stream over every finished record in every
+    bundle — the ground truth the merged fleet sketches must bitwise equal
+    (the HistogramSketch mergeability contract, asserted every fleet run)."""
+    from .request_trace import HistogramSketch, LATENCY_METRICS
+    singles = {m: HistogramSketch() for m in LATENCY_METRICS}
+    for b in bundles:
+        for rec in (b or {}).get("requests") or []:
+            if rec.get("status") == "finished":
+                for m in LATENCY_METRICS:
+                    singles[m].add(rec.get(m))
+    out = {}
+    for m in sorted(singles):
+        if not singles[m].count:
+            continue
+        for p in ps:
+            out[f"{m}_p{p:g}"] = singles[m].percentile(p)
+    return out
+
+
+def _fleet_main(args):
+    import tempfile
+
+    from ..utils.cluster import fleet_latency_summary, fleet_serving_totals
+    from ..utils.telemetry import TelemetrySession
+
+    if args.compare_cold_failover and not args.kill:
+        print("serve-sim: --compare-cold-failover needs --kill",
+              file=sys.stderr)
+        return 2
+
+    trace = _trace(args)
+    session = TelemetrySession(output_path=args.output, job_name="serve_sim")
+    model_params = _model_params(args)
+    snapshot_dir = args.snapshot_dir or tempfile.mkdtemp(
+        prefix="ds_tpu_fleet_snap_")
+
+    router, outputs, transcript = _run_fleet(
+        args, session, model_params, policy=args.fleet_policy,
+        cold_failover=False, snapshot_dir=snapshot_dir)
+
+    failures = []
+    finished = [o for o in outputs if o.status == "finished"]
+    refused = [o for o in outputs if o.status == "refused"]
+    shed = [o for o in outputs if o.status == "shed"]
+
+    # fleet invariant 1: one compile per program for the WHOLE fleet — the
+    # replicas share the program build, so N replicas cost one compile set
+    serve_names = sorted(n for n in session.watchdog.records
+                         if n.startswith("serve:"))
+    for name in serve_names:
+        n_r = session.watchdog.recompiles(name)
+        if n_r:
+            failures.append(f"{name}: {n_r} recompile(s) after warmup")
+    if not serve_names:
+        failures.append("no serve:* programs reached the compile watchdog")
+
+    # fleet invariant 2: conservation — every submitted request comes back
+    # exactly once, finished or EXPLICITLY refused/shed; kills lose nothing
+    want = sorted(r.req_id for r in trace)
+    got = sorted(o.req_id for o in outputs)
+    if want != got:
+        lost = sorted(set(want) - set(got))
+        dups = len(got) - len(set(got))
+        failures.append(f"request conservation violated: {len(lost)} "
+                        f"lost / {dups} duplicated "
+                        f"({', '.join(lost[:8])})")
+    bad = [o.req_id for o in outputs
+           if o.status not in ("finished", "refused", "shed")]
+    if bad:
+        failures.append(f"unexpected terminal status on {len(bad)} "
+                        f"request(s): {', '.join(bad[:8])}")
+
+    # fleet invariant 3: EXACT fleet percentiles — the merged per-replica
+    # sketches must bitwise-equal the single-stream sketch over the
+    # concatenated ledger (retired replicas and the front door included)
+    bundles = router.bundles()
+    fleet_lat = fleet_latency_summary(bundles, ps=(50, 95, 99))
+    single_lat = _fleet_single_stream(bundles, ps=(50, 95, 99))
+    fleet_merge_exact = fleet_lat == single_lat
+    if not fleet_merge_exact:
+        failures.append("fleet percentile merge diverged from the "
+                        "single-stream sketch over the concatenated ledger")
+
+    # fleet invariant 4: merged goodput floor (kills bill restart_replay
+    # badput on a synthetic per-iteration clock — pure schedule function)
+    gp = router.fleet_goodput()
+    if args.fleet_goodput_floor and not (
+            gp["goodput_fraction"] >= args.fleet_goodput_floor):
+        failures.append(
+            f"goodput_fleet fraction {gp['goodput_fraction']:.4f} under the "
+            f"--fleet-goodput-floor {args.fleet_goodput_floor}")
+
+    # fleet invariant 5: the SLO gate over FLEET-MERGED percentiles
+    if args.slo_ttft_ms and fleet_lat.get("ttft_ms_p99", 0.0) > args.slo_ttft_ms:
+        failures.append(f"fleet ttft_ms_p99 {fleet_lat['ttft_ms_p99']:.2f} "
+                        f"over the {args.slo_ttft_ms} ms SLO")
+    if args.slo_tpot_ms and fleet_lat.get("tpot_ms_p99", 0.0) > args.slo_tpot_ms:
+        failures.append(f"fleet tpot_ms_p99 {fleet_lat['tpot_ms_p99']:.2f} "
+                        f"over the {args.slo_tpot_ms} ms SLO")
+
+    # fleet invariant 6 (optional): affinity must BUY something over
+    # round-robin on this trace — identical tokens, strictly fewer total
+    # prefill chunks (the fleet-wide cache-reuse win), strictly better
+    # fleet p50 TTFT in the deterministic iteration domain
+    affinity_compare = None
+    if args.compare_affinity:
+        router_rr, outs_rr, _ = _run_fleet(
+            args, None, model_params, policy="round_robin",
+            cold_failover=False, snapshot_dir=snapshot_dir)
+        t_aff = {o.req_id: (o.status, o.tokens) for o in outputs}
+        t_rr = {o.req_id: (o.status, o.tokens) for o in outs_rr}
+        if t_aff != t_rr:
+            diff = sorted(r for r in t_aff if t_aff[r] != t_rr.get(r))
+            failures.append(
+                f"routing policy changed tokens on {len(diff)} request(s): "
+                f"{', '.join(diff[:8])}")
+        chunks_aff = sum(router.prefill_chunks)
+        chunks_rr = sum(router_rr.prefill_chunks)
+        p50_aff = _p50(o.ttft_iters for o in outputs
+                       if o.status == "finished")
+        p50_rr = _p50(o.ttft_iters for o in outs_rr
+                      if o.status == "finished")
+        affinity_compare = {
+            "prefill_chunks": {"affinity": chunks_aff,
+                               "round_robin": chunks_rr},
+            "ttft_p50_iters": {"affinity": p50_aff, "round_robin": p50_rr},
+        }
+        if not chunks_aff < chunks_rr:
+            failures.append(
+                f"affinity routing did not strictly reduce prefill chunks: "
+                f"{chunks_aff} vs round-robin {chunks_rr}")
+        if p50_aff is None or p50_rr is None or not p50_aff < p50_rr:
+            failures.append(
+                f"affinity routing did not strictly improve fleet p50 TTFT: "
+                f"{p50_aff} vs round-robin {p50_rr} iters")
+
+    # fleet invariant 7 (optional): warm failover must strictly beat a cold
+    # successor on the same kill schedule — identical tokens, fewer chunks
+    failover_compare = None
+    if args.compare_cold_failover:
+        router_cold, outs_cold, _ = _run_fleet(
+            args, None, model_params, policy=args.fleet_policy,
+            cold_failover=True, snapshot_dir=snapshot_dir)
+        t_warm = {o.req_id: (o.status, o.tokens) for o in outputs}
+        t_cold = {o.req_id: (o.status, o.tokens) for o in outs_cold}
+        if t_warm != t_cold:
+            diff = sorted(r for r in t_warm if t_warm[r] != t_cold.get(r))
+            failures.append(
+                f"failover mode changed tokens on {len(diff)} request(s): "
+                f"{', '.join(diff[:8])}")
+        chunks_warm = sum(router.prefill_chunks)
+        chunks_cold = sum(router_cold.prefill_chunks)
+        failover_compare = {"prefill_chunks": {"warm": chunks_warm,
+                                               "cold": chunks_cold}}
+        if not chunks_warm < chunks_cold:
+            failures.append(
+                f"warm failover did not strictly reduce prefill chunks: "
+                f"{chunks_warm} vs cold {chunks_cold}")
+
+    spec_totals = fleet_serving_totals(bundles)
+
+    if args.transcript:
+        with open(args.transcript, "w") as f:
+            f.write(json.dumps(transcript, sort_keys=True,
+                               separators=(",", ":")))
+
+    if args.dump_ledger:
+        router.tracer.dump(args.dump_ledger)
+
+    if args.json_out:
+        det = {
+            "args": {"requests": args.requests, "seed": args.seed,
+                     "fleet": args.fleet, "fleet_policy": args.fleet_policy,
+                     "affinity_weight": args.affinity_weight,
+                     "max_queue_depth": args.max_queue_depth,
+                     "occupancy_cap": args.occupancy_cap,
+                     "arrival_scale": args.arrival_scale,
+                     "shared_prefix": args.shared_prefix,
+                     "kill": [list(k) for k in args.kill],
+                     "speculate": args.speculate},
+            "n_finished": len(finished),
+            "n_refused": len(refused),
+            "n_shed": len(shed),
+            "kills": router.kills_applied,
+            "prefill_chunks": list(router.prefill_chunks),
+            "total_prefill_chunks": sum(router.prefill_chunks),
+            "goodput_fleet_fraction": gp["goodput_fraction"],
+            "fleet_merge_exact": bool(fleet_merge_exact),
+            "serving_totals": spec_totals,
+        }
+        if affinity_compare is not None:
+            det["affinity_compare"] = affinity_compare
+        if failover_compare is not None:
+            det["failover_compare"] = failover_compare
+        report = {"version": 1, "kind": "serve_fleet_report",
+                  "deterministic": det,
+                  "wall": {"fleet_latency": fleet_lat,
+                           "goodput_fleet": gp},
+                  "failures": list(failures)}
+        blob = json.dumps(report, sort_keys=True, separators=(",", ":"))
+        if args.json_out == "-":
+            print(blob)
+        else:
+            with open(args.json_out, "w") as f:
+                f.write(blob)
+
+    session.close()
+
+    print(f"serve-sim: fleet={args.fleet} policy={args.fleet_policy}: "
+          f"{len(finished)} finished / {len(refused)} refused / "
+          f"{len(shed)} shed of {len(trace)} requests, "
+          f"{router.kills_applied} replica kill(s)")
+    print(f"  prefill chunks   : {sum(router.prefill_chunks)} total "
+          f"{list(router.prefill_chunks)} per slot")
+    print(f"  fleet merge      : "
+          f"{'exact' if fleet_merge_exact else 'DIVERGED'} over "
+          f"{len(bundles)} bundles")
+    print(f"  goodput_fleet    : {gp['goodput_fraction']:.4f} "
+          f"({gp['class_seconds']['restart_replay']:.1f}s restart_replay "
+          f"across {gp['n_hosts']} slots)")
+    tot = spec_totals["totals"]
+    if tot.get("drafted_tokens"):
+        print(f"  fleet speculation: {tot['accepted_draft_tokens']} of "
+              f"{tot['drafted_tokens']} drafts accepted, "
+              f"{tot['wasted_draft_tokens']} wasted")
+    if affinity_compare is not None:
+        pc, tp = (affinity_compare["prefill_chunks"],
+                  affinity_compare["ttft_p50_iters"])
+        print(f"  affinity compare : chunks {pc['affinity']} vs "
+              f"round-robin {pc['round_robin']}, p50 TTFT "
+              f"{tp['affinity']} vs {tp['round_robin']} iters")
+    if failover_compare is not None:
+        fc = failover_compare["prefill_chunks"]
+        print(f"  failover compare : warm {fc['warm']} vs cold "
+              f"{fc['cold']} prefill chunks")
+    if args.transcript:
+        print(f"  transcript       : {args.transcript}")
+    print(f"  scalars          : {session.monitor.log_dir}/scalars.jsonl")
+
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print("serve-sim: OK")
+    return 0
 
 
 def main(argv=None):
@@ -273,11 +586,74 @@ def main(argv=None):
     ap.add_argument("--dump-ledger", default=None, metavar="PATH",
                     help="write the raw request-trace ledger bundle here "
                          "(input for `ds-tpu serve-timeline`)")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="route the trace across N engine replicas through "
+                         "the FleetRouter (serve/router.py) instead of one "
+                         "engine; implies --prefix-cache (affinity routing "
+                         "peeks it)")
+    ap.add_argument("--fleet-policy", default=None,
+                    choices=["affinity", "least_loaded", "round_robin"],
+                    help="fleet routing policy (default: affinity)")
+    ap.add_argument("--affinity-weight", type=float, default=1.0,
+                    help="cached-prefix blocks are worth this many queue "
+                         "slots in the affinity routing score")
+    ap.add_argument("--max-queue-depth", type=int, default=0,
+                    help="per-replica waiting-queue admission bound; an "
+                         "arrival with every replica at the bound is SHED "
+                         "(0 = unbounded)")
+    ap.add_argument("--occupancy-cap", type=float, default=1.0,
+                    help="per-replica pool-occupancy admission cap in "
+                         "(0, 1]; 1.0 = occupancy shedding off")
+    ap.add_argument("--compare-affinity", action="store_true",
+                    help="run the fleet trace affinity AND round_robin, "
+                         "assert token identity, STRICTLY fewer total "
+                         "prefill chunks and STRICTLY better fleet p50 TTFT "
+                         "(iters) with affinity on")
+    ap.add_argument("--kill", action="append", default=None,
+                    metavar="IT:REPLICA",
+                    help="kill replica REPLICA when the router clock reaches "
+                         "IT and fail it over (repeatable)")
+    ap.add_argument("--compare-cold-failover", action="store_true",
+                    help="with --kill: rerun the kill schedule with COLD "
+                         "replacements (no snapshot), assert token identity "
+                         "and STRICTLY fewer warm prefill chunks")
+    ap.add_argument("--fleet-goodput-floor", type=float, default=0.0,
+                    help="fail unless the merged goodput_fleet fraction is "
+                         ">= this floor (0 = not gated)")
+    ap.add_argument("--transcript", default=None, metavar="PATH",
+                    help="write the byte-stable fleet routing transcript "
+                         "here (lint.sh golden-compares it)")
+    ap.add_argument("--arrival-scale", type=float, default=1.0,
+                    help="scale the seeded inter-arrival gaps (0.0 = all "
+                         "requests arrive at once, past saturation)")
+    ap.add_argument("--snapshot-dir", default=None, metavar="DIR",
+                    help="warm-failover snapshot directory (default: a "
+                         "fresh temp dir)")
     args = ap.parse_args(argv)
     if args.no_trace and (args.slo_ttft_ms or args.slo_tpot_ms
                           or args.dump_ledger):
         ap.error("--no-trace is incompatible with --slo-*/--dump-ledger "
                  "(they need the ledger)")
+    args.kill = [_parse_kill(ap, s, args.fleet) for s in (args.kill or [])]
+    if args.fleet:
+        if args.fleet < 1:
+            ap.error("--fleet must be >= 1")
+        if args.no_trace:
+            ap.error("--fleet needs the request-trace ledger (the fleet "
+                     "percentile merge reads it)")
+        if args.sharding > 1 or args.verify_unsharded:
+            ap.error("--fleet replicas are single-chip in the sim")
+        if args.compare_prefix_cache or args.compare_speculate or args.replay:
+            ap.error("--fleet has its own compare modes "
+                     "(--compare-affinity / --compare-cold-failover)")
+        args.prefix_cache = True
+        if args.fleet_policy is None:
+            args.fleet_policy = "affinity"
+        return _fleet_main(args)
+    if (args.fleet_policy or args.compare_affinity or args.kill
+            or args.compare_cold_failover or args.transcript
+            or args.fleet_goodput_floor):
+        ap.error("fleet options need --fleet N")
     if args.compare_prefix_cache:
         args.prefix_cache = True
     if args.compare_speculate and not args.speculate:

@@ -628,6 +628,22 @@ def fleet_latency_summary(bundles, ps=(50, 95, 99)):
     return out
 
 
+def fleet_serving_totals(bundles):
+    """Sum the scheduled-work ``totals`` and lifecycle ``counts`` of N replica
+    request-trace bundles into one fleet rollup. Integer-exact (token and
+    request counters, no floats), so the speculation economics
+    (drafted/accepted/wasted_draft_tokens) survive the fleet fold instead of
+    being silently dropped next to the latency-sketch merge."""
+    totals = {}
+    counts = {}
+    for b in bundles:
+        for k, v in ((b or {}).get("totals") or {}).items():
+            totals[k] = totals.get(k, 0) + int(v)
+        for k, v in ((b or {}).get("counts") or {}).items():
+            counts[k] = counts.get(k, 0) + int(v)
+    return {"totals": totals, "counts": counts}
+
+
 # ----------------------------------------------------------- merged timeline
 
 

@@ -91,9 +91,8 @@ class PipelineTracer:
         self.steps = deque(maxlen=self.capacity)
         # the per-step SCHEDULE decomposition (bubble accounting) — distinct
         # from the run-level Run/Goodput ledger (utils/goodput.py), which is
-        # why the bare "goodput" name is deprecated here (docs/telemetry.md)
+        # why this is the schedule_-prefixed name, never bare "goodput"
         self.last_schedule_goodput = None
-        self.last_goodput = None   # deprecated alias, kept one release
         self._epoch = time.perf_counter()
         self._cur = None
         self._straggler_warned = 0
@@ -133,12 +132,8 @@ class PipelineTracer:
         cur["wall_seconds"] = time.perf_counter() - t0
         goodput = goodput_decomposition(cur["spans"], self.stages)
         cur["schedule_goodput"] = goodput
-        # deprecated alias, kept one release: readers should move to
-        # "schedule_goodput" (the bare name now means the run-level ledger)
-        cur["goodput"] = goodput
         self.steps.append(cur)
         self.last_schedule_goodput = goodput
-        self.last_goodput = goodput   # deprecated alias, kept one release
         straggler = goodput.get("straggler")
         if straggler is not None and self._straggler_warned < 3:
             self._straggler_warned += 1
@@ -156,7 +151,7 @@ class PipelineTracer:
         if not self.steps:
             return None
         last = self.steps[-1]
-        decomp = last.get("schedule_goodput") or last.get("goodput") or {}
+        decomp = last.get("schedule_goodput") or {}
         return _find_straggler(decomp["per_stage_busy_seconds"], threshold)
 
     # -- bundle / dump -----------------------------------------------------
@@ -538,7 +533,6 @@ def simulated_bundle(micro_batches, stages, schedule="train",
         "wall_seconds": t / 1e6,
     }
     rec["schedule_goodput"] = goodput_decomposition(spans, stages)
-    rec["goodput"] = rec["schedule_goodput"]   # deprecated alias, one release
     return {
         "version": PIPELINE_TRACE_VERSION,
         "kind": "pipeline_trace",

@@ -119,6 +119,26 @@ timeout -k 10 120 "$REPO/bin/ds-tpu" hang-sim --json /tmp/_hang_sim.json \
 && cmp "$REPO/tests/unit/golden/cluster_timeline_2host.trace.json" \
        /tmp/_cluster_timeline.trace.json
 hang_rc=$?
+# fleet gate: seeded 3-replica shared-prefix fleet with two mid-flight kills —
+# affinity routing must emit byte-identical tokens to round-robin while doing
+# STRICTLY fewer prefill chunks and a strictly better fleet p50 TTFT, warm
+# failover must beat cold on prefill chunks with no request lost (conservation
+# via request-trace identity) and the merged goodput_fleet fraction above the
+# pinned floor, the fleet percentiles must stay bitwise-equal the
+# single-stream sketch, the SLO gate reads the fleet-MERGED percentiles, and
+# the iteration-domain run transcript is byte-compared against the committed
+# golden so any routing/failover schedule drift fails CI
+timeout -k 10 600 "$REPO/bin/ds-tpu" serve-sim --fleet 3 --requests 24 \
+    --shared-prefix 96 --compare-affinity \
+    --kill 10:0 --kill 30:1 --compare-cold-failover \
+    --fleet-goodput-floor 0.8 \
+    --slo-ttft-ms 60000 --slo-tpot-ms 60000 \
+    --transcript /tmp/_fleet_transcript.json \
+    --json /tmp/_serve_fleet.json \
+    --output /tmp/_serve_fleet_telemetry \
+&& cmp "$REPO/tests/unit/golden/fleet_transcript_24.json" \
+       /tmp/_fleet_transcript.json
+fleet_rc=$?
 [ "$lint_rc" -ne 0 ] && exit "$lint_rc"
 [ "$comm_rc" -ne 0 ] && exit "$comm_rc"
 [ "$serve_rc" -ne 0 ] && exit "$serve_rc"
@@ -128,4 +148,5 @@ hang_rc=$?
 [ "$anatomy_rc" -ne 0 ] && exit "$anatomy_rc"
 [ "$crash_rc" -ne 0 ] && exit "$crash_rc"
 [ "$goodput_rc" -ne 0 ] && exit "$goodput_rc"
-exit "$hang_rc"
+[ "$hang_rc" -ne 0 ] && exit "$hang_rc"
+exit "$fleet_rc"

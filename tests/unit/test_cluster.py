@@ -27,7 +27,8 @@ from deepspeed_tpu.utils.cluster import (
     COL_DISPATCH_MS, COL_STEP_MS, HEARTBEAT_FIELDS, ClusterMonitor,
     HangWatchdog, ScopeTracker, assemble_cluster_report, cluster_dump_main,
     derive_cluster_stats, estimate_clock_offsets, find_straggler_host,
-    fleet_latency_summary, hang_sim_main, named_scope)
+    fleet_latency_sketches, fleet_latency_summary, fleet_serving_totals,
+    hang_sim_main, named_scope)
 from deepspeed_tpu.utils.hlo import (collective_counts, instruction_count,
                                      optimized_hlo)
 from deepspeed_tpu.utils.numerics import (FlightRecorder, load_run_bundles,
@@ -147,6 +148,71 @@ def test_fleet_latency_summary_matches_single_stream():
     want = {f"{m}_p{p:g}": single[m].percentile(p)
             for m in metrics for p in (50, 95, 99)}
     assert fleet == want
+
+
+def test_fleet_summary_empty_replica_folds_as_omission():
+    """A replica that finished nothing (empty sketches, or the key absent
+    entirely, or a None bundle) must fold bitwise-identically to leaving it
+    out — an idle fleet slot cannot move the percentiles."""
+    rng = random.Random(11)
+    busy = HistogramSketch()
+    for _ in range(300):
+        busy.add(rng.uniform(0.5, 900.0))
+    full = {"latency_sketches": {"ttft_ms": busy.to_dict()}}
+    empties = [
+        {"latency_sketches": {}},
+        {"latency_sketches": {"ttft_ms": HistogramSketch().to_dict()}},
+        {},
+        None,
+    ]
+    want = fleet_latency_summary([full], ps=(50, 95, 99))
+    for empty in empties:
+        assert fleet_latency_summary([full, empty], ps=(50, 95, 99)) == want
+        assert fleet_latency_summary([empty, full], ps=(50, 95, 99)) == want
+    # the empty-sketch fold is exact at the bucket level too, not just at
+    # the percentile read-out
+    merged = fleet_latency_sketches(
+        [full, {"latency_sketches": {"ttft_ms":
+                                     HistogramSketch().to_dict()}}])
+    md, bd = merged["ttft_ms"].to_dict(), busy.to_dict()
+    assert md.pop("total") == pytest.approx(bd.pop("total"))
+    assert md == bd
+
+
+def test_fleet_merge_refuses_mismatched_sketch_geometry():
+    """Two replicas tracing with different histogram geometry cannot merge
+    exactly — the fold must refuse loudly, never silently rebucket."""
+    a, b = HistogramSketch(), HistogramSketch(growth=1.1)
+    a.add(5.0)
+    b.add(5.0)
+    bundles = [{"latency_sketches": {"ttft_ms": a.to_dict()}},
+               {"latency_sketches": {"ttft_ms": b.to_dict()}}]
+    with pytest.raises(ValueError, match="geometry mismatch"):
+        fleet_latency_sketches(bundles)
+    with pytest.raises(ValueError, match="geometry mismatch"):
+        fleet_latency_summary(bundles)
+
+
+def test_fleet_serving_totals_sums_spec_counters():
+    """The fleet rollup must carry the speculation economics (and lifecycle
+    counts) across the fold instead of silently dropping them."""
+    bundles = [
+        {"totals": {"drafted_tokens": 10, "accepted_draft_tokens": 7,
+                    "wasted_draft_tokens": 3, "prefill_tokens": 100},
+         "counts": {"finished": 4, "refused": 1, "shed": 0}},
+        {"totals": {"drafted_tokens": 5, "accepted_draft_tokens": 5,
+                    "wasted_draft_tokens": 0, "decode_tokens": 40},
+         "counts": {"finished": 2, "shed": 3}},
+        {},          # an idle replica contributes nothing
+        None,        # and a dead one even less
+    ]
+    out = fleet_serving_totals(bundles)
+    assert out["totals"] == {"drafted_tokens": 15,
+                             "accepted_draft_tokens": 12,
+                             "wasted_draft_tokens": 3,
+                             "prefill_tokens": 100, "decode_tokens": 40}
+    assert out["counts"] == {"finished": 6, "refused": 1, "shed": 3}
+    assert fleet_serving_totals([]) == {"totals": {}, "counts": {}}
 
 
 # ------------------------------------------------------------- scope tracking

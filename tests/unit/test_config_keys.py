@@ -206,6 +206,27 @@ SWEEP = {
         ({"speculation": {"max_draft_tokens": True}}, ("raise", ValueError)),
         # block 0 is the reserved null page: 1 usable block can't exist
         ({"speculation": {"draft_pool_blocks": 1}}, ("raise", ValueError)),
+        ({"fleet": {"replicas": 3}},
+         ("attr", "serving_fleet_replicas", 3)),
+        ({"fleet": {"policy": "round_robin"}},
+         ("attr", "serving_fleet_policy", "round_robin")),
+        ({"fleet": {"affinity_weight": 2.5}},
+         ("attr", "serving_fleet_affinity_weight", 2.5)),
+        ({"fleet": {"max_queue_depth": 12}},
+         ("attr", "serving_fleet_max_queue_depth", 12)),
+        ({"fleet": {"occupancy_cap": 0.9}},
+         ("attr", "serving_fleet_occupancy_cap", 0.9)),
+        ({"fleet": {"goodput_floor": 0.85}},
+         ("attr", "serving_fleet_goodput_floor", 0.85)),
+        ({"fleet": {"replicas": 0}}, ("raise", ValueError)),
+        ({"fleet": {"replicas": True}}, ("raise", ValueError)),
+        ({"fleet": {"policy": "random"}}, ("raise", ValueError)),
+        ({"fleet": {"affinity_weight": -1}}, ("raise", ValueError)),
+        ({"fleet": {"max_queue_depth": -2}}, ("raise", ValueError)),
+        ({"fleet": {"occupancy_cap": 0.0}}, ("raise", ValueError)),
+        ({"fleet": {"occupancy_cap": 1.5}}, ("raise", ValueError)),
+        ({"fleet": {"goodput_floor": 2.0}}, ("raise", ValueError)),
+        ({"fleet": {"nonsense_key": 1}}, ("warn", "unknown serving.fleet")),
     ),
     "resilience": (
         ({"enabled": True, "save_dir": "/tmp/ckpt"},

@@ -1,0 +1,218 @@
+"""FleetRouter contracts: routing, shedding, failover, conservation.
+
+The router is a pure scheduling layer over N InferenceEngine replicas, so
+every pinned property is deterministic: prefix-affinity sends a repeat
+prefix back to the replica that cached it, round_robin cycles slots,
+saturation sheds with status "shed" (refusal, not a crash), a mid-flight
+kill with warm failover loses no requests and changes no tokens, and the
+fleet-merged latency summary is bitwise-equal to a single-stream rebuild
+over the concatenated ledgers.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.serve.engine import InferenceEngine
+from deepspeed_tpu.serve.request_trace import LATENCY_METRICS, HistogramSketch
+from deepspeed_tpu.serve.router import SHED_REASON, FleetRouter
+from deepspeed_tpu.serve.scheduler import Request
+from deepspeed_tpu.utils.cluster import fleet_latency_summary
+
+ML = 32
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = GPT2Config(vocab_size=64, n_positions=ML, n_embd=16, n_layer=2,
+                     n_head=2, compute_dtype=jnp.float32, loss_chunk=0)
+    model = GPT2Model(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _engine(model_and_params, slot, **kw):
+    model, params = model_and_params
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 33)
+    kw.setdefault("max_model_len", ML)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("prefix_cache", True)
+    kw.setdefault("request_trace", {"enabled": True, "capacity": 64,
+                                    "host_id": slot})
+    return InferenceEngine(model, params, **kw)
+
+
+def _fleet(model_and_params, n, engine_kw=None, **router_kw):
+    engines = [_engine(model_and_params, s, **(engine_kw or {}))
+               for s in range(n)]
+    return FleetRouter(engines, **router_kw)
+
+
+def _prompt(seed, n):
+    return np.random.RandomState(seed).randint(0, 64, size=n).astype(
+        np.int32).tolist()
+
+
+def _routed_slots(transcript):
+    return {rid: slot for it in transcript["iterations"]
+            for rid, slot, _ in it["routed"]}
+
+
+# ------------------------------------------------------------ construction
+
+def test_bad_policy_and_empty_fleet_raise(model_and_params):
+    with pytest.raises(ValueError, match="policy"):
+        _fleet(model_and_params, 1, policy="random")
+    with pytest.raises(ValueError, match="at least one"):
+        FleetRouter([])
+
+
+# ----------------------------------------------------------------- routing
+
+def test_round_robin_cycles_slots(model_and_params):
+    router = _fleet(model_and_params, 2, policy="round_robin")
+    reqs = [Request(f"r{i}", _prompt(i, 9), 3) for i in range(4)]
+    outs, transcript = router.run(reqs)
+    assert [o.status for o in outs] == ["finished"] * 4
+    slots = _routed_slots(transcript)
+    assert [slots[f"r{i}"] for i in range(4)] == [0, 1, 0, 1]
+
+
+def test_affinity_routes_repeat_prefix_to_cached_replica(model_and_params):
+    router = _fleet(model_and_params, 3, policy="affinity")
+    base = _prompt(7, 16)
+    # r0 seeds replica 0's prefix cache; r1/r2 keep the other replicas from
+    # being trivially empty; r3 repeats r0's prompt after r0 has finished.
+    reqs = [Request("r0", base, 3, arrival=0),
+            Request("r1", _prompt(8, 16), 3, arrival=0),
+            Request("r2", _prompt(9, 16), 3, arrival=0),
+            Request("r3", base + [1, 2], 3, arrival=20)]
+    outs, transcript = router.run(reqs)
+    assert [o.status for o in outs] == ["finished"] * 4
+    slots = _routed_slots(transcript)
+    assert slots["r3"] == slots["r0"]
+    hit = {rid: h for it in transcript["iterations"]
+           for rid, _, h in it["routed"]}
+    assert hit["r3"] > 0 and hit["r0"] == 0
+
+
+def test_affinity_weight_zero_is_least_loaded(model_and_params):
+    router = _fleet(model_and_params, 2, policy="affinity",
+                    affinity_weight=0.0)
+    base = _prompt(11, 16)
+    reqs = [Request("r0", base, 3, arrival=0),
+            Request("r1", base, 3, arrival=20),
+            Request("r2", base, 3, arrival=20)]
+    outs, transcript = router.run(reqs)
+    slots = _routed_slots(transcript)
+    # with weight 0 the cached prefix on slot 0 is worthless: r1 takes the
+    # lowest-slot tie-break, r2 balances onto the other replica
+    assert {slots["r1"], slots["r2"]} == {0, 1}
+
+
+# ---------------------------------------------------------------- shedding
+
+def test_max_queue_depth_sheds_with_refusal_semantics(model_and_params):
+    router = _fleet(model_and_params, 1, policy="least_loaded",
+                    max_queue_depth=2,
+                    engine_kw={"num_slots": 1})
+    reqs = [Request(f"r{i}", _prompt(20 + i, 9), 3) for i in range(8)]
+    outs, _ = router.run(reqs)
+    statuses = [o.status for o in outs]
+    assert "shed" in statuses and "finished" in statuses
+    for o in outs:
+        if o.status == "shed":
+            assert o.refusal == SHED_REASON and o.tokens == []
+    # refusal, not a crash: every request got exactly one output, and the
+    # front-door trace recorded every shed
+    assert len(outs) == len(reqs)
+    assert router.tracer.bundle()["counts"]["shed"] == statuses.count("shed")
+    assert router.shed_count == statuses.count("shed")
+
+
+def test_occupancy_cap_one_never_sheds(model_and_params):
+    router = _fleet(model_and_params, 1, occupancy_cap=1.0)
+    reqs = [Request(f"r{i}", _prompt(30 + i, 9), 3) for i in range(6)]
+    outs, _ = router.run(reqs)
+    assert [o.status for o in outs] == ["finished"] * 6
+
+
+# ---------------------------------------------------------------- failover
+
+def _run_with_kills(model_and_params, kills, cold, tmp_path):
+    model, params = model_and_params
+
+    def build_replacement(slot):
+        return _engine(model_and_params, slot, telemetry=None)
+
+    router = _fleet(model_and_params, 2,
+                    kill_schedule=kills,
+                    build_replacement=build_replacement,
+                    snapshot_dir=str(tmp_path),
+                    cold_failover=cold)
+    reqs = [Request(f"r{i}", _prompt(40 + i, 12), 4, arrival=i)
+            for i in range(8)]
+    return router, router.run(reqs)
+
+
+def test_warm_failover_conserves_requests_and_tokens(model_and_params,
+                                                     tmp_path):
+    _, (ref_outs, _) = _run_with_kills(model_and_params, [], False, tmp_path)
+    router, (outs, transcript) = _run_with_kills(
+        model_and_params, [(3, 0)], False, tmp_path)
+    assert router.kills_applied == 1
+    kills = [k for it in transcript["iterations"] for k in it["kills"]]
+    assert kills == [[0, "warm"]]
+    # no request lost, no token changed
+    assert [o.status for o in outs] == ["finished"] * 8
+    assert [o.tokens for o in outs] == [o.tokens for o in ref_outs]
+    # the victim's finished records were retired into the ledger exactly once
+    assert len(router.bundles()) == 2 + 1 + 1   # live + retired + front door
+
+
+def test_cold_failover_reprefills_more_than_warm(model_and_params, tmp_path):
+    warm, (wouts, _) = _run_with_kills(model_and_params, [(3, 0)], False,
+                                       tmp_path)
+    cold, (couts, _) = _run_with_kills(model_and_params, [(3, 0)], True,
+                                       tmp_path)
+    assert [o.tokens for o in wouts] == [o.tokens for o in couts]
+    assert sum(warm.prefill_chunks) < sum(cold.prefill_chunks)
+
+
+def test_kill_without_factory_raises(model_and_params):
+    router = _fleet(model_and_params, 2, kill_schedule=[(0, 0)])
+    with pytest.raises(RuntimeError, match="build_replacement"):
+        router.run([Request("r0", _prompt(50, 9), 3)])
+
+
+# ----------------------------------------------------------- observability
+
+def test_fleet_summary_merge_is_exact(model_and_params):
+    router = _fleet(model_and_params, 2)
+    reqs = [Request(f"r{i}", _prompt(60 + i, 10), 3, arrival=i)
+            for i in range(6)]
+    router.run(reqs)
+    summary = router.fleet_summary()
+    bundles = router.bundles()
+    assert summary["latency"] == fleet_latency_summary(bundles,
+                                                       ps=(50, 95, 99))
+    # bitwise-equal a single-stream rebuild over the concatenated ledgers
+    singles = {m: HistogramSketch() for m in LATENCY_METRICS}
+    for b in bundles:
+        for rec in b["requests"]:
+            if rec.get("status") == "finished":
+                for m in LATENCY_METRICS:
+                    singles[m].add(rec.get(m))
+    single = {}
+    for m in sorted(singles):
+        if singles[m].count:
+            for p in (50, 95, 99):
+                single[f"{m}_p{p:g}"] = singles[m].percentile(p)
+    assert summary["latency"] == single
+    gp = summary["goodput_fleet"]
+    assert 0.0 <= gp["goodput_fraction"] <= 1.0
+    assert summary["serving"]["counts"]["finished"] == 6
+    assert summary["finished"] == 6 and summary["shed"] == 0

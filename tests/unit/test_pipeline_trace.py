@@ -110,9 +110,10 @@ def test_goodput_scalars_flow_through_telemetry(tmp_path):
     assert g["fwd_seconds"] > 0 and g["bwd_seconds"] > 0
     assert 0.0 <= g["bubble_fraction"] < 1.0
     assert len(g["per_stage_busy_seconds"]) == eng.num_stages
-    # deprecated alias, kept one release (the bare name now means the
-    # run-level goodput ledger — docs/goodput.md)
-    assert eng.pipe_trace.last_goodput is g
+    # the one-release "goodput" alias is gone: the bare name means the
+    # run-level goodput ledger (docs/goodput.md), not this decomposition
+    assert not hasattr(eng.pipe_trace, "last_goodput")
+    assert "goodput" not in eng.pipe_trace.steps[-1]
 
 
 def _padded(fn, seconds):
@@ -139,7 +140,7 @@ def test_four_stage_measured_bubble_matches_simulator():
     eng._stage_last_bwd = _padded(eng._stage_last_bwd, 0.02)
     eng.train_batch(it)
     rec = eng.pipe_trace.steps[-1]
-    measured = rec["goodput"]["bubble_fraction"]
+    measured = rec["schedule_goodput"]["bubble_fraction"]
     t_fwd, t_bwd = measured_costs(rec)
     expected = simulate_schedule(8, 4, "train", t_fwd=t_fwd, t_bwd=t_bwd)["bubble_fraction"]
     assert measured == pytest.approx(expected, abs=0.15), (measured, expected)
