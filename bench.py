@@ -101,7 +101,16 @@ REGRESSION_KEYS = (
     # after the scripted warm failover — p99/shed lower-is-better
     "extra.serving_fleet.fleet_p99_ttft_ms",
     "extra.serving_fleet.shed_rate",
+    "extra.serving_fleet.shed_rate_2x_saturation",
     "extra.serving_fleet.goodput_fleet_fraction",
+    # HBM observatory (docs/hbm.md): the smoke engine's per-class resident
+    # bytes (engine.memory_manifest -> utils/hbm) and the compile-reported
+    # temp peak — a RISE is a memory regression (all lower-is-better)
+    "extra.hbm.peak_by_class.params",
+    "extra.hbm.peak_by_class.grads",
+    "extra.hbm.peak_by_class.master",
+    "extra.hbm.peak_by_class.optimizer",
+    "extra.hbm.peak_by_class.compiled_temp_peak",
     # resilience ledger: caller-thread checkpoint stall and the warm/cold
     # restart TTFT ratio (docs/resilience.md) — both lower-is-better
     "extra.resilience.checkpoint_stall_ms",
@@ -124,6 +133,12 @@ LOWER_IS_BETTER_KEYS = frozenset(
         "extra.serving_1p5b_spec.target_steps_per_token",
         "extra.serving_fleet.fleet_p99_ttft_ms",
         "extra.serving_fleet.shed_rate",
+        "extra.serving_fleet.shed_rate_2x_saturation",
+        "extra.hbm.peak_by_class.params",
+        "extra.hbm.peak_by_class.grads",
+        "extra.hbm.peak_by_class.master",
+        "extra.hbm.peak_by_class.optimizer",
+        "extra.hbm.peak_by_class.compiled_temp_peak",
     })
 
 
@@ -773,7 +788,9 @@ def bench_serving_speculative_smoke():
 def bench_serving_fleet_summary(cfg_kwargs, *, replicas, n_requests, num_slots,
                                 block_size, num_blocks, max_model_len,
                                 prefill_chunk, param_dtype=None, seed=11,
-                                shared_prefix=0, max_queue_depth=0, kills=()):
+                                shared_prefix=0, max_queue_depth=0, kills=(),
+                                shed_probe_rate=0.0,
+                                shed_probe_queue_depth=0):
     """Fleet-router serving summary (docs/serving.md): N replicas sharing one
     model/params object behind the prefix-affinity FleetRouter, a seeded
     shared-prefix trace routed through it, and a scripted warm failover —
@@ -832,7 +849,36 @@ def bench_serving_fleet_summary(cfg_kwargs, *, replicas, n_requests, num_slots,
     recompiles = sum(session.watchdog.recompiles(n)
                      for n in session.watchdog.records
                      if n.startswith("serve:"))
+    # load-shedding probe: the same seeded trace re-drawn as a Poisson
+    # process at ~2x the fleet's service capacity, routed through fresh
+    # replicas (same model/params — no new compiles) behind a queue-depth
+    # bound tight enough that the overload actually crosses it
+    # (shed_probe_queue_depth; the main trace's bound is sized NOT to).
+    # shed_rate under that overload is the admission-control ledger: a rise
+    # means the fleet sheds MORE of an identical overload than last round
+    # (regression key, lower-is-better).
+    probe = None
+    probe_depth = shed_probe_queue_depth or max_queue_depth
+    if shed_probe_rate and probe_depth:
+        probe_engines = [build(s) for s in range(replicas)]
+        probe_router = FleetRouter(
+            probe_engines, max_queue_depth=probe_depth,
+            run_id=f"bench_fleet{replicas}_shed_probe")
+        probe_reqs = synth_trace(
+            n_requests, vocab_size=cfg.vocab_size,
+            max_model_len=max_model_len, seed=seed,
+            shared_prefix_len=shared_prefix,
+            arrival_process=("poisson", shed_probe_rate))
+        pouts, _ = probe_router.run(probe_reqs)
+        pshed = sum(1 for o in pouts if o.status == "shed")
+        probe = {"arrival_rate": shed_probe_rate, "requests": len(probe_reqs),
+                 "queue_depth": probe_depth, "shed": pshed,
+                 "shed_rate_2x_saturation": round(
+                     pshed / max(len(probe_reqs), 1), 4)}
     return {"replicas": replicas, "requests": len(reqs),
+            **({"shed_probe": probe,
+                "shed_rate_2x_saturation":
+                    probe["shed_rate_2x_saturation"]} if probe else {}),
             "finished": len(fin), "shed": summary["shed"],
             "kills": summary["kills"], "wall_s": round(wall, 2),
             "goodput_tok_s": round(sum(len(o.tokens) for o in fin) / wall, 1),
@@ -855,7 +901,12 @@ def bench_serving_fleet_smoke():
              loss_chunk=0),
         replicas=3, n_requests=16, num_slots=4, block_size=8, num_blocks=33,
         max_model_len=64, prefill_chunk=16, shared_prefix=24,
-        max_queue_depth=8, kills=((6, 0),))
+        max_queue_depth=8, kills=((6, 0),),
+        # service capacity on this trace ~ replicas*slots/mean-request-iters
+        # = 3*4/~10 ~ 1.2 req/iteration; probe the shed path at ~2x that,
+        # behind a depth-1 bound (the 12 decode slots absorb the burst at
+        # this toy scale behind anything looser and the probe reads 0.0)
+        shed_probe_rate=2.4, shed_probe_queue_depth=1)
 
 
 def bench_resilience_smoke():
@@ -1390,6 +1441,15 @@ def main():
         _fence(loss)
         telemetry = engine.telemetry.summary()
         numerics = engine._numerics.summary() if engine._numerics is not None else None
+        try:  # HBM ledger: per-class resident bytes + compile-reported temp peak
+            from deepspeed_tpu.utils import hbm as _hbm
+            _, class_bytes = _hbm.manifest_signatures(engine.memory_manifest())
+            hbm_block = {"peak_by_class": {
+                **{k: int(v) for k, v in class_bytes.items()},
+                "compiled_temp_peak":
+                    int(engine.telemetry.watchdog.peak_temp_bytes())}}
+        except Exception as e:
+            hbm_block = {"error": f"{type(e).__name__}: {e}"}
         engine.telemetry.close()
         try:  # instrumented post-window probe; headline window above stays untraced
             pipeline_goodput = _pipeline_goodput_probe()
@@ -1440,7 +1500,8 @@ def main():
                             "serving_speculative": serving_spec,
                             "serving_fleet": serving_fleet,
                             "resilience": resilience,
-                            "goodput": goodput}}
+                            "goodput": goodput,
+                            "hbm": hbm_block}}
         result["extra"]["regression_vs_previous_round"] = \
             regression_vs_previous_round(result)
         print(json.dumps(result))

@@ -247,6 +247,16 @@ class _DecodeLintAdapter:
     def lint_programs(self, sample_batch=None):
         return self.model.decode_lint_programs(self.params)
 
+    def memory_manifest(self):
+        # params are the only persistent device residents on the dense
+        # decode path (caches are per-call arguments, not engine state)
+        leaves = jax.tree_util.tree_leaves(self.params)
+        psi = sum(int(np.prod(l.shape)) if l.shape else 1 for l in leaves)
+        itemsize = int(jnp.dtype(leaves[0].dtype).itemsize) if leaves else 4
+        return {"classes": {"params": self.params},
+                "geometry": {"kind": "decode", "psi": psi,
+                             "param_itemsize": itemsize}}
+
 
 def _build_gpt2_decode():
     return _DecodeLintAdapter(*_tiny_gpt2()), None
@@ -284,6 +294,12 @@ def _build_serving_speculative():
         def lint_programs(self, sample_batch=None):
             return [e for e in eng.lint_programs(sample_batch)
                     if "spec" in e[0]]
+
+        def memory_manifest(self):
+            # the wrapped engine's full resident set: the entry captures only
+            # the spec programs, so target-only classes report as unobserved
+            # in the hbm sweep (resident, but outside this program subset)
+            return eng.memory_manifest()
 
     return _SpecPrograms(), None
 

@@ -370,6 +370,21 @@ class DeepSpeedConfig:
                 "DeepSpeedConfig: telemetry.goodput.eval_tag must be a "
                 f"non-empty string, got {self.telemetry_goodput_eval_tag!r}")
 
+        hbm_dict = tel_dict.get(TELEMETRY_HBM, {}) or {}
+        self._warn_unknown_nested(f"{TELEMETRY}.{TELEMETRY_HBM}",
+                                  hbm_dict, HBM_CONFIG_KEYS)
+        self.telemetry_hbm_enabled = get_scalar_param(hbm_dict, HBM_ENABLED,
+                                                      HBM_ENABLED_DEFAULT)
+        if self.telemetry_hbm_enabled and not self.telemetry_enabled:
+            raise ValueError(
+                "DeepSpeedConfig: telemetry.hbm.enabled requires "
+                "telemetry.enabled — the Memory/* scalars ride the end_step "
+                "record the telemetry session produces")
+        if not isinstance(self.telemetry_hbm_enabled, bool):
+            raise ValueError(
+                "DeepSpeedConfig: telemetry.hbm.enabled must be a bool, got "
+                f"{self.telemetry_hbm_enabled!r}")
+
         num_dict = param_dict.get(NUMERICS, {})
         self._warn_unknown_nested(NUMERICS, num_dict, NUMERICS_CONFIG_KEYS)
         self.numerics_enabled = get_scalar_param(num_dict, NUMERICS_ENABLED, NUMERICS_ENABLED_DEFAULT)

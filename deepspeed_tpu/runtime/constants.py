@@ -256,6 +256,16 @@ GOODPUT_EMIT_SCALARS_DEFAULT = True
 GOODPUT_EVAL_TAG = "eval_tag"
 GOODPUT_EVAL_TAG_DEFAULT = "eval"
 
+# telemetry.hbm sub-block: HBM memory observatory — installs the engine's
+# per-class resident-byte manifest (params / grads / master / optimizer /
+# comm-EF) into the telemetry session so end_step emits Memory/* scalars and
+# the flight recorder's dump carries OOM forensics (docs/hbm.md). Host-side
+# constants only; the lowered step program is HLO-instruction-identical with
+# the block on or off.
+TELEMETRY_HBM = "hbm"
+HBM_ENABLED = "enabled"
+HBM_ENABLED_DEFAULT = False
+
 #############################################
 # Numerics observatory (TPU-native health layer on top of telemetry; no
 # reference key — in-graph per-subtree anomaly sentinel, loss-scale event
@@ -589,6 +599,7 @@ TELEMETRY_CONFIG_KEYS = frozenset({
     TELEMETRY_ANATOMY,
     TELEMETRY_CLUSTER,
     TELEMETRY_GOODPUT,
+    TELEMETRY_HBM,
 })
 
 ANATOMY_CONFIG_KEYS = frozenset({
@@ -621,6 +632,10 @@ GOODPUT_CONFIG_KEYS = frozenset({
     GOODPUT_LEDGER_DIR,
     GOODPUT_EMIT_SCALARS,
     GOODPUT_EVAL_TAG,
+})
+
+HBM_CONFIG_KEYS = frozenset({
+    HBM_ENABLED,
 })
 
 NUMERICS_CONFIG_KEYS = frozenset({

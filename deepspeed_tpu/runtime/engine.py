@@ -624,6 +624,17 @@ class DeepSpeedEngine:
 
         self._compile_steps()
 
+        # ---- HBM observatory (docs/hbm.md): install the per-class resident-
+        # byte manifest into the telemetry session. Pure host arithmetic over
+        # abstract shapes/shardings — no device work, and the compiled step is
+        # HLO-instruction-identical with the block on or off (pinned in tests).
+        if self.telemetry is not None and self.config.telemetry_hbm_enabled:
+            from ..utils import hbm as _hbm
+            manifest = self.memory_manifest()
+            _, class_bytes = _hbm.manifest_signatures(manifest)
+            self.telemetry.set_memory_manifest(
+                class_bytes, geometry=manifest.get("geometry"))
+
         # ---- resilience (docs/resilience.md): periodic async checkpointing +
         # flight-recorder-driven auto-resume. Everything here is host-side —
         # the save hook snapshots committed step state and commits in a
@@ -1701,6 +1712,84 @@ class DeepSpeedEngine:
                     acc_in, self.params, step, hyper)
         progs.append(("apply_update", self._jit_apply_update, args, au_man))
         return progs
+
+    def memory_manifest(self):
+        """The memory analogue of ``lint_programs``: every persistent
+        device-resident pytree this engine owns, grouped into the HBM
+        observatory's attribution classes, plus the geometry the closed-form
+        ZeRO predictor needs (utils/hbm.modeled_classes, docs/hbm.md).
+
+        Class leaves may be live arrays or ShapeDtypeStructs — only
+        shape/dtype/sharding are read (no device work, no syncs). Classes:
+
+        - ``params``: compute-dtype parameters (sharded at stage >= 3)
+        - ``grads``: the persistent grad/accumulation buffer handed between
+          programs on the two-jit, accumulation and offload paths; absent on
+          the fused path, where the grad tree stays internal and XLA frees
+          each leaf as the optimizer consumes it (PERF.md round 5)
+        - ``master``/``optimizer``: engine-held fp32 master and moment state
+          (absent under ZeRO-Offload — host tier — and external-master, whose
+          client state rides in ``optimizer`` alone)
+        - ``comm_ef``: the compressed exchange's persistent error-feedback
+          buffers, when configured
+        """
+        import jax
+        classes = {"params": self.params}
+        fused = getattr(self, "_run_fused_step", None) is not None
+        offload = self._offload is not None
+
+        def grads_like(dt):
+            return jax.tree_util.tree_map(
+                lambda p, s: jax.ShapeDtypeStruct(p.shape, dt, sharding=s),
+                self.params, self._grad_shardings)
+
+        if offload:
+            classes["grads"] = grads_like(self._grad_dtype)
+            grad_itemsize = jnp.dtype(self._grad_dtype).itemsize
+        elif not fused:
+            classes["grads"] = grads_like(self._acc_dtype)
+            grad_itemsize = jnp.dtype(self._acc_dtype).itemsize
+        else:
+            grad_itemsize = jnp.dtype(self._grad_dtype).itemsize
+        master_numel = 0
+        if offload:
+            pass                    # master + moments live in host DRAM
+        elif self._external_master:
+            classes["optimizer"] = self.opt_state
+            # the one client-declared quantity: an external master is an
+            # Adam-style fp32 triple (master, m1, m2) over the client's shard
+            master_numel = sum(
+                int(np.prod(l.shape)) if l.shape else 1
+                for l in jax.tree_util.tree_leaves(self.opt_state)) // 3
+        else:
+            classes["master"] = self.master_params
+            classes["optimizer"] = self.opt_state
+        comm_ef_bytes = 0
+        if self._comm_we is not None:
+            from ..utils.hbm import leaf_signature
+            classes["comm_ef"] = [self._comm_we, self._comm_se]
+            comm_ef_bytes = sum(leaf_signature(b)[2]
+                                for b in (self._comm_we, self._comm_se))
+        psi = sum(int(np.prod(l.shape)) if l.shape else 1
+                  for l in jax.tree_util.tree_leaves(self.params))
+        geometry = {
+            "kind": "training",
+            "psi": psi,
+            "param_itemsize": int(jnp.dtype(self.compute_dtype).itemsize),
+            "grad_itemsize": int(grad_itemsize),
+            "dp": int(self.dp_size),
+            "zero_stage": int(self.zero_optimization_stage()),
+            "zero_sharded_fraction": self._zero_sharded_fraction,
+            "external_master": bool(self._external_master),
+            "master_numel": int(master_numel),
+            "offload": offload,
+            "fused": fused,
+            "gas": int(self.gradient_accumulation_steps()),
+            "comm_ef_bytes": int(comm_ef_bytes),
+            "n_buckets": (len(self._overlap_plan) if self._overlap_plan
+                          else 0),
+        }
+        return {"classes": classes, "geometry": geometry}
 
     # ------------------------------------------------------------------ train API
     def shard_batch(self, batch):

@@ -590,6 +590,27 @@ class PipelineEngine(DeepSpeedEngine):
             x_in = x_out
         return progs
 
+    def memory_manifest(self):
+        """SPMD path: the base-engine manifest (the step programs are the
+        base programs). Instruction-executor path: the per-stage jits are
+        LOCAL programs, so the live param working set of any one program is
+        the largest stage subtree, not the full tree — the manifest keeps the
+        full tree for classification (every stage's leaves must classify as
+        params) and declares the per-stage maximum for the model."""
+        if self._spmd:
+            return super().memory_manifest()
+        from ...utils import hbm as _hbm
+        stage_bytes = []
+        for s in range(self.num_stages):
+            leaves = jax.tree_util.tree_leaves(self._select_params(s))
+            stage_bytes.append(sum(_hbm.leaf_signature(l)[2] for l in leaves))
+        return {
+            "classes": {"params": self.params},
+            "geometry": {"kind": "pipeline_local",
+                         "num_stages": int(self.num_stages),
+                         "stage_param_bytes_max": max(stage_bytes, default=0)},
+        }
+
     # ------------------------------------------------------------- blocked base API
     def forward(self, *args, **kwargs):
         raise PipelineError("Only train_batch() is accessible in pipeline mode.")

@@ -250,14 +250,15 @@ class PartitionedTensor:
 # ---------------------------------------------------------------------------
 
 def see_memory_usage(message: str, force: bool = False):
-    try:
-        stats = jax.local_devices()[0].memory_stats() or {}
-        ib = stats.get("bytes_in_use", 0) / (1024**3)
-        pk = stats.get("peak_bytes_in_use", 0) / (1024**3)
-        lim = stats.get("bytes_limit", 0) / (1024**3)
-        logger.info(f"{message} | device mem in-use {ib:.2f} GB | peak {pk:.2f} GB | limit {lim:.2f} GB")
-    except Exception:
+    from ..utils.hbm import device_memory_stats
+    stats = device_memory_stats()
+    if stats is None:
         logger.info(f"{message} | device memory stats unavailable")
+        return
+    ib = stats.get("bytes_in_use", 0) / (1024**3)
+    pk = stats.get("peak_bytes_in_use", 0) / (1024**3)
+    lim = stats.get("bytes_limit", 0) / (1024**3)
+    logger.info(f"{message} | device mem in-use {ib:.2f} GB | peak {pk:.2f} GB | limit {lim:.2f} GB")
 
 
 def memory_status(msg: str, print_rank: int = 0):

@@ -958,3 +958,51 @@ class InferenceEngine:
                   kp, vp), manifest))
             entries.extend(self._spec.lint_programs(manifest))
         return entries
+
+    def memory_manifest(self):
+        """The memory analogue of ``lint_programs`` (utils/hbm, docs/hbm.md):
+        the serving engine's persistent device residents — compute-dtype
+        params (head-sharded under tp) and the paged KV pools, plus the draft
+        model's own params/pool when speculation is live. Geometry carries the
+        closed-form pool arithmetic (2 x L x blocks x block_size x H x Hd x
+        itemsize, head-sharded over tp) the modeled view predicts from."""
+        import jax
+        from ..utils.hbm import leaf_signature
+        c = self.model.config
+        itemsize = int(jnp.dtype(c.compute_dtype).itemsize)
+        leaves = jax.tree_util.tree_leaves(self.params)
+        psi = sum(int(np.prod(l.shape)) if l.shape else 1 for l in leaves)
+        per_device = sum(leaf_signature(l)[2] for l in leaves)
+        classes = {"params": self.params,
+                   "kv_pool": [self.k_pool, self.v_pool]}
+        geometry = {
+            "kind": "serving",
+            "psi": psi,
+            "param_itemsize": itemsize,
+            "tp": int(self.tp),
+            "param_per_device_fraction": per_device / max(psi * itemsize, 1),
+            "pool": {"n_layer": int(c.n_layer),
+                     "num_blocks": int(self.num_blocks),
+                     "block_size": int(self.block_size),
+                     "n_head": int(c.n_head), "head_dim": int(c.head_dim),
+                     "itemsize": itemsize,
+                     "shard_factor": int(self.tp) if self.tp > 1 else 1},
+        }
+        if self._spec is not None:
+            dc = self._spec.model.config
+            d_item = int(jnp.dtype(dc.compute_dtype).itemsize)
+            d_leaves = jax.tree_util.tree_leaves(self._spec.params)
+            classes["draft_params"] = self._spec.params
+            classes["draft_pool"] = [self._spec.k_pool, self._spec.v_pool]
+            geometry["draft"] = {
+                "psi": sum(int(np.prod(l.shape)) if l.shape else 1
+                           for l in d_leaves),
+                "param_itemsize": d_item,
+                "pool": {"n_layer": int(dc.n_layer),
+                         "num_blocks": int(self._spec.k_pool.shape[1]),
+                         "block_size": int(self._spec.block_size),
+                         "n_head": int(dc.n_head),
+                         "head_dim": int(dc.head_dim),
+                         "itemsize": d_item},
+            }
+        return {"classes": classes, "geometry": geometry}

@@ -32,7 +32,7 @@ import sys
 
 def synth_trace(n, *, vocab_size, max_model_len, seed, beam_every=7,
                 include_infeasible=False, shared_prefix_len=0,
-                arrival_scale=1.0):
+                arrival_scale=1.0, arrival_process=None):
     """Seeded mixed trace: prompts 1..~ML/2, generations 1..~ML/4, arrivals
     staggered 0-2 iterations apart, every ``beam_every``-th request beam-4.
 
@@ -42,7 +42,16 @@ def synth_trace(n, *, vocab_size, max_model_len, seed, beam_every=7,
     inter-arrival gaps (0.0 = every request arrives at once, the
     past-saturation fleet workload) without perturbing the RNG stream. The
     default path draws nothing extra, so existing seeded traces (and their
-    goldens) are untouched."""
+    goldens) are untouched.
+
+    ``arrival_process=("poisson", rate)`` replaces the staggered gaps with a
+    seeded Poisson process of intensity ``rate`` requests/iteration
+    (exponential inter-arrival gaps on a float clock, floored to the
+    iteration domain). Arrivals bunch, so a rate past the fleet's service
+    capacity drives the waiting queues through any --max-queue-depth bound —
+    the load-shedding workload. Deterministic per seed like everything else
+    here; it is a DIFFERENT mode (the extra draw shifts the RNG stream), so
+    default-mode traces are still byte-identical to older releases."""
     import numpy as np
     from .scheduler import Request
 
@@ -52,9 +61,16 @@ def synth_trace(n, *, vocab_size, max_model_len, seed, beam_every=7,
         raise ValueError("shared_prefix_len must leave room for a tail and "
                          f"generation (got {P} >= {max_model_len})")
     system_prompt = rng.randint(0, vocab_size, size=P).tolist() if P else []
-    reqs, arrival = [], 0
+    reqs, arrival, clock = [], 0, 0.0
     for i in range(n):
-        arrival += int(int(rng.randint(0, 3)) * arrival_scale)
+        if arrival_process is not None:
+            kind, rate = arrival_process
+            if kind != "poisson":
+                raise ValueError(f"unknown arrival process {kind!r}")
+            clock += float(rng.exponential(1.0 / rate))
+            arrival = int(clock)
+        else:
+            arrival += int(int(rng.randint(0, 3)) * arrival_scale)
         T0 = P + int(rng.randint(1, max(2, (max_model_len - P) // 2)))
         L = int(rng.randint(1, max(2, max_model_len // 4)))
         if T0 + L > max_model_len:          # keep the trace feasible
@@ -141,7 +157,8 @@ def _trace(args):
                        max_model_len=args.max_model_len, seed=args.seed,
                        include_infeasible=args.include_infeasible,
                        shared_prefix_len=args.shared_prefix,
-                       arrival_scale=args.arrival_scale)
+                       arrival_scale=args.arrival_scale,
+                       arrival_process=args.arrival_process)
 
 
 def _report(args, trace, outputs, logs, tracer, waste, slo, failures,
@@ -416,6 +433,29 @@ def _fleet_main(args):
                 f"warm failover did not strictly reduce prefill chunks: "
                 f"{chunks_warm} vs cold {chunks_cold}")
 
+    # fleet invariant 8 (poisson arrivals): shed determinism — the shed set
+    # (and so the shed RATE) must be a pure function of the seeded trace and
+    # the admission bounds. Re-route the identical trace through a fresh
+    # router (shared model/params, so no recompiles) and require the same
+    # terminal status on every request.
+    shed_rate = len(shed) / max(len(trace), 1)
+    if args.arrival_process is not None:
+        _, outs_re, _ = _run_fleet(
+            args, None, model_params, policy=args.fleet_policy,
+            cold_failover=False, snapshot_dir=snapshot_dir)
+        st = {o.req_id: o.status for o in outputs}
+        st_re = {o.req_id: o.status for o in outs_re}
+        if st != st_re:
+            diff = sorted(r for r in st if st[r] != st_re.get(r))
+            failures.append(
+                f"shed determinism violated: terminal status changed on "
+                f"{len(diff)} request(s) across identical replays "
+                f"({', '.join(diff[:8])})")
+        shed_re = sum(1 for s in st_re.values() if s == "shed")
+        if shed_re != len(shed):
+            failures.append(f"shed rate not deterministic: {len(shed)} vs "
+                            f"{shed_re} shed across identical replays")
+
     spec_totals = fleet_serving_totals(bundles)
 
     if args.transcript:
@@ -434,12 +474,14 @@ def _fleet_main(args):
                      "max_queue_depth": args.max_queue_depth,
                      "occupancy_cap": args.occupancy_cap,
                      "arrival_scale": args.arrival_scale,
+                     "arrival": args.arrival,
                      "shared_prefix": args.shared_prefix,
                      "kill": [list(k) for k in args.kill],
                      "speculate": args.speculate},
             "n_finished": len(finished),
             "n_refused": len(refused),
             "n_shed": len(shed),
+            "shed_rate": round(shed_rate, 6),
             "kills": router.kills_applied,
             "prefill_chunks": list(router.prefill_chunks),
             "total_prefill_chunks": sum(router.prefill_chunks),
@@ -626,6 +668,14 @@ def main(argv=None):
     ap.add_argument("--arrival-scale", type=float, default=1.0,
                     help="scale the seeded inter-arrival gaps (0.0 = all "
                          "requests arrive at once, past saturation)")
+    ap.add_argument("--arrival", default="default", metavar="PROCESS",
+                    help="arrival process: 'default' (seeded 0-2 iteration "
+                         "stagger) or 'poisson:RATE' (seeded Poisson process "
+                         "at RATE requests/iteration — arrivals bunch, so a "
+                         "rate past service capacity crosses any "
+                         "--max-queue-depth bound and sheds; with --fleet "
+                         "the run re-routes the trace a second time and "
+                         "asserts the shed set is deterministic)")
     ap.add_argument("--snapshot-dir", default=None, metavar="DIR",
                     help="warm-failover snapshot directory (default: a "
                          "fresh temp dir)")
@@ -634,6 +684,17 @@ def main(argv=None):
                           or args.dump_ledger):
         ap.error("--no-trace is incompatible with --slo-*/--dump-ledger "
                  "(they need the ledger)")
+    args.arrival_process = None
+    if args.arrival != "default":
+        kind, sep, rate_s = args.arrival.partition(":")
+        try:
+            rate = float(rate_s)
+        except ValueError:
+            rate = 0.0
+        if kind != "poisson" or not sep or not rate > 0.0:
+            ap.error("--arrival must be 'default' or 'poisson:RATE' with "
+                     f"RATE > 0, got {args.arrival!r}")
+        args.arrival_process = (kind, rate)
     args.kill = [_parse_kill(ap, s, args.fleet) for s in (args.kill or [])]
     if args.fleet:
         if args.fleet < 1:

@@ -234,11 +234,25 @@ def analyze_program(hlo_text, flops, hbm_bytes, spec, slice_sets=None,
 
 def analyze_artifact(artifact, spec, slice_sets=None):
     """``analyze_program`` over one lint ``ProgramArtifact`` (optimized HLO +
-    cost_analysis stats)."""
+    cost_analysis stats). The report carries the memory cross-link — the
+    entry-layout byte attribution from utils/hbm's parsers — so one sweep
+    answers both where the step's *time* and where its *HBM* go."""
     cost = getattr(artifact, "cost_stats", {}) or {}
-    return analyze_program(artifact.hlo_text, cost.get("flops", 0.0),
-                           cost.get("bytes_accessed", 0.0), spec,
-                           slice_sets=slice_sets, name=artifact.name)
+    report = analyze_program(artifact.hlo_text, cost.get("flops", 0.0),
+                             cost.get("bytes_accessed", 0.0), spec,
+                             slice_sets=slice_sets, name=artifact.name)
+    try:
+        table = hlo.entry_buffer_table(artifact.hlo_text)
+        report["memory"] = {
+            "parameter_bytes": table["parameter_bytes"],
+            "aliased_result_bytes": table["aliased_result_bytes"],
+            "unaliased_result_bytes": table["unaliased_result_bytes"],
+            "temp_estimate_bytes":
+                hlo.temp_allocation_estimate(artifact.hlo_text),
+        }
+    except Exception:  # anatomy must not die on an unparsable entry layout
+        report["memory"] = None
+    return report
 
 
 def opportunities(reports, min_bytes=DEFAULT_OPPORTUNITY_MIN_BYTES):
@@ -288,6 +302,7 @@ def _program_json(report):
             "predicted_floor_us": _us(rf["predicted_floor_s"]),
             "mfu_ceiling": round(rf["mfu_ceiling"], 4),
         },
+        "memory": report.get("memory"),
     }
 
 

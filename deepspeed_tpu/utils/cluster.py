@@ -477,8 +477,8 @@ class ClusterMonitor:
             dispatch_ms = float(t.last_dispatch_ms)
         wire_ici = float(t.last_wire_bytes_ici) if t is not None else 0.0
         wire_dcn = float(t.last_wire_bytes_dcn) if t is not None else 0.0
-        from .telemetry import hbm_stats
-        stats = hbm_stats()
+        from .hbm import device_memory_stats
+        stats = device_memory_stats()
         hbm = float((stats or {}).get("peak_bytes_in_use", 0))
         return [float(step), time.time(), step_ms, dispatch_ms,
                 wire_ici, wire_dcn, hbm]

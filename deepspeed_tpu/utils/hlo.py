@@ -502,6 +502,157 @@ def entry_result_types(hlo_text):
     return _shaped_types(body.split("->", 1)[1])
 
 
+# ------------------------------------------------------------- buffer table
+# The module header's buffer_donor set names parameters the caller donated but
+# XLA left unaliased (they are still freed, just not reused in place):
+#   buffer_donor={ (1, {}), (3, {}) }
+_BUFFER_DONOR_RE = re.compile(r"buffer_donor=\{((?:[^{}]|\{[^}]*\})*)\}")
+_BUFFER_DONOR_ENTRY_RE = re.compile(r"\((\d+),\s*\{[0-9, ]*\}\)")
+
+
+def _type_bytes(shaped):
+    return sum(_elements(dims) * _DTYPE_BYTES.get(dt, 0) for dt, dims in shaped)
+
+
+def entry_buffer_table(hlo_text):
+    """Per-buffer view of an optimized program's entry interface — the HBM
+    observatory's parsing surface (utils/hbm.py classifies these rows against
+    the engine's memory manifest).
+
+    Returns::
+
+        {"parameters": [{"param": i, "leaves": [(dtype, dims, bytes)],
+                         "bytes": total, "donated": bool,
+                         "aliased_outputs": [output_index tuples]}],
+         "results": [{"index": j, "dtype": dt, "dims": dims, "bytes": b,
+                      "aliased": bool}],
+         "parameter_bytes": int, "result_bytes": int,
+         "aliased_result_bytes": int, "unaliased_result_bytes": int}
+
+    Shapes are the post-SPMD per-device shapes of the compiled module (one
+    entry parameter per flattened pytree leaf under jit). ``donated`` is true
+    when the parameter appears in either donation header (``input_output_alias``
+    — donation honored in place — or ``buffer_donor`` — donated, freed, but not
+    aliased to an output). A result leaf is ``aliased`` when an input buffer
+    backs it, i.e. it occupies no HBM beyond its parameter's bytes."""
+    body = _entry_layout_body(hlo_text)
+    if body is None or "->" not in body:
+        return {"parameters": [], "results": [], "parameter_bytes": 0,
+                "result_bytes": 0, "aliased_result_bytes": 0,
+                "unaliased_result_bytes": 0}
+    params_str, result_str = body.split("->", 1)
+    params_str = params_str.strip()
+    if params_str.startswith("(") and params_str.endswith(")"):
+        params_str = params_str[1:-1]
+    aliases = input_output_aliases(hlo_text)
+    donors = set()
+    m = _BUFFER_DONOR_RE.search(hlo_text)
+    if m:
+        donors = {int(p) for p in _BUFFER_DONOR_ENTRY_RE.findall(m.group(1))}
+    aliased_outputs = {tuple(out_idx)
+                       for rows in aliases.values()
+                       for out_idx, _param_idx, _kind in rows}
+    parameters = []
+    for i, part in enumerate(_split_top_level(params_str)):
+        shaped = _shaped_types(part)
+        leaves = [(dt, dims, _elements(dims) * _DTYPE_BYTES.get(dt, 0))
+                  for dt, dims in shaped]
+        parameters.append({
+            "param": i,
+            "leaves": leaves,
+            "bytes": sum(b for _dt, _dims, b in leaves),
+            "donated": i in aliases or i in donors,
+            "aliased_outputs": sorted(out_idx for out_idx, _pi, _k in
+                                      aliases.get(i, [])),
+        })
+    result_str = result_str.strip()
+    if result_str.startswith("(") and result_str.endswith(")"):
+        result_str = result_str[1:-1]
+        result_parts = _split_top_level(result_str)
+    else:
+        result_parts = [result_str]
+    results = []
+    for j, part in enumerate(result_parts):
+        shaped = _shaped_types(part)
+        if not shaped:
+            continue
+        dt, dims = shaped[0]
+        results.append({
+            "index": j, "dtype": dt, "dims": dims,
+            "bytes": _type_bytes(shaped),
+            "aliased": (j,) in aliased_outputs or (() in aliased_outputs
+                                                   and len(result_parts) == 1),
+        })
+    parameter_bytes = sum(p["bytes"] for p in parameters)
+    result_bytes = sum(r["bytes"] for r in results)
+    aliased_result_bytes = sum(r["bytes"] for r in results if r["aliased"])
+    return {
+        "parameters": parameters,
+        "results": results,
+        "parameter_bytes": parameter_bytes,
+        "result_bytes": result_bytes,
+        "aliased_result_bytes": aliased_result_bytes,
+        "unaliased_result_bytes": result_bytes - aliased_result_bytes,
+    }
+
+
+_USE_RE = re.compile(r"%([\w.-]+)")
+
+
+def temp_allocation_estimate(hlo_text):
+    """Analytic peak-temp estimate: a def-to-last-use liveness scan over the
+    ENTRY computation's instruction lines. Each non-parameter instruction's
+    result bytes go live at its definition line and die after the last line
+    referencing it; the estimate is the peak of concurrently-live bytes,
+    excluding parameters (argument bytes) and the ROOT tuple (output bytes) —
+    i.e. the same bucket ``memory_analysis().temp_size_in_bytes`` measures.
+
+    Fusion-internal buffers are invisible at this granularity (a fusion's
+    temp is its result), so the estimate is a scheduling-free LOWER-bound
+    companion to the measured temp watermark, good for attribution and
+    cross-run comparison rather than exact byte parity."""
+    lines = hlo_text.splitlines()
+    entry_start = None
+    for i in range(len(lines) - 1, -1, -1):
+        if lines[i].startswith("ENTRY "):
+            entry_start = i
+            break
+    if entry_start is None:
+        return 0
+    entry_end = len(lines)
+    for i in range(entry_start + 1, len(lines)):
+        if lines[i].startswith("}"):
+            entry_end = i
+            break
+    defs = {}       # name -> (def line, bytes)
+    last_use = {}   # name -> last line referencing it as an operand
+    for i in range(entry_start + 1, entry_end):
+        line = lines[i]
+        name_m = _DEF_NAME_RE.match(line)
+        if not name_m:
+            continue
+        name = name_m.group(1)
+        is_param = " parameter(" in line
+        is_root = line.lstrip().startswith("ROOT ")
+        if not is_param and not is_root:
+            defs[name] = (i, result_bytes(line))
+        for used in _USE_RE.findall(line.split("=", 1)[1]):
+            if used != name:
+                last_use[used] = i
+    deaths = {}
+    for name, (_def_line, b) in defs.items():
+        deaths.setdefault(last_use.get(name, entry_end), []).append(name)
+    live = peak = 0
+    for i in range(entry_start + 1, entry_end):
+        for name, (def_line, b) in defs.items():
+            if def_line == i:
+                live += b
+        peak = max(peak, live)
+        for name in deaths.get(i, ()):
+            live -= defs[name][1]
+    return peak
+
+
 _F32_DOT_RE = re.compile(r"%?([\w.-]+) = f32\[[^\]]*\][^ ]* dot\(([^)]*)\)")
 # optimized HLO annotates operands inline (`convert(bf16[8]{0} %x)`); the
 # pre-backend module the dtype lint reads writes bare names (`convert(x.4)`),

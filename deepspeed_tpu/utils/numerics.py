@@ -303,6 +303,20 @@ class FlightRecorder:
             out["serving_request_trace"] = self.request_trace.bundle()
         if self.cluster is not None:
             out["cluster"] = self.cluster.bundle()
+        snap = None
+        if self.telemetry is not None:
+            snapper = getattr(self.telemetry, "memory_snapshot", None)
+            if snapper is not None:
+                try:
+                    snap = snapper()
+                except Exception:  # forensics must never block the dump
+                    snap = None
+        if snap is not None:
+            try:
+                from .hbm import oom_forensics
+                out["hbm"] = oom_forensics(snap)
+            except Exception:
+                out["hbm"] = {"error": "oom_forensics failed", "snapshot": snap}
         return out
 
     def _span(self):

@@ -55,16 +55,24 @@ def _abstract_signature(args) -> tuple:
     return tuple(sig)
 
 
+_mem_unavailable_warned = set()   # backends already named in a warning
+
+
 def _analyze_compiled(compiled, slice_sets=None, anatomy_spec=None):
     """(flops, argument/output/temp bytes, collective wire bytes, wire bytes
-    split (ici, dcn), HBM bytes accessed, anatomy report) of a compiled
-    executable, each 0/None when the backend doesn't report it. With no slice
-    factorization every wire byte accounts as ICI. The anatomy report
-    (utils/anatomy.analyze_program) is computed only when ``anatomy_spec``
-    names a chip spec — pure host-side text analysis of the same artifact."""
+    split (ici, dcn), HBM bytes accessed, anatomy report, mem_unavailable) of
+    a compiled executable, each 0/None when the backend doesn't report it.
+    With no slice factorization every wire byte accounts as ICI. The anatomy
+    report (utils/anatomy.analyze_program) is computed only when
+    ``anatomy_spec`` names a chip spec — pure host-side text analysis of the
+    same artifact. ``mem_unavailable`` is True when ``memory_analysis()``
+    raised or returned nothing — recorded so its zeros are distinguishable
+    from a genuinely zero-byte program, with one warning per backend per
+    session instead of a silent pass."""
     flops = hbm_b = 0.0
     arg_b = out_b = tmp_b = wire = wire_ici = wire_dcn = 0
     anatomy = None
+    mem_unavailable = False
     try:
         ca = compiled.cost_analysis()
         if not isinstance(ca, dict):  # older jax returned [dict]
@@ -75,11 +83,25 @@ def _analyze_compiled(compiled, slice_sets=None, anatomy_spec=None):
         pass
     try:
         mem = compiled.memory_analysis()
+        if mem is None:
+            raise RuntimeError("memory_analysis() returned None")
         arg_b = int(getattr(mem, "argument_size_in_bytes", 0) or 0)
         out_b = int(getattr(mem, "output_size_in_bytes", 0) or 0)
         tmp_b = int(getattr(mem, "temp_size_in_bytes", 0) or 0)
-    except Exception:
-        pass
+    except Exception as e:
+        mem_unavailable = True
+        backend = "unknown"
+        try:
+            backend = jax.default_backend()
+        except Exception:
+            pass
+        if backend not in _mem_unavailable_warned:
+            _mem_unavailable_warned.add(backend)
+            logger.warning(
+                f"[deepspeed_tpu] telemetry: compiled memory_analysis is "
+                f"unavailable on the {backend!r} backend ({e!r}); compile "
+                f"records carry mem_unavailable=True and zero arg/out/temp "
+                f"bytes (watermark-based HBM attribution is off)")
     try:
         from .hlo import collective_bytes, collective_axis_bytes
         text = compiled.as_text()
@@ -95,7 +117,7 @@ def _analyze_compiled(compiled, slice_sets=None, anatomy_spec=None):
     except Exception:
         pass
     return (flops, arg_b, out_b, tmp_b, wire, wire_ici, wire_dcn, hbm_b,
-            anatomy)
+            anatomy, mem_unavailable)
 
 
 class CompileRecord:
@@ -103,11 +125,13 @@ class CompileRecord:
 
     __slots__ = ("signature", "compile_seconds", "flops", "argument_bytes",
                  "output_bytes", "temp_bytes", "wire_bytes", "wire_bytes_ici",
-                 "wire_bytes_dcn", "hbm_bytes", "anatomy", "count")
+                 "wire_bytes_dcn", "hbm_bytes", "anatomy", "mem_unavailable",
+                 "count")
 
     def __init__(self, signature, compile_seconds, flops=0.0, argument_bytes=0,
                  output_bytes=0, temp_bytes=0, wire_bytes=0, wire_bytes_ici=0,
-                 wire_bytes_dcn=0, hbm_bytes=0.0, anatomy=None):
+                 wire_bytes_dcn=0, hbm_bytes=0.0, anatomy=None,
+                 mem_unavailable=False):
         self.signature = signature
         self.compile_seconds = compile_seconds
         self.flops = flops
@@ -119,6 +143,8 @@ class CompileRecord:
         self.wire_bytes_dcn = wire_bytes_dcn
         self.hbm_bytes = hbm_bytes          # cost_analysis "bytes accessed"
         self.anatomy = anatomy              # utils/anatomy report or None
+        self.mem_unavailable = mem_unavailable  # memory_analysis absent: the
+        # zero arg/out/temp bytes above mean "not reported", not "zero bytes"
         self.count = 1
 
 
@@ -148,14 +174,14 @@ class CompileWatchdog:
         else:
             if compiled is not None:
                 (flops, arg_b, out_b, tmp_b, wire, wire_ici, wire_dcn,
-                 hbm_b, anatomy) = _analyze_compiled(compiled, self.slice_sets,
-                                                     self.anatomy_spec)
+                 hbm_b, anatomy, mem_unavail) = _analyze_compiled(
+                     compiled, self.slice_sets, self.anatomy_spec)
             else:
                 flops = arg_b = out_b = tmp_b = wire = wire_ici = wire_dcn = 0
-                hbm_b, anatomy = 0.0, None
+                hbm_b, anatomy, mem_unavail = 0.0, None, False
             rec = per[sig] = CompileRecord(sig, seconds, flops, arg_b, out_b,
                                            tmp_b, wire, wire_ici, wire_dcn,
-                                           hbm_b, anatomy)
+                                           hbm_b, anatomy, mem_unavail)
         n = sum(r.count for r in per.values())
         if len(per) >= self.recompile_warn and name not in self._storm_warned:
             self._storm_warned.add(name)
@@ -259,12 +285,11 @@ class _WatchedJit:
 
 def hbm_stats() -> Optional[Dict[str, int]]:
     """device 0's memory_stats dict, or None where the backend doesn't report
-    them (CPU returns None; TPU/GPU report bytes_in_use / peak_bytes_in_use)."""
-    try:
-        stats = jax.local_devices()[0].memory_stats()
-    except Exception:
-        return None
-    return stats or None
+    them (CPU returns None; TPU/GPU report bytes_in_use / peak_bytes_in_use).
+    Thin alias of utils/hbm.device_memory_stats — the package's single
+    memory_stats read."""
+    from .hbm import device_memory_stats
+    return device_memory_stats()
 
 
 class TelemetrySession:
@@ -326,6 +351,13 @@ class TelemetrySession:
         self._last_exp_dcn = 0.0
         self._last_compiles = 0
 
+        # HBM observatory (docs/hbm.md): per-class resident bytes from the
+        # engine's memory_manifest — host dicts only, set once at wiring time,
+        # emitted as Memory/* scalars in end_step (no device work ever)
+        self._memory_class_bytes = None
+        self._memory_geometry = None
+        self._forecast_config = None
+
         self._trace_active = False
         self._trace_done = False
         self._trace_failed = False
@@ -352,6 +384,34 @@ class TelemetrySession:
         self.hbm_bytes_executed += hbm_bytes
         self.exposed_ici_executed += exposed_ici_s
         self.exposed_dcn_executed += exposed_dcn_s
+
+    def set_memory_manifest(self, class_bytes, geometry=None,
+                            forecast_config=None):
+        """Install the engine's per-class resident-byte attribution
+        (utils/hbm.manifest_signatures over engine.memory_manifest()).
+        ``class_bytes`` is a host dict {class: per-device bytes}; ``geometry``
+        the manifest's predictor geometry; ``forecast_config`` an optional
+        utils/hbm.forecast config enabling fitting-delta suggestions in the
+        flight recorder's OOM forensics. Pure host state — end_step emits the
+        classes as ``Memory/*`` scalars and nothing about the compiled step
+        changes (HLO-instruction-identity is pinned in tests)."""
+        self._memory_class_bytes = dict(class_bytes) if class_bytes else None
+        self._memory_geometry = dict(geometry) if geometry else None
+        self._forecast_config = forecast_config
+
+    def memory_snapshot(self) -> Optional[Dict[str, Any]]:
+        """The OOM-forensics input: manifest classes + geometry + the device
+        watermarks + the watchdog's compiled-temp peak. None when no manifest
+        was installed (telemetry.hbm off)."""
+        if self._memory_class_bytes is None:
+            return None
+        return {
+            "classes": dict(self._memory_class_bytes),
+            "geometry": dict(self._memory_geometry or {}),
+            "measured": hbm_stats(),
+            "temp_peak_bytes": self.watchdog.peak_temp_bytes(),
+            "forecast_config": self._forecast_config,
+        }
 
     def set_comm_topology(self, slice_sets):
         """Install the slice factorization (list of per-slice device-id sets,
@@ -521,6 +581,15 @@ class TelemetrySession:
             mon.add_scalar("Telemetry/Samples/hbm_peak_bytes",
                            stats.get("peak_bytes_in_use", 0), samples)
         mon.add_scalar("Telemetry/Samples/compile_count", compiles, samples)
+        # per-class resident-HBM attribution: host constants installed once by
+        # the engine via set_memory_manifest — no device syncs, and the
+        # compiled step is untouched (HLO-instruction-identity pinned in
+        # tests). Scalars appear/disappear with telemetry.hbm only.
+        if self._memory_class_bytes is not None:
+            for cls, nbytes in sorted(self._memory_class_bytes.items()):
+                mon.add_scalar(f"Memory/{cls}_bytes", nbytes, samples)
+            mon.add_scalar("Memory/compiled_temp_peak_bytes",
+                           self.watchdog.peak_temp_bytes(), samples)
         # step anatomy: the roofline attribution of this step's measured wall
         # time. Pure arithmetic over counters the proxies already fed — the
         # scalars appear or disappear with telemetry.anatomy, nothing else

@@ -84,14 +84,13 @@ class SynchronizedWallClockTimer:
 
     @staticmethod
     def memory_usage() -> str:
-        try:
-            import jax
-            stats = jax.local_devices()[0].memory_stats() or {}
-            in_use = stats.get("bytes_in_use", 0) / (1024**3)
-            peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
-            return f"Mem in-use {round(in_use, 2)} GB | peak {round(peak, 2)} GB"
-        except Exception:
+        from .hbm import device_memory_stats
+        stats = device_memory_stats()
+        if stats is None:
             return "Mem stats unavailable"
+        in_use = stats.get("bytes_in_use", 0) / (1024**3)
+        peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
+        return f"Mem in-use {round(in_use, 2)} GB | peak {round(peak, 2)} GB"
 
     def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True, memory_breakdown: bool = False):
         assert normalizer > 0.0

@@ -87,6 +87,21 @@ timeout -k 10 300 "$REPO/bin/ds-tpu" anatomy --json --out /tmp/_anatomy.json \
 && cmp "$REPO/tests/unit/golden/anatomy_comm_compare.json" \
        /tmp/_anatomy_comm.json
 anatomy_rc=$?
+# hbm: memory-observatory gate — per-buffer attribution parsed from every
+# lint-registry program's entry layout, reconciled against the analytic ZeRO
+# memory model within the pinned tolerance ON EVERY ENTRY (`ds-tpu hbm`
+# exits 1 on any drift), plus the round-5 OOM-frontier forecast re-derived
+# offline (every OOMed PERF.md config predicted infeasible, the winner
+# feasible, no compile executed). The stable projection (parsed/modeled
+# bytes + verdicts, no XLA-scheduler-dependent watermarks) is byte-compared
+# against the committed golden so any attribution drift fails CI.
+timeout -k 10 300 "$REPO/bin/ds-tpu" hbm --json --out /tmp/_hbm.json \
+    --golden-out /tmp/_hbm_golden.json \
+&& cmp "$REPO/tests/unit/golden/hbm_registry_sweep.json" \
+       /tmp/_hbm_golden.json \
+&& timeout -k 10 60 "$REPO/bin/ds-tpu" hbm --forecast round5 \
+    --json --out /tmp/_hbm_round5.json
+hbm_rc=$?
 # crash-sim: seeded kill-point sweep (mid-save, between shard writes,
 # auto-resume selection, mid-decode, post-preemption) — every scenario must
 # recover (bit-equal retrain / warm token-identical restart), and the
@@ -146,6 +161,7 @@ fleet_rc=$?
 [ "$spec_rc" -ne 0 ] && exit "$spec_rc"
 [ "$shard_rc" -ne 0 ] && exit "$shard_rc"
 [ "$anatomy_rc" -ne 0 ] && exit "$anatomy_rc"
+[ "$hbm_rc" -ne 0 ] && exit "$hbm_rc"
 [ "$crash_rc" -ne 0 ] && exit "$crash_rc"
 [ "$goodput_rc" -ne 0 ] && exit "$goodput_rc"
 [ "$hang_rc" -ne 0 ] && exit "$hang_rc"

@@ -171,3 +171,32 @@ def test_engine_trace_replays_byte_identically():
                       o.preemptions) for o in outs]})
 
     assert serialize() == serialize()
+
+
+def test_synth_trace_poisson_arrivals():
+    """Poisson mode: seeded-deterministic, non-decreasing integer arrivals
+    whose mean inter-arrival tracks 1/rate, while the default path's trace
+    stays byte-identical to a poisson-free build (separate RNG draws)."""
+    from deepspeed_tpu.serve.sim import synth_trace
+
+    kw = dict(vocab_size=64, max_model_len=32, seed=7)
+    a = synth_trace(64, arrival_process=("poisson", 2.0), **kw)
+    b = synth_trace(64, arrival_process=("poisson", 2.0), **kw)
+    assert [r.arrival for r in a] == [r.arrival for r in b]
+    arrivals = [r.arrival for r in a]
+    assert arrivals == sorted(arrivals)
+    assert all(isinstance(t, int) for t in arrivals)
+    # 64 draws at rate 2/iter → span ≈ 32 iterations; loose 2x bounds
+    assert 16 <= arrivals[-1] <= 64
+    # a hotter rate compresses the same trace's span
+    hot = [r.arrival for r in
+           synth_trace(64, arrival_process=("poisson", 8.0), **kw)]
+    assert hot[-1] < arrivals[-1]
+    # default mode draws nothing extra: byte-equal with and without the arg
+    d1 = synth_trace(8, **kw)
+    d2 = synth_trace(8, arrival_process=None, **kw)
+    assert [(r.req_id, r.arrival, r.prompt) for r in d1] == \
+           [(r.req_id, r.arrival, r.prompt) for r in d2]
+
+    with pytest.raises(ValueError, match="unknown arrival process"):
+        synth_trace(4, arrival_process=("uniform", 1.0), **kw)
