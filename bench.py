@@ -111,6 +111,14 @@ REGRESSION_KEYS = (
     "extra.hbm.peak_by_class.master",
     "extra.hbm.peak_by_class.optimizer",
     "extra.hbm.peak_by_class.compiled_temp_peak",
+    # measured-time profile observatory (docs/profile.md): per-step exposed
+    # collective time and host gap from the smoke trace window (all
+    # lower-is-better — a RISE means overlap regressed), plus the measured
+    # window MFU beside the rolling estimate
+    "extra.profile.exposed_ici_ms",
+    "extra.profile.exposed_dcn_ms",
+    "extra.profile.host_gap_ms",
+    "extra.profile.measured_mfu",
     # resilience ledger: caller-thread checkpoint stall and the warm/cold
     # restart TTFT ratio (docs/resilience.md) — both lower-is-better
     "extra.resilience.checkpoint_stall_ms",
@@ -139,6 +147,9 @@ LOWER_IS_BETTER_KEYS = frozenset(
         "extra.hbm.peak_by_class.master",
         "extra.hbm.peak_by_class.optimizer",
         "extra.hbm.peak_by_class.compiled_temp_peak",
+        "extra.profile.exposed_ici_ms",
+        "extra.profile.exposed_dcn_ms",
+        "extra.profile.host_gap_ms",
     })
 
 
@@ -257,6 +268,15 @@ def _telemetry_probe_420m(model, cfg, mesh, batch, tokens, labels, steps=8):
                                               "peak_tflops": PEAK_TFLOPS,
                                               "mfu_window": steps,
                                               "output_path": tel_dir,
+                                              # one traced 2-step window mid-probe;
+                                              # the profile observatory ingests it and
+                                              # summary()["profile"] carries the
+                                              # measured decomposition next to
+                                              # anatomy's prediction (docs/profile.md)
+                                              "trace_steps": [4, 6],
+                                              "trace_dir": os.path.join(
+                                                  tel_dir, "trace"),
+                                              "profile": {"enabled": True},
                                               # chip auto-detected from device_kind;
                                               # summary()["anatomy"] then carries the
                                               # roofline floor + MFU ceiling beside
@@ -1403,6 +1423,7 @@ def main():
         B = max(4, jax.device_count())
         # the smoke engine carries telemetry directly: on CPU the per-step loss
         # fetch is cheap, and the smoke JSON doubles as a telemetry demo
+        smoke_tel_dir = tempfile.mkdtemp(prefix="ds_bench_telemetry_")
         engine = DeepSpeedEngine(model=model, model_parameters=params,
                                  mesh=build_mesh(model=1, pipe=1),
                                  config_params={"train_batch_size": B,
@@ -1410,8 +1431,18 @@ def main():
                                                 "zero_optimization": {"stage": 2},
                                                 "telemetry": {"enabled": True,
                                                               "peak_tflops": PEAK_TFLOPS,
-                                                              "output_path": tempfile.mkdtemp(
-                                                                  prefix="ds_bench_telemetry_"),
+                                                              "output_path": smoke_tel_dir,
+                                                              # trace window over the two
+                                                              # clean post-window steps;
+                                                              # the profile observatory
+                                                              # ingests it so extra.profile
+                                                              # carries the MEASURED
+                                                              # decomposition beside
+                                                              # anatomy's predicted one
+                                                              "trace_steps": [3, 5],
+                                                              "trace_dir": os.path.join(
+                                                                  smoke_tel_dir, "trace"),
+                                                              "profile": {"enabled": True},
                                                               # anatomy prices the same
                                                               # PEAK_TFLOPS so the MFU
                                                               # ceiling is comparable to
@@ -1493,6 +1524,10 @@ def main():
                             "mfu_ceiling": anatomy.get("mfu_ceiling"),
                             "anatomy_predicted_floor_ms":
                                 anatomy.get("predicted_floor_ms"),
+                            # measured-time decomposition of the traced window
+                            # (None when the profiler backend is unavailable —
+                            # telemetry.trace.failed above says why)
+                            "profile": telemetry.get("profile"),
                             "pipeline_goodput": pipeline_goodput,
                             "serving": serving,
                             "serving_prefix_cache": serving_prefix,

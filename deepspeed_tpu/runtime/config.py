@@ -385,6 +385,37 @@ class DeepSpeedConfig:
                 "DeepSpeedConfig: telemetry.hbm.enabled must be a bool, got "
                 f"{self.telemetry_hbm_enabled!r}")
 
+        prof_dict = tel_dict.get(TELEMETRY_PROFILE, {}) or {}
+        self._warn_unknown_nested(f"{TELEMETRY}.{TELEMETRY_PROFILE}",
+                                  prof_dict, PROFILE_CONFIG_KEYS)
+        self.telemetry_profile_enabled = get_scalar_param(
+            prof_dict, PROFILE_ENABLED, PROFILE_ENABLED_DEFAULT)
+        if not isinstance(self.telemetry_profile_enabled, bool):
+            raise ValueError(
+                "DeepSpeedConfig: telemetry.profile.enabled must be a bool, "
+                f"got {self.telemetry_profile_enabled!r}")
+        if self.telemetry_profile_enabled and not self.telemetry_enabled:
+            raise ValueError(
+                "DeepSpeedConfig: telemetry.profile.enabled requires "
+                "telemetry.enabled — the observatory ingests the trace window "
+                "the telemetry session writes")
+        self.telemetry_profile_reconcile_tolerance = get_scalar_param(
+            prof_dict, PROFILE_RECONCILE_TOLERANCE,
+            PROFILE_RECONCILE_TOLERANCE_DEFAULT)
+        tol = self.telemetry_profile_reconcile_tolerance
+        if isinstance(tol, bool) or not isinstance(tol, (int, float)) \
+                or tol <= 0:
+            raise ValueError(
+                "DeepSpeedConfig: telemetry.profile.reconcile_tolerance must "
+                f"be a number > 0, got {tol!r}")
+        self.telemetry_profile_reconcile_tolerance = float(tol)
+        self.telemetry_profile_emit_scalars = get_scalar_param(
+            prof_dict, PROFILE_EMIT_SCALARS, PROFILE_EMIT_SCALARS_DEFAULT)
+        if not isinstance(self.telemetry_profile_emit_scalars, bool):
+            raise ValueError(
+                "DeepSpeedConfig: telemetry.profile.emit_scalars must be a "
+                f"bool, got {self.telemetry_profile_emit_scalars!r}")
+
         num_dict = param_dict.get(NUMERICS, {})
         self._warn_unknown_nested(NUMERICS, num_dict, NUMERICS_CONFIG_KEYS)
         self.numerics_enabled = get_scalar_param(num_dict, NUMERICS_ENABLED, NUMERICS_ENABLED_DEFAULT)

@@ -266,6 +266,23 @@ TELEMETRY_HBM = "hbm"
 HBM_ENABLED = "enabled"
 HBM_ENABLED_DEFAULT = False
 
+# telemetry.profile sub-block: measured-time profile observatory — reads the
+# trace window's profiler JSON back after it closes, classifies the device
+# timeline per named scope, and reconciles measured vs predicted (anatomy) vs
+# derived (step counters) step time (docs/profile.md). Host-side file parsing
+# only; the lowered step program is HLO-instruction-identical with the block
+# on or off. Requires telemetry.enabled (and a trace window to have anything
+# to ingest).
+TELEMETRY_PROFILE = "profile"
+PROFILE_ENABLED = "enabled"
+PROFILE_ENABLED_DEFAULT = False
+# relative tolerance of the ds-tpu profile --reconcile verdicts (the
+# machine-independent pairs: flops, collective counts, wire bytes)
+PROFILE_RECONCILE_TOLERANCE = "reconcile_tolerance"
+PROFILE_RECONCILE_TOLERANCE_DEFAULT = 0.05
+PROFILE_EMIT_SCALARS = "emit_scalars"
+PROFILE_EMIT_SCALARS_DEFAULT = True
+
 #############################################
 # Numerics observatory (TPU-native health layer on top of telemetry; no
 # reference key — in-graph per-subtree anomaly sentinel, loss-scale event
@@ -600,6 +617,7 @@ TELEMETRY_CONFIG_KEYS = frozenset({
     TELEMETRY_CLUSTER,
     TELEMETRY_GOODPUT,
     TELEMETRY_HBM,
+    TELEMETRY_PROFILE,
 })
 
 ANATOMY_CONFIG_KEYS = frozenset({
@@ -636,6 +654,12 @@ GOODPUT_CONFIG_KEYS = frozenset({
 
 HBM_CONFIG_KEYS = frozenset({
     HBM_ENABLED,
+})
+
+PROFILE_CONFIG_KEYS = frozenset({
+    PROFILE_ENABLED,
+    PROFILE_RECONCILE_TOLERANCE,
+    PROFILE_EMIT_SCALARS,
 })
 
 NUMERICS_CONFIG_KEYS = frozenset({

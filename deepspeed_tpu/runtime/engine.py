@@ -508,6 +508,18 @@ class DeepSpeedEngine:
                 output_path=self.config.telemetry_output_path or None,
                 job_name=self.config.telemetry_job_name,
                 anatomy_spec=anatomy_spec)
+            # measured-time profile observatory (docs/profile.md): configured
+            # BEFORE _compile_steps so every step program's compile also
+            # records the scope/collective identity catalog the trace
+            # ingester joins on — host-side text analysis only, the compiled
+            # step is HLO-instruction-identical on or off (pinned in tests)
+            if self.config.telemetry_profile_enabled:
+                self.telemetry.configure_profile(
+                    True,
+                    reconcile_tolerance=(
+                        self.config.telemetry_profile_reconcile_tolerance),
+                    emit_scalars=(
+                        self.config.telemetry_profile_emit_scalars))
             if self._comm_topo.is_hierarchical:
                 # per-axis wire ledger: split every program's collective bytes
                 # into ICI (intra-slice) vs DCN (cross-slice) — installed before

@@ -306,6 +306,46 @@ def _program_json(report):
     }
 
 
+def program_schedule_events(report, pid, floor_tid=0, comm_tid=1,
+                            sort_base=0, label_prefix=""):
+    """The predicted-schedule track pair of ONE program report: the binding
+    compute/HBM floor slice on ``floor_tid``, the exposed collectives laid end
+    to end after it on ``comm_tid``. Shared between ``ds-tpu anatomy``'s
+    per-program processes and ``ds-tpu profile``'s merged
+    measured-vs-predicted timeline (which stacks every program's pair inside
+    one "predicted schedule" process, hence the tid/label knobs)."""
+    rf = report["roofline"]
+    events = []
+    events += thread_meta_events(pid, floor_tid,
+                                 label_prefix + "roofline floor",
+                                 sort_index=sort_base)
+    events += thread_meta_events(pid, comm_tid, label_prefix + "exposed comm",
+                                 sort_index=sort_base + 1)
+    bound_s = max(rf["compute_floor_s"], rf["hbm_floor_s"])
+    binding = ("compute floor"
+               if rf["compute_floor_s"] >= rf["hbm_floor_s"]
+               else "hbm floor")
+    events.append(complete_slice(
+        pid, floor_tid, 0, _us(bound_s), binding, "roofline",
+        {"compute_floor_us": _us(rf["compute_floor_s"]),
+         "hbm_floor_us": _us(rf["hbm_floor_s"]),
+         "mfu_ceiling": round(rf["mfu_ceiling"], 4)}))
+    ts = _us(bound_s)
+    for r in report["collectives"]:
+        if r["exposed_s"] <= 0:
+            continue
+        dur = _us(r["exposed_s"])
+        events.append(complete_slice(
+            pid, comm_tid, ts, dur, f"{r['op']} ({r['level']})",
+            "exposed-comm",
+            {"instruction": r["instruction"], "bytes": r["bytes"],
+             "zero_overlap": r["zero_overlap"],
+             "overlap_us": _us(r["overlap_s"])},
+            cname="terrible" if r["zero_overlap"] else "bad"))
+        ts += dur
+    return events
+
+
 def to_anatomy_trace_events(reports):
     """Predicted-schedule Perfetto timeline: one process per program (sorted),
     thread 0 carrying the binding compute/HBM floor slice, thread 1 the
@@ -314,31 +354,8 @@ def to_anatomy_trace_events(reports):
     alert color."""
     events = []
     for pid, report in enumerate(sorted(reports, key=lambda r: r["name"])):
-        rf = report["roofline"]
         events.append(process_name_event(pid, report["name"]))
-        events += thread_meta_events(pid, 0, "roofline floor", sort_index=0)
-        events += thread_meta_events(pid, 1, "exposed comm", sort_index=1)
-        bound_s = max(rf["compute_floor_s"], rf["hbm_floor_s"])
-        binding = ("compute floor"
-                   if rf["compute_floor_s"] >= rf["hbm_floor_s"]
-                   else "hbm floor")
-        events.append(complete_slice(
-            pid, 0, 0, _us(bound_s), binding, "roofline",
-            {"compute_floor_us": _us(rf["compute_floor_s"]),
-             "hbm_floor_us": _us(rf["hbm_floor_s"]),
-             "mfu_ceiling": round(rf["mfu_ceiling"], 4)}))
-        ts = _us(bound_s)
-        for r in report["collectives"]:
-            if r["exposed_s"] <= 0:
-                continue
-            dur = _us(r["exposed_s"])
-            events.append(complete_slice(
-                pid, 1, ts, dur, f"{r['op']} ({r['level']})", "exposed-comm",
-                {"instruction": r["instruction"], "bytes": r["bytes"],
-                 "zero_overlap": r["zero_overlap"],
-                 "overlap_us": _us(r["overlap_s"])},
-                cname="terrible" if r["zero_overlap"] else "bad"))
-            ts += dur
+        events += program_schedule_events(report, pid)
     return trace_envelope(events, "ds-tpu anatomy",
                           programs=len(reports),
                           trace_version=ANATOMY_REPORT_VERSION)

@@ -375,6 +375,43 @@ def collective_lines(hlo_text):
     return out
 
 
+# ------------------------------------------------------- metadata / identity
+# The profiler's device timeline names slices by (hlo_module, hlo_op); mapping
+# them back to the engine's named scopes needs two more module facts: the
+# HloModule header name (the trace's ``hlo_module`` key) and each entry
+# instruction's ``metadata={op_name="jit(f)/.../ds_grad_bucket0/mul"}`` — the
+# jaxpr scope path ``jax.named_scope`` threads through compilation. CPU traces
+# carry bare instruction names, so the metadata map is the only scope source
+# there; TPU traces prefix scopes in the op name itself and use this map as a
+# cross-check.
+_MODULE_NAME_RE = re.compile(r"^HloModule\s+([\w.-]+)")
+_METADATA_OP_NAME_RE = re.compile(r'metadata=\{[^{}]*op_name="([^"]*)"')
+
+
+def module_name(hlo_text):
+    """The ``HloModule`` header name (e.g. ``jit_loss_and_grad``) — the same
+    string the profiler's trace events carry as ``args.hlo_module``. Empty
+    when the text has no module header."""
+    m = _MODULE_NAME_RE.match(hlo_text)
+    return m.group(1) if m else ""
+
+
+def instruction_op_names(hlo_text):
+    """{instruction name: metadata op_name} over every definition line that
+    carries ``op_name`` metadata, across all computations. The op_name is the
+    traced scope path (``jit(fn)/jit(main)/<named scopes>/<primitive>``);
+    callers regex their scope tokens out of it."""
+    out = {}
+    for line in hlo_text.splitlines():
+        d = _DEF_NAME_RE.match(line)
+        if not d:
+            continue
+        m = _METADATA_OP_NAME_RE.search(line)
+        if m:
+            out[d.group(1)] = m.group(1)
+    return out
+
+
 # per-instruction cost estimates for the overlap-window pricing: a window's
 # compute capacity is what the scheduler placed between -start and -done,
 # priced as max(dot flops / peak, result bytes / HBM bandwidth)

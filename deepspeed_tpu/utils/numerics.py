@@ -317,6 +317,19 @@ class FlightRecorder:
                 out["hbm"] = oom_forensics(snap)
             except Exception:
                 out["hbm"] = {"error": "oom_forensics failed", "snapshot": snap}
+        if self.telemetry is not None:
+            # measured-time observatory: the last closed trace window's
+            # summary rides along so a post-mortem sees what the device
+            # timeline actually did (guarded like hbm — forensics must never
+            # block the dump)
+            prof_snapper = getattr(self.telemetry, "profile_snapshot", None)
+            if prof_snapper is not None:
+                try:
+                    prof = prof_snapper()
+                except Exception:
+                    prof = None
+                if prof is not None:
+                    out["profile"] = prof
         return out
 
     def _span(self):

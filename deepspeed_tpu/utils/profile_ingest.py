@@ -1,0 +1,943 @@
+"""Measured-time profile observatory: ingest profiler traces, reconcile them
+against anatomy's predicted schedule (docs/profile.md).
+
+The anatomy observatory (utils/anatomy.py) *predicts* step-time structure —
+overlap windows, exposed ICI/DCN, roofline MFU ceilings — and telemetry's
+trace windows (utils/telemetry.py) capture the *measured* device timeline that
+nothing previously read back. This module closes the loop: a stdlib-pure
+parser for the trace-viewer JSON ``jax.profiler`` writes
+(``plugins/profile/*/…trace.json.gz``) that classifies device-timeline slices
+into compute / collective (ICI vs DCN) / host-gap per named scope
+(``ds_grad_bucket{k}``, ``ds_fwd_bwd``, ``ds_apply_update``, ``ring_rot{r}``,
+``ds_offload_*`` — the scopes the engines already thread), computes measured
+exposed ICI/DCN per bucket window, per-program measured MFU (trace durations
+x the compile watchdog's recorded flops), and the step-wall decomposition.
+
+``reconcile_profile`` then pins three views per class within a stated
+tolerance — **measured** (the trace), **predicted** (the compile watchdog's
+HLO catalog: anatomy bucket-window pricing, collective instruction counts,
+wire bytes), **derived** (TelemetrySession's step counters) — with verdicts
+ok / drift / unobserved exactly like ``ds-tpu hbm``. Seconds measured on the
+CPU test mesh can never numerically match the cpu-test ChipSpec's fictional
+pricing, so the gated pairs are machine-INDEPENDENT: collective slice
+executions per step per device vs HLO instruction counts, predicted vs
+derived flops and wire bytes. Wall-clock seconds are reported, never gated
+(except step-wall at a generous sanity tolerance) and never golden-pinned.
+
+Parsing is stdlib-only (``gzip``/``json``/``re``); the HLO-catalog and
+reconcile-runner helpers lazily import ``.hlo`` / the engine stack, so a
+post-mortem box can ingest and diff traces with no accelerator runtime.
+"""
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+PROFILE_REPORT_VERSION = 1
+PROFILE_REPORT_KIND = "profile_report"
+PROFILE_RECONCILE_KIND = "profile_reconcile"
+PROFILE_DIFF_KIND = "profile_diff"
+
+# measured-vs-predicted-vs-derived reconciliation slack for the gated
+# machine-independent pairs (counts, flops, wire bytes)
+PROFILE_REL_TOL = 0.05
+# step-wall sanity gate: measured trace extent vs telemetry's host step wall.
+# Generous on purpose — both are real seconds on the same host, but profiler
+# overhead and the window's first/last step edges land inside it.
+PROFILE_STEP_WALL_REL_TOL = 0.5
+
+# the named scopes the engines thread (engine.py, comm/hierarchical.py,
+# runtime/ring.py, runtime/offload.py, parallel/pipe engines) — kept textually
+# in sync with the emitting sites by tests/unit/test_profile_ingest.py
+SCOPE_RE = re.compile(
+    r"(ds_grad_bucket\d+|ds_fwd_bwd|ds_accumulate|ds_apply_update"
+    r"|ring_rot\d+|ds_offload_\w+|ds_pipe_\w+)")
+_BUCKET_SCOPE_RE = re.compile(r"ds_grad_bucket(\d+)")
+
+# collective HLO op-name prefixes, mirroring hlo.COLLECTIVE_OPS (kept local so
+# trace ingestion stays stdlib-pure). Order matters: longest prefixes first so
+# ``all-reduce-start.3`` doesn't half-match.
+COLLECTIVE_PREFIXES = ("all-to-all", "all-gather", "all-reduce",
+                      "reduce-scatter", "collective-permute")
+
+# namespaced trace dirs (mirrors the flight-recorder dump naming,
+# utils/numerics.py): trace_<run>_host<h>/ under the configured trace_dir.
+# run ids are _sanitize_token'd (no underscores), so the split is unambiguous.
+_TRACE_DIR_RE = re.compile(r"^trace_(?P<run>[^_]+)_host(?P<host>\d+)$")
+
+
+class ProfileParseError(ValueError):
+    """A trace file or directory that cannot be ingested — malformed JSON,
+    truncated gzip, or a JSON object that is not a trace-viewer bundle. The
+    parser refuses loudly instead of returning a silently-empty report."""
+
+
+# ----------------------------------------------------------------- discovery
+def scan_trace_dirs(trace_dir):
+    """Enumerate the per-run trace directories under a configured
+    ``telemetry.trace_dir``: ``[{"run", "host", "path"}]`` sorted by
+    (run, host). Namespaced layout is ``trace_<run>_host<h>/``; a legacy
+    un-namespaced layout (``trace_dir/plugins/profile`` directly — traces
+    written before the namespacing, or sessions configured with
+    ``run_id=""``) reports as ``{"run": "", "host": 0}``."""
+    out = []
+    if not os.path.isdir(trace_dir):
+        return out
+    if os.path.isdir(os.path.join(trace_dir, "plugins", "profile")):
+        out.append({"run": "", "host": 0, "path": trace_dir})
+    for name in sorted(os.listdir(trace_dir)):
+        m = _TRACE_DIR_RE.match(name)
+        path = os.path.join(trace_dir, name)
+        if m and os.path.isdir(path):
+            out.append({"run": m.group("run"), "host": int(m.group("host")),
+                        "path": path})
+    out.sort(key=lambda d: (d["run"], d["host"]))
+    return out
+
+
+def find_trace_files(path):
+    """Trace-viewer JSON files under one trace directory (the
+    ``plugins/profile/<timestamp>/*.trace.json.gz`` layout ``jax.profiler``
+    writes), newest session last. Accepts a direct file path too."""
+    if os.path.isfile(path):
+        return [path]
+    pats = [os.path.join(path, "plugins", "profile", "*", "*.trace.json.gz"),
+            os.path.join(path, "plugins", "profile", "*", "*.trace.json")]
+    files = []
+    for pat in pats:
+        files.extend(glob.glob(pat))
+    return sorted(files)
+
+
+def load_trace(path):
+    """Parse one trace-viewer JSON (plain or gzipped). Returns the decoded
+    dict; raises :class:`ProfileParseError` on truncated/undecodable input or
+    when the payload is not a ``traceEvents`` bundle."""
+    try:
+        if path.endswith(".gz"):
+            with gzip.open(path, "rt", encoding="utf-8", errors="replace") as f:
+                data = json.load(f)
+        else:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                data = json.load(f)
+    except (OSError, EOFError, ValueError) as e:
+        raise ProfileParseError(f"unreadable trace {path!r}: {e}") from e
+    if not isinstance(data, dict) or not isinstance(
+            data.get("traceEvents"), list):
+        raise ProfileParseError(
+            f"{path!r} is not a trace-viewer bundle (no traceEvents list)")
+    return data
+
+
+def load_trace_dir(path):
+    """Load every trace file of one trace dir (one file per profiled host
+    process) and return ``(merged event list, [file paths])``. Raises
+    :class:`ProfileParseError` when the directory holds no trace files."""
+    files = find_trace_files(path)
+    if not files:
+        raise ProfileParseError(
+            f"no trace files under {path!r} (expected "
+            "plugins/profile/<session>/*.trace.json.gz)")
+    events = []
+    for f in files:
+        events.extend(load_trace(f)["traceEvents"])
+    return events, files
+
+
+# ------------------------------------------------------------ classification
+def device_slices(events):
+    """The device-timeline slices of a trace: every complete ("X") event
+    carrying an ``hlo_op`` arg — one slice per HLO instruction execution per
+    device. Host-side python/runtime spans (no ``hlo_op``) are dropped here
+    and accounted only through the host-gap class. Returns
+    ``[{"module", "op", "ts", "dur"}]`` in timestamp order."""
+    out = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args")
+        if not isinstance(args, dict) or "hlo_op" not in args:
+            continue
+        try:
+            ts = float(e["ts"])
+            dur = float(e.get("dur", 0.0))
+        except (KeyError, TypeError, ValueError):
+            continue
+        out.append({"module": str(args.get("hlo_module", "")),
+                    "op": str(args["hlo_op"]), "ts": ts, "dur": dur})
+    out.sort(key=lambda s: (s["ts"], s["op"]))
+    return out
+
+
+def is_collective_op(op_name):
+    """True when an ``hlo_op`` slice name is a collective instruction
+    (``all-reduce.8``, ``reduce-scatter-start.2``, ...)."""
+    return op_name.startswith(COLLECTIVE_PREFIXES)
+
+
+def slice_scope(s, catalog=None):
+    """Named scope of one device slice, or None. The per-program HLO catalog
+    (``op_name`` metadata parsed at compile time) is authoritative — CPU
+    traces carry bare instruction names. TPU traces prefix the scope path in
+    the op name itself; the regex fallback covers those with no catalog."""
+    if catalog:
+        prog = catalog.get(s["module"])
+        if prog:
+            scope = prog.get("scopes", {}).get(s["op"])
+            if scope:
+                return scope
+    m = SCOPE_RE.search(s["op"])
+    return m.group(1) if m else None
+
+
+def slice_level(s, catalog=None):
+    """"ici" or "dcn" for a collective slice: the catalog's per-instruction
+    replica-group classification when available (the same membership rule as
+    ``hlo.collective_axis_bytes``), else ICI — the single-slice default the
+    wire-byte ledger uses."""
+    if catalog:
+        prog = catalog.get(s["module"])
+        if prog:
+            row = prog.get("collectives", {}).get(s["op"])
+            if row:
+                return row["level"]
+    return "ici"
+
+
+# ------------------------------------------------------------- interval math
+def _union(intervals):
+    """Merge (start, end) intervals; returns the disjoint sorted union."""
+    ivs = sorted((a, b) for a, b in intervals if b > a)
+    out = []
+    for a, b in ivs:
+        if out and a <= out[-1][1]:
+            if b > out[-1][1]:
+                out[-1] = (out[-1][0], b)
+        else:
+            out.append((a, b))
+    return out
+
+
+def _union_len(merged):
+    return sum(b - a for a, b in merged)
+
+
+def _subtract_len(merged_a, merged_b):
+    """Total length of ``A \\ B`` for two disjoint sorted interval unions —
+    the measured-exposure primitive (collective wire time not covered by
+    compute)."""
+    total = 0.0
+    j = 0
+    for a, b in merged_a:
+        cur = a
+        while j < len(merged_b) and merged_b[j][1] <= cur:
+            j += 1
+        k = j
+        while cur < b:
+            if k >= len(merged_b) or merged_b[k][0] >= b:
+                total += b - cur
+                cur = b
+            else:
+                lo, hi = merged_b[k]
+                if lo > cur:
+                    total += lo - cur
+                cur = max(cur, min(hi, b))
+                k += 1
+    return total
+
+
+def _us(x):
+    """Deterministic microsecond rounding (same contract as anatomy._us —
+    report fields are pure functions of the input trace)."""
+    return round(x, 3)
+
+
+# --------------------------------------------------------------- HLO catalog
+def program_profile_info(hlo_text, slice_sets=None):
+    """Compact per-program catalog the measured-trace attribution joins
+    against, computed once at compile time by the watchdog (lazily imports
+    ``.hlo`` — trace ingestion itself never needs it)::
+
+        {"module": HloModule header name (the trace's hlo_module key),
+         "scopes": {instruction: named scope},          # op_name metadata
+         "collectives": {instruction: {"level", "bytes", "bucket"}}}
+    """
+    from . import hlo
+    sets = [frozenset(s) for s in slice_sets] if slice_sets else []
+
+    def level(groups):
+        if len(sets) <= 1:
+            return "ici"
+        if groups is None:
+            return "dcn"
+        return ("ici" if all(any(set(g) <= ss for ss in sets) for g in groups)
+                else "dcn")
+
+    scopes = {}
+    for iname, op_name in hlo.instruction_op_names(hlo_text).items():
+        m = SCOPE_RE.search(op_name)
+        if m:
+            scopes[iname] = m.group(1)
+    collectives = {}
+    for _line, iname, _op, _is_start, b, groups in hlo.collective_lines(
+            hlo_text):
+        scope = scopes.get(iname)
+        bm = _BUCKET_SCOPE_RE.search(scope or "")
+        collectives[iname] = {"level": level(groups), "bytes": int(b),
+                              "bucket": int(bm.group(1)) if bm else None}
+    return {"module": hlo.module_name(hlo_text), "scopes": scopes,
+            "collectives": collectives}
+
+
+def catalog_from_watchdog(watchdog):
+    """{module name: catalog info + flops/wire/anatomy facts} over a
+    CompileWatchdog's records — the predicted side of the reconciliation.
+    Programs compiled without ``profile_scopes`` (or that failed analysis)
+    are skipped."""
+    catalog = {}
+    for name, sigs in watchdog.records.items():
+        for rec in sigs.values():
+            info = getattr(rec, "profile_info", None)
+            if not info or not info.get("module"):
+                continue
+            anat = rec.anatomy or {}
+            exposed = anat.get("exposed_s", {})
+            catalog[info["module"]] = {
+                "program": name,
+                "scopes": info["scopes"],
+                "collectives": info["collectives"],
+                "flops": float(rec.flops),
+                "wire_ici": int(rec.wire_bytes_ici),
+                "wire_dcn": int(rec.wire_bytes_dcn),
+                "predicted_exposed_ici_us": _us(
+                    float(exposed.get("ici", 0.0)) * 1e6),
+                "predicted_exposed_dcn_us": _us(
+                    float(exposed.get("dcn", 0.0)) * 1e6),
+            }
+    return catalog
+
+
+# ------------------------------------------------------------- summarization
+def summarize_slices(slices, catalog=None, devices=1, steps=1,
+                     peak_tflops=None):
+    """The measured profile report over one window's device slices.
+
+    Interval math runs on the union timeline across all device threads (the
+    CPU mesh runs 8 virtual devices on one host; per-device separation is not
+    available in the trace, and the union is the quantity step wall actually
+    pays). Exposure mirrors the anatomy pricing rule: exposed DCN is DCN wire
+    time no compute covers; exposed ICI is ICI wire time covered by neither
+    compute nor in-flight DCN (the cross-level overlap the bucketed exchange
+    exists to create — docs/overlap.md)."""
+    devices = max(int(devices), 1)
+    steps = max(int(steps), 1)
+    compute_iv, ici_iv, dcn_iv, all_iv = [], [], [], []
+    bucket_iv = {}     # bucket -> {"ici": [...], "dcn": [...]}
+    scope_rows = {}    # scope -> {"busy_us", "collective_us", "slices"}
+    per_program = {}   # module -> {"slices", "intervals", "coll_counts"}
+    for s in slices:
+        iv = (s["ts"], s["ts"] + s["dur"])
+        all_iv.append(iv)
+        coll = is_collective_op(s["op"])
+        if not coll and catalog:
+            prog = catalog.get(s["module"])
+            if prog and s["op"] in prog.get("collectives", {}):
+                coll = True
+        scope = slice_scope(s, catalog) or "unattributed"
+        row = scope_rows.setdefault(scope, {"busy_us": 0.0,
+                                            "collective_us": 0.0, "slices": 0})
+        row["busy_us"] += s["dur"]
+        row["slices"] += 1
+        pp = per_program.setdefault(s["module"], {
+            "slices": 0, "intervals": [], "collective_counts": {}})
+        pp["slices"] += 1
+        pp["intervals"].append(iv)
+        if coll:
+            row["collective_us"] += s["dur"]
+            level = slice_level(s, catalog)
+            (ici_iv if level == "ici" else dcn_iv).append(iv)
+            pp["collective_counts"][s["op"]] = (
+                pp["collective_counts"].get(s["op"], 0) + 1)
+            m = _BUCKET_SCOPE_RE.search(scope)
+            if m:
+                bucket_iv.setdefault(int(m.group(1)),
+                                     {"ici": [], "dcn": []})[level].append(iv)
+        else:
+            compute_iv.append(iv)
+    comp_u, ici_u, dcn_u = _union(compute_iv), _union(ici_iv), _union(dcn_iv)
+    all_u = _union(all_iv)
+    extent = (all_u[-1][1] - all_u[0][0]) if all_u else 0.0
+    comp_or_dcn = _union(compute_iv + dcn_iv)
+    buckets = {}
+    for k, ivs in sorted(bucket_iv.items()):
+        b_ici, b_dcn = _union(ivs["ici"]), _union(ivs["dcn"])
+        buckets[str(k)] = {
+            "collective_ici_us": _us(_union_len(b_ici)),
+            "collective_dcn_us": _us(_union_len(b_dcn)),
+            "exposed_ici_us": _us(_subtract_len(b_ici, comp_or_dcn)),
+            "exposed_dcn_us": _us(_subtract_len(b_dcn, comp_u)),
+        }
+    programs = {}
+    collective_counts = {}
+    for module, pp in sorted(per_program.items()):
+        busy_us = _union_len(_union(pp["intervals"]))
+        row = {"slices": pp["slices"], "busy_us": _us(busy_us)}
+        info = (catalog or {}).get(module)
+        if pp["collective_counts"]:
+            collective_counts[module] = dict(sorted(
+                pp["collective_counts"].items()))
+        if info:
+            row["program"] = info["program"]
+            row["flops"] = info["flops"]
+            if peak_tflops and busy_us > 0:
+                # per-program measured MFU: the watchdog's per-device flops x
+                # the window's executions over the program's busy wall on the
+                # union timeline, against the stated peak. On the shared-host
+                # CPU mesh this is an attribution metric, not a hardware
+                # utilization claim — docs/profile.md spells the formula out.
+                row["measured_mfu"] = round(
+                    (info["flops"] * steps)
+                    / (busy_us * 1e-6 * peak_tflops * 1e12), 12)
+        programs[module] = row
+    measured_mfu = None
+    if peak_tflops and extent > 0 and catalog:
+        # same convention as TelemetrySession's rolling MFU: one program
+        # execution contributes its cost_analysis flops once, priced against
+        # the stated peak over the window's wall extent
+        window_flops = sum(catalog[m]["flops"] * steps
+                           for m in per_program if m in catalog)
+        if window_flops > 0:
+            measured_mfu = round(
+                window_flops / (extent * 1e-6 * peak_tflops * 1e12), 12)
+    return {
+        "version": PROFILE_REPORT_VERSION,
+        "kind": PROFILE_REPORT_KIND,
+        "devices": devices,
+        "steps": steps,
+        "classes": {
+            "compute": {"busy_us": _us(_union_len(comp_u))},
+            "collective_ici": {
+                "busy_us": _us(_union_len(ici_u)),
+                "exposed_us": _us(_subtract_len(ici_u, comp_or_dcn)),
+            },
+            "collective_dcn": {
+                "busy_us": _us(_union_len(dcn_u)),
+                "exposed_us": _us(_subtract_len(dcn_u, comp_u)),
+            },
+            "host_gap": {"gap_us": _us(extent - _union_len(all_u))},
+        },
+        "step_wall_us": _us(extent / steps),
+        "extent_us": _us(extent),
+        "measured_mfu": measured_mfu,
+        "total_slices": len(slices),
+        "scopes": {k: {"busy_us": _us(v["busy_us"]),
+                       "collective_us": _us(v["collective_us"]),
+                       "slices": v["slices"]}
+                   for k, v in sorted(scope_rows.items())},
+        "buckets": buckets,
+        "programs": programs,
+        "collective_counts": collective_counts,
+    }
+
+
+def measured_collective_counts(report, catalog):
+    """Per-level measured collective executions per step per device —
+    the machine-independent measured basis the reconciliation gates. Every
+    HLO collective instruction executes exactly once per device per step, so
+    the trace's slice count divided by (devices x steps) must equal the
+    catalog's instruction count."""
+    denom = report["devices"] * report["steps"]
+    counts = {"ici": 0.0, "dcn": 0.0}
+    for module, ops in report.get("collective_counts", {}).items():
+        prog = catalog.get(module, {})
+        for op, c in ops.items():
+            row = prog.get("collectives", {}).get(op)
+            level = row["level"] if row else "ici"
+            counts[level] += c / denom
+    return {k: round(v, 6) for k, v in counts.items()}
+
+
+# ------------------------------------------------------------ reconciliation
+def _within(a, b, rel_tol):
+    return abs(a - b) <= rel_tol * max(abs(a), abs(b), 1e-12)
+
+
+def reconcile_profile(measured, catalog, derived, rel_tol=PROFILE_REL_TOL,
+                      entry=""):
+    """Pin the three views against each other, per class, hbm-style.
+
+    ``measured`` is a :func:`summarize_slices` report; ``catalog`` the
+    watchdog catalog (:func:`catalog_from_watchdog`); ``derived`` the
+    telemetry session's per-step counter view::
+
+        {"flops_per_step", "wire_ici_per_step", "wire_dcn_per_step",
+         "step_wall_ms" (optional)}
+
+    Gated, machine-independent pairs per class:
+
+    * ``compute`` — predicted flops/step (catalog, one execution per program
+      per step) vs derived flops/step (the proxies' counters); measured
+      compute busy time must be observed (>0) for the class to gate at all.
+    * ``collective_ici`` / ``collective_dcn`` — measured slice executions per
+      step per device vs the catalog's HLO instruction count, AND predicted
+      vs derived wire bytes/step.
+    * ``step_wall`` — measured trace extent per step vs telemetry's host step
+      wall, at :data:`PROFILE_STEP_WALL_REL_TOL` (real seconds both, so gated
+      only as a sanity check and excluded from the golden projection).
+
+    Verdicts: ``ok`` / ``drift`` / ``unobserved`` (the measured side saw
+    nothing a prediction exists for — e.g. a trace window that closed before
+    the program ran)."""
+    classes = {}
+    ok = True
+    pred_flops = sum(p["flops"] for p in catalog.values())
+    pred_counts = {"ici": 0, "dcn": 0}
+    pred_wire = {"ici": 0, "dcn": 0}
+    pred_exposed = {"ici": 0.0, "dcn": 0.0}
+    for p in catalog.values():
+        for row in p["collectives"].values():
+            pred_counts[row["level"]] += 1
+        pred_wire["ici"] += p["wire_ici"]
+        pred_wire["dcn"] += p["wire_dcn"]
+        pred_exposed["ici"] += p["predicted_exposed_ici_us"]
+        pred_exposed["dcn"] += p["predicted_exposed_dcn_us"]
+    meas_counts = measured_collective_counts(measured, catalog)
+
+    row = {
+        "measured_busy_us": measured["classes"]["compute"]["busy_us"],
+        "predicted_flops_per_step": round(pred_flops, 3),
+        "derived_flops_per_step": round(float(derived["flops_per_step"]), 3),
+    }
+    if row["measured_busy_us"] <= 0 and pred_flops > 0:
+        row["status"] = "unobserved"
+    elif _within(pred_flops, derived["flops_per_step"], rel_tol):
+        row["status"] = "ok"
+    else:
+        row["status"] = "drift"
+        ok = False
+    classes["compute"] = row
+
+    for level in ("ici", "dcn"):
+        mc = meas_counts[level]
+        pc = pred_counts[level]
+        dw = int(derived[f"wire_{level}_per_step"])
+        pw = pred_wire[level]
+        row = {
+            "measured_count_per_step_per_device": mc,
+            "predicted_instruction_count": pc,
+            "predicted_wire_bytes_per_step": pw,
+            "derived_wire_bytes_per_step": dw,
+            "measured_busy_us":
+                measured["classes"][f"collective_{level}"]["busy_us"],
+            "measured_exposed_us":
+                measured["classes"][f"collective_{level}"]["exposed_us"],
+            "predicted_exposed_us": _us(pred_exposed[level]),
+        }
+        if mc == 0 and pc > 0:
+            row["status"] = "unobserved"
+        elif _within(mc, pc, rel_tol) and _within(pw, dw, rel_tol):
+            row["status"] = "ok"
+        else:
+            row["status"] = "drift"
+            ok = False
+        classes[f"collective_{level}"] = row
+
+    row = {"measured_step_wall_ms": round(measured["step_wall_us"] / 1e3, 6)}
+    derived_wall = derived.get("step_wall_ms")
+    if derived_wall:
+        row["derived_step_wall_ms"] = round(float(derived_wall), 6)
+        if _within(measured["step_wall_us"] / 1e3, derived_wall,
+                   PROFILE_STEP_WALL_REL_TOL):
+            row["status"] = "ok"
+        else:
+            row["status"] = "drift"
+            ok = False
+    else:
+        row["status"] = "unobserved"
+    classes["step_wall"] = row
+
+    return {
+        "version": PROFILE_REPORT_VERSION,
+        "kind": PROFILE_RECONCILE_KIND,
+        "entry": entry,
+        "tolerance": {"rel": rel_tol,
+                      "step_wall_rel": PROFILE_STEP_WALL_REL_TOL},
+        "classes": classes,
+        "scopes_observed": sorted(s for s in measured.get("scopes", {})
+                                  if s != "unattributed"),
+        "buckets_observed": sorted(measured.get("buckets", {}), key=int),
+        "measured": measured,
+        "ok": ok,
+    }
+
+
+def stable_projection(report):
+    """The golden-pinnable slice of a reconcile report: verdicts, collective
+    execution/instruction counts, wire bytes, flops, scope and bucket
+    coverage — all pure functions of the compiled programs and the pinned
+    8-device CPU mesh. Every wall-clock field (busy/exposed/step-wall
+    microseconds) is excluded: those move with the machine; the structural
+    facts must not."""
+    classes = {}
+    for cls, row in report["classes"].items():
+        if cls == "step_wall":
+            continue  # both sides are real seconds — never golden material
+        keep = {k: v for k, v in row.items()
+                if not k.endswith("_us") and not k.endswith("_ms")}
+        classes[cls] = keep
+    return {
+        "version": report["version"],
+        "kind": report["kind"] + "_golden",
+        "entry": report["entry"],
+        "tolerance": report["tolerance"],
+        "classes": classes,
+        "scopes_observed": report["scopes_observed"],
+        "buckets_observed": report["buckets_observed"],
+        "collective_counts": report["measured"]["collective_counts"],
+        "ok": report["ok"],
+    }
+
+
+def diff_reports(old, new, rel_tol=PROFILE_REL_TOL):
+    """Cross-run regression gate over two reconcile reports (full or golden
+    projection): any class verdict that left ``ok``, any measured collective
+    count or wire-byte growth beyond tolerance, any scope or bucket that
+    disappeared from coverage."""
+    regressions = []
+    o_cls = old.get("classes", {})
+    n_cls = new.get("classes", {})
+    for cls in sorted(o_cls):
+        o_row = o_cls[cls]
+        n_row = n_cls.get(cls)
+        if n_row is None:
+            regressions.append(f"{cls}: class disappeared")
+            continue
+        if o_row.get("status") == "ok" and n_row.get("status") != "ok":
+            regressions.append(
+                f"{cls}: verdict regressed ok -> {n_row.get('status')}")
+        for key in ("measured_count_per_step_per_device",
+                    "predicted_wire_bytes_per_step"):
+            ov, nv = o_row.get(key), n_row.get(key)
+            if ov is None or nv is None:
+                continue
+            if nv > ov + rel_tol * max(abs(ov), 1e-12):
+                regressions.append(f"{cls}/{key}: grew {ov} -> {nv}")
+    for field in ("scopes_observed", "buckets_observed"):
+        gone = sorted(set(old.get(field, [])) - set(new.get(field, [])))
+        if gone:
+            regressions.append(f"{field}: lost {gone}")
+    return {"version": PROFILE_REPORT_VERSION, "kind": PROFILE_DIFF_KIND,
+            "regressions": regressions, "ok": not regressions}
+
+
+# -------------------------------------------------------------- merged timeline
+def to_profile_trace_events(slices, catalog=None, predicted_reports=None):
+    """Merged measured-vs-predicted Perfetto timeline: pid 0 carries the
+    predicted schedule (one roofline-floor + exposed-comm thread pair per
+    program, the same tracks ``ds-tpu anatomy`` draws), pinned ABOVE pid 1's
+    measured device timeline (one thread per class) via process_sort_index.
+    Measured slices are re-based to the window start so the two timebases
+    align at 0."""
+    from .trace_event import (complete_slice, process_name_event,
+                              process_sort_index_event, thread_meta_events,
+                              trace_envelope)
+    events = [process_name_event(0, "predicted schedule"),
+              process_sort_index_event(0, 0),
+              process_name_event(1, "measured trace"),
+              process_sort_index_event(1, 1)]
+    if predicted_reports:
+        from .anatomy import program_schedule_events
+        for i, rep in enumerate(sorted(predicted_reports,
+                                       key=lambda r: r["name"])):
+            events += program_schedule_events(
+                rep, pid=0, floor_tid=2 * i, comm_tid=2 * i + 1,
+                sort_base=2 * i, label_prefix=rep["name"] + " ")
+    class_tid = {"compute": 0, "collective_ici": 1, "collective_dcn": 2}
+    for tid, name in ((0, "compute"), (1, "collective ici"),
+                      (2, "collective dcn")):
+        events += thread_meta_events(1, tid, name, sort_index=tid)
+    t0 = min((s["ts"] for s in slices), default=0.0)
+    for s in slices:
+        coll = is_collective_op(s["op"])
+        if not coll and catalog:
+            prog = catalog.get(s["module"])
+            coll = bool(prog and s["op"] in prog.get("collectives", {}))
+        cls = (f"collective_{slice_level(s, catalog)}" if coll else "compute")
+        args = {"module": s["module"]}
+        scope = slice_scope(s, catalog)
+        if scope:
+            args["scope"] = scope
+        events.append(complete_slice(
+            1, class_tid[cls], _us(s["ts"] - t0), _us(s["dur"]), s["op"],
+            cls.replace("_", "-"), args,
+            cname="bad" if cls == "collective_dcn"
+            else ("thread_state_iowait" if coll else None)))
+    return trace_envelope(events, "ds-tpu profile",
+                          measured_slices=len(slices),
+                          trace_version=PROFILE_REPORT_VERSION)
+
+
+# ---------------------------------------------------------- reconcile runner
+RECONCILE_ENTRY = "comm_overlap"
+RECONCILE_TRACE_STEPS = (3, 6)
+RECONCILE_TOTAL_STEPS = 7
+
+
+def run_reconcile(rel_tol=PROFILE_REL_TOL, trace_dir=None, keep_engine=False):
+    """Build the lint registry's ``comm_overlap`` engine shape with a
+    profile-enabled telemetry trace window, run it on the pinned 8-device CPU
+    mesh, ingest the window's trace and reconcile measured vs predicted vs
+    derived — the ``ds-tpu profile --reconcile`` lint gate. Heavy imports are
+    local: only this runner needs jax/the engine stack."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    import deepspeed_tpu
+    from ..lint.registry import LintModel, _sample_batch
+
+    own_dir = trace_dir is None
+    trace_dir = trace_dir or tempfile.mkdtemp(prefix="ds_profile_reconcile_")
+    model = LintModel()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+        config_params={
+            "train_batch_size": 8, "steps_per_print": 1000,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 2},
+            "comm": {"mode": "hierarchical", "dcn_slices": 2,
+                     "overlap": {"mode": "bucketed", "bucket_mb": 0.004}},
+            "telemetry": {
+                "enabled": True,
+                "trace_dir": trace_dir,
+                "trace_steps": list(RECONCILE_TRACE_STEPS),
+                "anatomy": {"enabled": True, "chip": "cpu-test"},
+                "profile": {"enabled": True, "reconcile_tolerance": rel_tol},
+            },
+        })
+    try:
+        session = engine.telemetry
+        x, y = _sample_batch()
+        a, b = RECONCILE_TRACE_STEPS
+
+        def counters():
+            return {"flops": session.flops_executed,
+                    "wire_ici": session.wire_ici_executed,
+                    "wire_dcn": session.wire_dcn_executed}
+        base = end = {}
+        walls = []
+        for step in range(RECONCILE_TOTAL_STEPS):
+            # `step` completed optimizer steps precede this iteration — the
+            # same count on_step_begin keys the trace window off, so the
+            # counter snapshots bracket exactly the traced steps [a, b)
+            if step == a:
+                base = counters()
+            if step == b:
+                end = counters()
+            loss = engine(x, y)
+            engine.backward(loss)
+            engine.step()
+            if a <= step < b:
+                walls.append(session.last_step_ms)
+        if not end:
+            end = counters()
+        if session._trace_failed:
+            raise ProfileParseError(
+                "profiler trace window failed to start (see telemetry "
+                "warning); nothing to reconcile")
+        steps = b - a
+        derived = {
+            "flops_per_step": (end["flops"] - base["flops"]) / steps,
+            "wire_ici_per_step": (end["wire_ici"] - base["wire_ici"]) // steps,
+            "wire_dcn_per_step": (end["wire_dcn"] - base["wire_dcn"]) // steps,
+            "step_wall_ms": sum(walls) / len(walls) if walls else None,
+        }
+        catalog = catalog_from_watchdog(session.watchdog)
+        events, _files = load_trace_dir(session.trace_output_dir)
+        slices = device_slices(events)
+        peak = session.peak_tflops
+        measured = summarize_slices(slices, catalog=catalog,
+                                    devices=jax.device_count(), steps=steps,
+                                    peak_tflops=peak)
+        report = reconcile_profile(measured, catalog, derived,
+                                   rel_tol=rel_tol, entry=RECONCILE_ENTRY)
+        anatomy_reports = [rec.anatomy
+                           for sigs in session.watchdog.records.values()
+                           for rec in sigs.values() if rec.anatomy]
+        return report, slices, catalog, anatomy_reports
+    finally:
+        if not keep_engine:
+            try:
+                engine.telemetry.close()
+            except Exception:
+                pass
+        if own_dir:
+            shutil.rmtree(trace_dir, ignore_errors=True)
+
+
+# ------------------------------------------------------------------- CLI
+def _load_json(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _resolve_source(path):
+    """A positional source can be a trace file, a trace dir, or a telemetry
+    ``trace_dir`` holding namespaced per-run dirs — pick the newest run."""
+    if os.path.isfile(path):
+        return path
+    if find_trace_files(path):
+        return path
+    runs = scan_trace_dirs(path)
+    candidates = [r["path"] for r in runs if find_trace_files(r["path"])]
+    if candidates:
+        return candidates[-1]
+    raise ProfileParseError(
+        f"no trace files under {path!r} — expected a trace-viewer JSON, a "
+        "profiler dir (plugins/profile/...) or a telemetry trace_dir with "
+        "trace_<run>_host<h>/ subdirs")
+
+
+def profile_main(argv=None):
+    """``ds-tpu profile`` — the measured-time observatory CLI. Default mode
+    ingests a trace (dir or file) into the deterministic ``--json`` report;
+    ``--reconcile`` runs the traced CPU-mesh window and gates measured vs
+    predicted vs derived (exit 1 on drift — the lint.sh gate); ``--diff A B``
+    is the pure-host cross-run regression gate."""
+    parser = argparse.ArgumentParser(
+        prog="ds-tpu profile",
+        description="profiler-trace ingestion: classify device slices per "
+                    "scope, reconcile measured/predicted/derived step time")
+    parser.add_argument("source", nargs="?", metavar="TRACE",
+                        help="trace file, profiler dir, or telemetry "
+                             "trace_dir (newest namespaced run wins)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the full report as JSON on stdout")
+    parser.add_argument("--out", metavar="PATH",
+                        help="also write the JSON report to PATH")
+    parser.add_argument("--golden-out", metavar="PATH",
+                        help="write the stable (golden-pinnable) projection "
+                             "of a --reconcile report to PATH")
+    parser.add_argument("--timeline", metavar="PATH",
+                        help="write the merged measured-vs-predicted "
+                             "Perfetto trace")
+    parser.add_argument("--reconcile", action="store_true",
+                        help="run the traced CPU-mesh lint window and gate "
+                             "measured vs predicted vs derived (exit 1 on "
+                             "drift)")
+    parser.add_argument("--tolerance", type=float, default=PROFILE_REL_TOL,
+                        help="reconciliation relative tolerance "
+                             "(default: %(default)s)")
+    parser.add_argument("--devices", type=int, default=1,
+                        help="device count normalizing ingested slice counts "
+                             "(default: 1; --reconcile derives it)")
+    parser.add_argument("--steps", type=int, default=1,
+                        help="optimizer steps the ingested window spans "
+                             "(default: 1; --reconcile derives it)")
+    parser.add_argument("--peak-tflops", type=float, default=0.0,
+                        help="peak TFLOP/s pricing measured MFU (default: "
+                             "off)")
+    parser.add_argument("--diff", nargs=2, metavar=("OLD", "NEW"),
+                        help="compare two reconcile reports; exit 1 on any "
+                             "regression")
+    args = parser.parse_args(argv)
+
+    # stdout belongs to the report (same contract as ds-tpu lint/hbm)
+    import logging
+    for h in logging.getLogger("DeepSpeedTPU").handlers:
+        if isinstance(h, logging.StreamHandler) and h.stream is sys.stdout:
+            h.stream = sys.stderr
+
+    slices = catalog = None
+    anatomy_reports = []
+    if args.diff:
+        report = diff_reports(_load_json(args.diff[0]),
+                              _load_json(args.diff[1]),
+                              rel_tol=args.tolerance)
+    elif args.reconcile:
+        try:
+            report, slices, catalog, anatomy_reports = run_reconcile(
+                rel_tol=args.tolerance)
+        except ProfileParseError as e:
+            print(f"ERROR {e}", file=sys.stderr)
+            return 1
+    else:
+        if not args.source:
+            parser.error("a TRACE source is required unless --reconcile or "
+                         "--diff is given")
+        try:
+            source = _resolve_source(args.source)
+            events, files = load_trace_dir(source) \
+                if not os.path.isfile(source) \
+                else (load_trace(source)["traceEvents"], [source])
+            slices = device_slices(events)
+            report = summarize_slices(
+                slices, devices=args.devices, steps=args.steps,
+                peak_tflops=args.peak_tflops or None)
+            report["source"] = sorted(os.path.relpath(f, args.source)
+                                      if not os.path.isfile(args.source)
+                                      else f for f in files)
+        except ProfileParseError as e:
+            print(f"ERROR {e}", file=sys.stderr)
+            return 1
+
+    text = json.dumps(report, indent=2, sort_keys=True) + "\n"
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    if args.golden_out and report.get("kind") == PROFILE_RECONCILE_KIND:
+        with open(args.golden_out, "w") as f:
+            f.write(json.dumps(stable_projection(report), indent=2,
+                               sort_keys=True) + "\n")
+    if args.timeline and slices is not None:
+        from .trace_event import serialize_trace
+        with open(args.timeline, "w") as f:
+            f.write(serialize_trace(to_profile_trace_events(
+                slices, catalog=catalog,
+                predicted_reports=anatomy_reports)))
+    if args.json:
+        sys.stdout.write(text)
+    else:
+        _print_report(report)
+    return 0 if report.get("ok", True) else 1
+
+
+def _print_report(report):
+    kind = report.get("kind")
+    if kind == PROFILE_RECONCILE_KIND:
+        for cls, row in sorted(report["classes"].items()):
+            print(f"{cls}: [{row['status']}]")
+            for k, v in sorted(row.items()):
+                if k != "status":
+                    print(f"  {k:<36} {v}")
+        print(f"scopes: {', '.join(report['scopes_observed']) or '(none)'}; "
+              f"buckets: {', '.join(report['buckets_observed']) or '(none)'}")
+        print("reconciled" if report["ok"] else "DRIFT")
+    elif kind == PROFILE_DIFF_KIND:
+        for r in report["regressions"]:
+            print(f"REGRESSION {r}")
+        print(f"{len(report['regressions'])} regression(s)")
+    elif kind == PROFILE_REPORT_KIND:
+        for cls, row in sorted(report["classes"].items()):
+            facts = "  ".join(f"{k} {v}" for k, v in sorted(row.items())
+                              if v is not None)
+            print(f"{cls}: {facts}")
+        print(f"step wall {report['step_wall_us']}us over "
+              f"{report['steps']} step(s), {report['total_slices']} device "
+              f"slice(s)")
+        for scope, row in sorted(report["scopes"].items()):
+            print(f"  scope {scope:<18} busy {row['busy_us']:>12}us  "
+                  f"collective {row['collective_us']:>12}us")
+        for k, row in sorted(report["buckets"].items(), key=lambda kv:
+                             int(kv[0])):
+            print(f"  bucket {k}: exposed ici {row['exposed_ici_us']}us / "
+                  f"dcn {row['exposed_dcn_us']}us")
+
+
+if __name__ == "__main__":
+    sys.exit(profile_main())

@@ -58,20 +58,24 @@ def _abstract_signature(args) -> tuple:
 _mem_unavailable_warned = set()   # backends already named in a warning
 
 
-def _analyze_compiled(compiled, slice_sets=None, anatomy_spec=None):
+def _analyze_compiled(compiled, slice_sets=None, anatomy_spec=None,
+                      profile_scopes=False):
     """(flops, argument/output/temp bytes, collective wire bytes, wire bytes
-    split (ici, dcn), HBM bytes accessed, anatomy report, mem_unavailable) of
-    a compiled executable, each 0/None when the backend doesn't report it.
-    With no slice factorization every wire byte accounts as ICI. The anatomy
-    report (utils/anatomy.analyze_program) is computed only when
-    ``anatomy_spec`` names a chip spec — pure host-side text analysis of the
-    same artifact. ``mem_unavailable`` is True when ``memory_analysis()``
-    raised or returned nothing — recorded so its zeros are distinguishable
-    from a genuinely zero-byte program, with one warning per backend per
-    session instead of a silent pass."""
+    split (ici, dcn), HBM bytes accessed, anatomy report, profile_info,
+    mem_unavailable) of a compiled executable, each 0/None when the backend
+    doesn't report it. With no slice factorization every wire byte accounts
+    as ICI. The anatomy report (utils/anatomy.analyze_program) is computed
+    only when ``anatomy_spec`` names a chip spec — pure host-side text
+    analysis of the same artifact. ``profile_scopes`` additionally parses the
+    program's scope/collective identity catalog
+    (utils/profile_ingest.program_profile_info) so a measured trace window
+    can be joined back to this compile. ``mem_unavailable`` is True when
+    ``memory_analysis()`` raised or returned nothing — recorded so its zeros
+    are distinguishable from a genuinely zero-byte program, with one warning
+    per backend per session instead of a silent pass."""
     flops = hbm_b = 0.0
     arg_b = out_b = tmp_b = wire = wire_ici = wire_dcn = 0
-    anatomy = None
+    anatomy = profile_info = None
     mem_unavailable = False
     try:
         ca = compiled.cost_analysis()
@@ -114,10 +118,13 @@ def _analyze_compiled(compiled, slice_sets=None, anatomy_spec=None):
             from .anatomy import analyze_program
             anatomy = analyze_program(text, flops, hbm_b, anatomy_spec,
                                       slice_sets=slice_sets)
+        if profile_scopes:
+            from .profile_ingest import program_profile_info
+            profile_info = program_profile_info(text, slice_sets=slice_sets)
     except Exception:
         pass
     return (flops, arg_b, out_b, tmp_b, wire, wire_ici, wire_dcn, hbm_b,
-            anatomy, mem_unavailable)
+            anatomy, profile_info, mem_unavailable)
 
 
 class CompileRecord:
@@ -125,13 +132,13 @@ class CompileRecord:
 
     __slots__ = ("signature", "compile_seconds", "flops", "argument_bytes",
                  "output_bytes", "temp_bytes", "wire_bytes", "wire_bytes_ici",
-                 "wire_bytes_dcn", "hbm_bytes", "anatomy", "mem_unavailable",
-                 "count")
+                 "wire_bytes_dcn", "hbm_bytes", "anatomy", "profile_info",
+                 "mem_unavailable", "count")
 
     def __init__(self, signature, compile_seconds, flops=0.0, argument_bytes=0,
                  output_bytes=0, temp_bytes=0, wire_bytes=0, wire_bytes_ici=0,
                  wire_bytes_dcn=0, hbm_bytes=0.0, anatomy=None,
-                 mem_unavailable=False):
+                 profile_info=None, mem_unavailable=False):
         self.signature = signature
         self.compile_seconds = compile_seconds
         self.flops = flops
@@ -143,6 +150,7 @@ class CompileRecord:
         self.wire_bytes_dcn = wire_bytes_dcn
         self.hbm_bytes = hbm_bytes          # cost_analysis "bytes accessed"
         self.anatomy = anatomy              # utils/anatomy report or None
+        self.profile_info = profile_info    # utils/profile_ingest catalog row
         self.mem_unavailable = mem_unavailable  # memory_analysis absent: the
         # zero arg/out/temp bytes above mean "not reported", not "zero bytes"
         self.count = 1
@@ -164,6 +172,9 @@ class CompileWatchdog:
         # roofline ChipSpec: when set, every analyzed compile also gets the
         # step-anatomy report (utils/anatomy) — still pure host text analysis
         self.anatomy_spec = None
+        # profile observatory: when True, every analyzed compile also parses
+        # the scope/collective identity catalog the trace ingester joins on
+        self.profile_scopes = False
 
     def record(self, name: str, sig, seconds: float, compiled=None) -> CompileRecord:
         per = self.records.setdefault(name, {})
@@ -174,14 +185,17 @@ class CompileWatchdog:
         else:
             if compiled is not None:
                 (flops, arg_b, out_b, tmp_b, wire, wire_ici, wire_dcn,
-                 hbm_b, anatomy, mem_unavail) = _analyze_compiled(
-                     compiled, self.slice_sets, self.anatomy_spec)
+                 hbm_b, anatomy, profile_info, mem_unavail) = \
+                    _analyze_compiled(compiled, self.slice_sets,
+                                      self.anatomy_spec, self.profile_scopes)
             else:
                 flops = arg_b = out_b = tmp_b = wire = wire_ici = wire_dcn = 0
-                hbm_b, anatomy, mem_unavail = 0.0, None, False
+                hbm_b, anatomy, profile_info, mem_unavail = 0.0, None, None, \
+                    False
             rec = per[sig] = CompileRecord(sig, seconds, flops, arg_b, out_b,
                                            tmp_b, wire, wire_ici, wire_dcn,
-                                           hbm_b, anatomy, mem_unavail)
+                                           hbm_b, anatomy, profile_info,
+                                           mem_unavail)
         n = sum(r.count for r in per.values())
         if len(per) >= self.recompile_warn and name not in self._storm_warned:
             self._storm_warned.add(name)
@@ -304,7 +318,8 @@ class TelemetrySession:
                  trace_dir: Optional[str] = None, trace_steps=None,
                  mfu_window: int = 20, recompile_warn: int = 3,
                  output_path: Optional[str] = None, job_name: Optional[str] = None,
-                 anatomy_spec=None):
+                 anatomy_spec=None, run_id: Optional[str] = None,
+                 host_id: Optional[int] = None):
         self.watchdog = CompileWatchdog(recompile_warn=recompile_warn)
         # step-anatomy: a roofline ChipSpec (utils/roofline.resolve_spec)
         # switches on the per-compile overlap/roofline analysis and the
@@ -314,7 +329,31 @@ class TelemetrySession:
         self.last_anatomy = None
         self.peak_tflops = float(peak_tflops) if peak_tflops else None
         self.trace_dir = trace_dir or "deepspeed_telemetry_trace"
+        # namespaced trace output (mirrors the flight-recorder dump naming):
+        # trace_<run>_host<h>/ under trace_dir, so two engines sharing one
+        # trace_dir never interleave profiler sessions. run_id="" opts back
+        # into the legacy layout (the trace lands in trace_dir itself);
+        # run_id=None derives the same default id the flight recorder uses.
+        if run_id is None:
+            from .numerics import default_run_id
+            run_id = default_run_id()
+        self.run_id = run_id
+        if host_id is None:
+            try:
+                host_id = jax.process_index()
+            except Exception:
+                host_id = 0
+        self.host_id = int(host_id)
+        self.trace_output_dir = (
+            os.path.join(self.trace_dir,
+                         f"trace_{self.run_id}_host{self.host_id}")
+            if self.run_id else self.trace_dir)
         self.trace_steps = tuple(trace_steps) if trace_steps is not None else None
+        # profile observatory (docs/profile.md): off until configure_profile
+        self.profile_enabled = False
+        self.profile_rel_tol = None
+        self.profile_emit_scalars = True
+        self.last_profile = None
         self._owns_monitor = monitor is None
         if monitor is None:
             from .monitor import SummaryMonitor
@@ -413,6 +452,34 @@ class TelemetrySession:
             "forecast_config": self._forecast_config,
         }
 
+    def configure_profile(self, enabled: bool, reconcile_tolerance=None,
+                          emit_scalars: bool = True):
+        """Switch the measured-time profile observatory on for this session:
+        every subsequently compiled program also records its scope/collective
+        identity catalog (utils/profile_ingest.program_profile_info — pure
+        host text analysis, the compiled step is untouched), and when a trace
+        window closes end_step ingests the written trace into ``Profile/*``
+        scalars and ``last_profile``. Call before the step programs compile,
+        like set_comm_topology."""
+        self.profile_enabled = bool(enabled)
+        self.profile_rel_tol = reconcile_tolerance
+        self.profile_emit_scalars = bool(emit_scalars)
+        if self.profile_enabled:
+            self.watchdog.profile_scopes = True
+
+    def profile_snapshot(self) -> Optional[Dict[str, Any]]:
+        """Flight-recorder embedding: the last closed trace window's measured
+        profile report (utils/profile_ingest.summarize_slices) plus the
+        window disposition. None when no window was ever ingested AND the
+        trace never failed — i.e. when there is nothing worth embedding."""
+        if self.last_profile is None and not self._trace_failed:
+            return None
+        return {
+            "trace_dir": self.trace_output_dir,
+            "trace_failed": self._trace_failed,
+            "report": self.last_profile,
+        }
+
     def set_comm_topology(self, slice_sets):
         """Install the slice factorization (list of per-slice device-id sets,
         CommTopology.slice_device_sets) that splits every subsequently compiled
@@ -437,8 +504,8 @@ class TelemetrySession:
     def _start_trace(self):
         a, b = self.trace_steps
         try:
-            os.makedirs(self.trace_dir, exist_ok=True)
-            jax.profiler.start_trace(self.trace_dir)
+            os.makedirs(self.trace_output_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_output_dir)
         except Exception as e:
             self._trace_failed = True
             logger.warning(f"[deepspeed_tpu] telemetry: profiler trace unavailable "
@@ -446,17 +513,41 @@ class TelemetrySession:
             return
         self._trace_active = True
         logger.info(f"[deepspeed_tpu] telemetry: profiler trace started for steps "
-                    f"{a}..{b - 1} -> {self.trace_dir}")
+                    f"{a}..{b - 1} -> {self.trace_output_dir}")
 
     def _stop_trace(self):
         try:
             jax.profiler.stop_trace()
             logger.info(f"[deepspeed_tpu] telemetry: profiler trace written to "
-                        f"{self.trace_dir}")
+                        f"{self.trace_output_dir}")
         except Exception as e:
+            self._trace_failed = True
             logger.warning(f"[deepspeed_tpu] telemetry: stop_trace failed ({e!r})")
         self._trace_active = False
         self._trace_done = True
+
+    def _ingest_profile(self):
+        """Read the just-closed trace window back into the measured profile
+        report (utils/profile_ingest) — pure host file parsing after
+        stop_trace flushed, no device work. Failures warn once and leave
+        ``last_profile`` None; the training loop is never at risk from a
+        malformed trace."""
+        from .profile_ingest import (ProfileParseError, catalog_from_watchdog,
+                                     device_slices, load_trace_dir,
+                                     summarize_slices)
+        a, b = self.trace_steps
+        try:
+            events, _files = load_trace_dir(self.trace_output_dir)
+            self.last_profile = summarize_slices(
+                device_slices(events),
+                catalog=catalog_from_watchdog(self.watchdog),
+                devices=jax.device_count(), steps=max(b - a, 1),
+                peak_tflops=self.peak_tflops)
+        except (ProfileParseError, OSError) as e:
+            logger.warning(f"[deepspeed_tpu] telemetry: profile ingest of "
+                           f"{self.trace_output_dir} failed ({e}); Profile/* "
+                           "scalars skipped")
+        return self.last_profile
 
     # ------------------------------------------------------------- step metrics
     def mark_step_dispatched(self):
@@ -632,6 +723,39 @@ class TelemetrySession:
         if self._trace_active and self.trace_steps is not None \
                 and global_step >= self.trace_steps[1]:
             self._stop_trace()
+            # measured-time observatory: the window just flushed to disk —
+            # read it back (host-side file parsing only; the step programs
+            # are untouched and HLO-instruction-identical, pinned in tests)
+            if self.profile_enabled and not self._trace_failed \
+                    and self._ingest_profile() is not None \
+                    and self.profile_emit_scalars:
+                prof = self.last_profile
+                steps = max(prof["steps"], 1)
+                cls = prof["classes"]
+                mon.add_scalar("Profile/compute_ms",
+                               cls["compute"]["busy_us"] / steps / 1e3,
+                               samples)
+                mon.add_scalar("Profile/collective_ici_ms",
+                               cls["collective_ici"]["busy_us"] / steps / 1e3,
+                               samples)
+                mon.add_scalar("Profile/collective_dcn_ms",
+                               cls["collective_dcn"]["busy_us"] / steps / 1e3,
+                               samples)
+                mon.add_scalar("Profile/exposed_ici_ms",
+                               cls["collective_ici"]["exposed_us"] / steps
+                               / 1e3, samples)
+                mon.add_scalar("Profile/exposed_dcn_ms",
+                               cls["collective_dcn"]["exposed_us"] / steps
+                               / 1e3, samples)
+                mon.add_scalar("Profile/host_gap_ms",
+                               cls["host_gap"]["gap_us"] / steps / 1e3,
+                               samples)
+                mon.add_scalar("Profile/step_wall_ms",
+                               prof["step_wall_us"] / 1e3, samples)
+                if prof.get("measured_mfu") is not None:
+                    mon.add_scalar("Profile/mfu", prof["measured_mfu"],
+                                   samples)
+                mon.flush()
         return numerics_host
 
     # ------------------------------------------------------------- breakdown gate
@@ -671,11 +795,47 @@ class TelemetrySession:
                 "host_gap_ms": round(rf["host_gap_s"] * 1e3, 6),
                 "mfu_ceiling": round(rf["mfu_ceiling"], 4),
             }
+        profile = None
+        if self.last_profile is not None:
+            prof = self.last_profile
+            steps = max(prof["steps"], 1)
+            cls = prof["classes"]
+            profile = {
+                "compute_ms": round(cls["compute"]["busy_us"] / steps / 1e3, 6),
+                "collective_ici_ms": round(
+                    cls["collective_ici"]["busy_us"] / steps / 1e3, 6),
+                "collective_dcn_ms": round(
+                    cls["collective_dcn"]["busy_us"] / steps / 1e3, 6),
+                "exposed_ici_ms": round(
+                    cls["collective_ici"]["exposed_us"] / steps / 1e3, 6),
+                "exposed_dcn_ms": round(
+                    cls["collective_dcn"]["exposed_us"] / steps / 1e3, 6),
+                "host_gap_ms": round(
+                    cls["host_gap"]["gap_us"] / steps / 1e3, 6),
+                "step_wall_ms": round(prof["step_wall_us"] / 1e3, 6),
+                "measured_mfu": prof.get("measured_mfu"),
+                "scopes": sorted(prof.get("scopes", {})),
+                "steps": prof["steps"],
+            }
+        # trace-window disposition, with the _trace_failed latch surfaced so
+        # a "profiler unavailable" run is visible in every bench/report
+        # digest instead of only in one early warning line
+        trace = None
+        if self.trace_steps is not None:
+            trace = {
+                "trace_dir": self.trace_output_dir,
+                "steps": list(self.trace_steps),
+                "active": self._trace_active,
+                "done": self._trace_done,
+                "failed": self._trace_failed,
+            }
         return {
             "mfu": self.last_mfu,
             "step_time_ms": self.last_step_ms,
             "steps_recorded": self.steps_recorded,
             "anatomy": anatomy,
+            "trace": trace,
+            "profile": profile,
             "wire_bytes_per_step": self.last_wire_bytes,
             "wire_bytes_per_step_ici": self.last_wire_bytes_ici,
             "wire_bytes_per_step_dcn": self.last_wire_bytes_dcn,

@@ -18,8 +18,9 @@ byte-identical across the refactor.
 import json
 
 __all__ = ["serialize_trace", "trace_envelope", "load_bundle",
-           "process_name_event", "thread_meta_events",
-           "complete_slice", "counter_event", "instant_event"]
+           "process_name_event", "process_sort_index_event",
+           "thread_meta_events", "complete_slice", "counter_event",
+           "instant_event"]
 
 
 def serialize_trace(trace):
@@ -54,6 +55,14 @@ def load_bundle(path, kind):
 def process_name_event(pid, name, tid=0):
     return {"ph": "M", "pid": pid, "tid": tid, "name": "process_name",
             "args": {"name": name}}
+
+
+def process_sort_index_event(pid, sort_index, tid=0):
+    """Pin a process track's vertical position in the Perfetto UI — the merged
+    measured-vs-predicted profile timeline uses it to keep the predicted
+    schedule above the measured one regardless of pid numbering."""
+    return {"ph": "M", "pid": pid, "tid": tid, "name": "process_sort_index",
+            "args": {"sort_index": sort_index}}
 
 
 def thread_meta_events(pid, tid, name, sort_index=None):

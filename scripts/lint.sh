@@ -134,6 +134,18 @@ timeout -k 10 120 "$REPO/bin/ds-tpu" hang-sim --json /tmp/_hang_sim.json \
 && cmp "$REPO/tests/unit/golden/cluster_timeline_2host.trace.json" \
        /tmp/_cluster_timeline.trace.json
 hang_rc=$?
+# profile: measured-time observatory gate — run a traced CPU-mesh window
+# through the comm_overlap lint entry and reconcile measured (trace) vs
+# predicted (compile-time catalog) vs derived (step counters) per class
+# (`ds-tpu profile --reconcile` exits 1 on any drift verdict). The stable
+# projection (verdicts, collective execution counts, wire bytes, flops,
+# scope/bucket coverage — no wall-clock fields) is byte-compared against the
+# committed golden so any attribution or schedule drift fails CI.
+timeout -k 10 300 "$REPO/bin/ds-tpu" profile --reconcile --json \
+    --out /tmp/_profile.json --golden-out /tmp/_profile_golden.json \
+&& cmp "$REPO/tests/unit/golden/profile_reconcile.json" \
+       /tmp/_profile_golden.json
+profile_rc=$?
 # fleet gate: seeded 3-replica shared-prefix fleet with two mid-flight kills —
 # affinity routing must emit byte-identical tokens to round-robin while doing
 # STRICTLY fewer prefill chunks and a strictly better fleet p50 TTFT, warm
@@ -165,4 +177,5 @@ fleet_rc=$?
 [ "$crash_rc" -ne 0 ] && exit "$crash_rc"
 [ "$goodput_rc" -ne 0 ] && exit "$goodput_rc"
 [ "$hang_rc" -ne 0 ] && exit "$hang_rc"
+[ "$profile_rc" -ne 0 ] && exit "$profile_rc"
 exit "$fleet_rc"

@@ -9,4 +9,7 @@
 # baseline test in test_lint_ast.py execute the same passes `ds-tpu lint`
 # runs. scripts/lint.sh is the standalone CLI variant (emits the JSON
 # report for CI artifact upload); it needs no separate tier-1 slot.
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+# Timeout raised 870 -> 1080 at PR 19: the suite grew to 940+ tests over 18
+# PRs and a clean full run takes ~880 s on the reference container — the old
+# budget was killing green runs at ~98%.
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1080 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -143,6 +143,22 @@ SWEEP = {
          ("raise", ValueError)),
         ({"enabled": True, "goodput": {"enabled": True, "ledger_dir": 5}},
          ("raise", ValueError)),
+        ({"enabled": True, "profile": {"enabled": True}},
+         ("attr", "telemetry_profile_enabled", True)),
+        ({"enabled": True, "profile": {"enabled": True,
+                                       "reconcile_tolerance": 0.1}},
+         ("attr", "telemetry_profile_reconcile_tolerance", 0.1)),
+        ({"enabled": True, "profile": {"enabled": True, "emit_scalars": False}},
+         ("attr", "telemetry_profile_emit_scalars", False)),
+        # the observatory ingests the trace window the telemetry session
+        # writes — no telemetry, no profile
+        ({"profile": {"enabled": True}}, ("raise", ValueError)),
+        ({"enabled": True, "profile": {"enabled": True,
+                                       "reconcile_tolerance": 0}},
+         ("raise", ValueError)),
+        ({"enabled": True, "profile": {"enabled": True, "emit_scalars": 1}},
+         ("raise", ValueError)),
+        ({"enabled": True, "profile": {"enabled": 1}}, ("raise", ValueError)),
         # the heartbeat rides the telemetry end_step record — no telemetry, no cluster
         ({"cluster": {"enabled": True}}, ("raise", ValueError)),
         ({"enabled": True, "cluster": {"enabled": True, "heartbeat_interval": 0}},
@@ -336,6 +352,15 @@ def test_unknown_anatomy_key_warns(capture):
     assert "chip" in capture.text    # the known-keys hint points at the fix
 
 
+def test_unknown_profile_key_warns(capture):
+    _cfg(telemetry={"enabled": True,
+                    "profile": {"enabled": True, "tolernce": 0.1}})
+    assert "unknown telemetry.profile config key" in capture.text
+    assert "tolernce" in capture.text
+    # the known-keys hint points at the fix
+    assert "reconcile_tolerance" in capture.text
+
+
 def test_unknown_goodput_key_warns(capture):
     _cfg(telemetry={"enabled": True,
                     "goodput": {"enabled": True, "ledger_dirr": "/tmp/gp"}})
@@ -419,6 +444,8 @@ def test_known_nested_keys_do_not_warn(capture):
                                 "dcn_gbps": 25.0},
                     "goodput": {"enabled": True, "ledger_dir": "/tmp/gp",
                                 "emit_scalars": True, "eval_tag": "eval"},
+                    "profile": {"enabled": True, "reconcile_tolerance": 0.05,
+                                "emit_scalars": True},
                     "cluster": {"enabled": True, "heartbeat_interval": 2,
                                 "hang_deadline_s": 120.0, "dump_dir": "/tmp/cl",
                                 "straggler_threshold": 3.0,

@@ -1,0 +1,304 @@
+"""Measured-time profile observatory: trace parsing, classification, interval
+math, reconciliation verdicts, the diff gate, and the trace-dir namespacing
+helper (docs/profile.md). Everything here is pure host work over the
+committed fixture (tests/unit/fixtures/profile_cpu_mesh.trace.json.gz) or
+synthetic inputs — the end-to-end traced engine run is gated by
+``ds-tpu profile --reconcile`` in scripts/lint.sh against the committed
+golden (tests/unit/golden/profile_reconcile.json)."""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.utils.profile_ingest import (
+    ProfileParseError, device_slices, diff_reports, find_trace_files,
+    is_collective_op, load_trace, load_trace_dir, program_profile_info,
+    reconcile_profile, scan_trace_dirs, slice_level, slice_scope,
+    stable_projection, summarize_slices, to_profile_trace_events)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "profile_cpu_mesh.trace.json.gz")
+
+# the catalog row a profile-enabled compile would have recorded for the
+# fixture's program (program_profile_info over the optimized HLO)
+CATALOG = {
+    "jit_loss_and_grad": {
+        "program": "loss_and_grad",
+        "scopes": {"fusion.1": "ds_fwd_bwd",
+                   "reduce-scatter.6": "ds_grad_bucket0",
+                   "all-gather.3": "ds_grad_bucket0"},
+        "collectives": {
+            "reduce-scatter.6": {"level": "dcn", "bytes": 1024, "bucket": 0},
+            "all-gather.3": {"level": "ici", "bytes": 512, "bucket": 0},
+        },
+        "flops": 1000.0, "wire_ici": 512, "wire_dcn": 1024,
+        "predicted_exposed_ici_us": 0.0, "predicted_exposed_dcn_us": 90.0,
+    },
+    "jit_apply_update": {
+        "program": "apply_update",
+        "scopes": {"fusion.3": "ds_apply_update"},
+        "collectives": {},
+        "flops": 200.0, "wire_ici": 0, "wire_dcn": 0,
+        "predicted_exposed_ici_us": 0.0, "predicted_exposed_dcn_us": 0.0,
+    },
+}
+
+
+def _slices():
+    return device_slices(load_trace(FIXTURE)["traceEvents"])
+
+
+# ----------------------------------------------------------------- parsing
+def test_fixture_loads_and_filters_device_slices():
+    """Only complete events carrying an hlo_op arg are device slices — the
+    python host span and the counter event are dropped."""
+    slices = _slices()
+    assert len(slices) == 5
+    assert all(s["module"].startswith("jit_") for s in slices)
+    assert [s["op"] for s in slices] == [
+        "fusion.1", "fusion.2", "reduce-scatter.6", "all-gather.3",
+        "fusion.3"]
+
+
+def test_malformed_trace_refused(tmp_path):
+    """Truncated gzip, undecodable JSON, and a JSON object that is not a
+    trace bundle all raise ProfileParseError with the path named — never a
+    silent empty report, never a raw traceback type."""
+    trunc = tmp_path / "t.trace.json.gz"
+    trunc.write_bytes(gzip.compress(b'{"traceEvents": [')[:-4])
+    with pytest.raises(ProfileParseError, match="t.trace.json.gz"):
+        load_trace(str(trunc))
+    bad = tmp_path / "bad.trace.json"
+    bad.write_text("not json at all {")
+    with pytest.raises(ProfileParseError, match="bad.trace.json"):
+        load_trace(str(bad))
+    wrong = tmp_path / "wrong.trace.json"
+    wrong.write_text(json.dumps({"kind": "something_else"}))
+    with pytest.raises(ProfileParseError, match="not a trace-viewer bundle"):
+        load_trace(str(wrong))
+
+
+def test_empty_trace_dir_refused(tmp_path):
+    with pytest.raises(ProfileParseError, match="no trace files"):
+        load_trace_dir(str(tmp_path))
+
+
+def test_find_trace_files_walks_profiler_layout(tmp_path):
+    d = tmp_path / "plugins" / "profile" / "2026_08_07_00_00_00"
+    d.mkdir(parents=True)
+    f = d / "vm.trace.json.gz"
+    f.write_bytes(gzip.compress(json.dumps({"traceEvents": []}).encode()))
+    assert find_trace_files(str(tmp_path)) == [str(f)]
+    assert find_trace_files(str(f)) == [str(f)]
+
+
+# ----------------------------------------------------------- classification
+def test_collective_classification():
+    assert is_collective_op("all-reduce.8")
+    assert is_collective_op("reduce-scatter-start.2")
+    assert is_collective_op("collective-permute.1")
+    assert not is_collective_op("fusion.1")
+    assert not is_collective_op("convert.3")
+
+
+def test_scope_attribution_catalog_and_fallback():
+    """The compile-time catalog is authoritative (CPU traces carry bare
+    instruction names); TPU-style scope-prefixed op names attribute through
+    the regex fallback with no catalog at all."""
+    s = {"module": "jit_loss_and_grad", "op": "reduce-scatter.6",
+         "ts": 0.0, "dur": 1.0}
+    assert slice_scope(s, CATALOG) == "ds_grad_bucket0"
+    assert slice_scope(s) is None
+    tpu = {"module": "jit_train", "op": "ds_grad_bucket2/reduce-scatter.1",
+           "ts": 0.0, "dur": 1.0}
+    assert slice_scope(tpu) == "ds_grad_bucket2"
+    assert slice_scope({"module": "m", "op": "ring_rot3/copy.1",
+                        "ts": 0.0, "dur": 1.0}) == "ring_rot3"
+
+
+def test_level_attribution():
+    rs = {"module": "jit_loss_and_grad", "op": "reduce-scatter.6"}
+    ag = {"module": "jit_loss_and_grad", "op": "all-gather.3"}
+    assert slice_level(rs, CATALOG) == "dcn"
+    assert slice_level(ag, CATALOG) == "ici"
+    assert slice_level(rs) == "ici"  # no catalog: single-slice default
+
+
+# -------------------------------------------------------------- window math
+def test_window_interval_math():
+    """The fixture is built for exact arithmetic: compute [0,150]+[300,340],
+    DCN [140,240], ICI [200,260] -> exposed DCN 90 (not under compute),
+    exposed ICI 20 (not under compute OR in-flight DCN), host gap 40."""
+    report = summarize_slices(_slices(), catalog=CATALOG, devices=1, steps=1)
+    cls = report["classes"]
+    assert cls["compute"]["busy_us"] == 190.0          # [0,150] + [300,340]
+    assert cls["collective_dcn"]["busy_us"] == 100.0
+    assert cls["collective_dcn"]["exposed_us"] == 90.0
+    assert cls["collective_ici"]["busy_us"] == 60.0
+    assert cls["collective_ici"]["exposed_us"] == 20.0
+    assert cls["host_gap"]["gap_us"] == 40.0           # extent 340 - union 300
+    assert report["extent_us"] == 340.0
+    assert report["step_wall_us"] == 340.0
+    # per-bucket exposure: both fixture collectives are tagged bucket 0
+    assert report["buckets"]["0"]["exposed_dcn_us"] == 90.0
+    assert report["buckets"]["0"]["exposed_ici_us"] == 20.0
+
+
+def test_scope_rows_and_programs():
+    report = summarize_slices(_slices(), catalog=CATALOG, devices=1, steps=1,
+                              peak_tflops=1e-6)
+    scopes = report["scopes"]
+    assert scopes["ds_fwd_bwd"]["slices"] == 1
+    assert scopes["ds_grad_bucket0"]["collective_us"] == 160.0
+    assert scopes["ds_apply_update"]["busy_us"] == 40.0
+    assert scopes["unattributed"]["slices"] == 1       # fusion.2: no metadata
+    progs = report["programs"]
+    assert progs["jit_loss_and_grad"]["program"] == "loss_and_grad"
+    assert progs["jit_loss_and_grad"]["flops"] == 1000.0
+    # measured MFU: flops over the program's busy union [0,260] against peak
+    assert progs["jit_loss_and_grad"]["measured_mfu"] == pytest.approx(
+        1000.0 / (260e-6 * 1e-6 * 1e12))
+    assert report["measured_mfu"] == pytest.approx(
+        1200.0 / (340e-6 * 1e-6 * 1e12))
+
+
+# ------------------------------------------------------------ reconciliation
+def _derived(flops=1200.0, ici=512, dcn=1024, wall=None):
+    return {"flops_per_step": flops, "wire_ici_per_step": ici,
+            "wire_dcn_per_step": dcn, "step_wall_ms": wall}
+
+
+def test_reconcile_ok_and_projection_excludes_wall_clock():
+    measured = summarize_slices(_slices(), catalog=CATALOG, devices=1, steps=1)
+    report = reconcile_profile(measured, CATALOG, _derived(wall=0.34),
+                               entry="fixture")
+    assert report["ok"]
+    assert {c: r["status"] for c, r in report["classes"].items()} == {
+        "compute": "ok", "collective_ici": "ok", "collective_dcn": "ok",
+        "step_wall": "ok"}
+    golden = stable_projection(report)
+    assert "step_wall" not in golden["classes"]
+    flat = json.dumps(golden)
+    assert "_us" not in flat and "_ms" not in flat
+    assert golden["classes"]["compute"]["predicted_flops_per_step"] == 1200.0
+    assert golden["scopes_observed"] == [
+        "ds_apply_update", "ds_fwd_bwd", "ds_grad_bucket0"]
+
+
+def test_reconcile_drift_and_unobserved():
+    measured = summarize_slices(_slices(), catalog=CATALOG, devices=1, steps=1)
+    # derived flops 2x predicted -> compute drift, exit-1 contract
+    drift = reconcile_profile(measured, CATALOG, _derived(flops=2400.0))
+    assert not drift["ok"]
+    assert drift["classes"]["compute"]["status"] == "drift"
+    # a window that saw no slices at all: predictions exist, measurement
+    # doesn't -> unobserved, not drift (and not ok)
+    empty = summarize_slices([], catalog=CATALOG, devices=1, steps=1)
+    rep = reconcile_profile(empty, CATALOG, _derived())
+    assert rep["classes"]["compute"]["status"] == "unobserved"
+    assert rep["classes"]["collective_ici"]["status"] == "unobserved"
+    assert rep["classes"]["step_wall"]["status"] == "unobserved"
+
+
+def test_diff_gate():
+    measured = summarize_slices(_slices(), catalog=CATALOG, devices=1, steps=1)
+    ok = stable_projection(
+        reconcile_profile(measured, CATALOG, _derived(wall=0.34)))
+    assert diff_reports(ok, ok)["ok"]
+    # verdict regression ok -> drift is caught
+    bad = json.loads(json.dumps(ok))
+    bad["classes"]["compute"]["status"] = "drift"
+    d = diff_reports(ok, bad)
+    assert not d["ok"]
+    assert any("compute" in r and "drift" in r for r in d["regressions"])
+    # losing a scope from coverage is a regression too
+    lost = json.loads(json.dumps(ok))
+    lost["scopes_observed"].remove("ds_grad_bucket0")
+    assert not diff_reports(ok, lost)["ok"]
+
+
+# ---------------------------------------------------------------- trace dirs
+def test_scan_trace_dirs_namespaced_and_legacy(tmp_path):
+    (tmp_path / "trace_run-a_host0").mkdir()
+    (tmp_path / "trace_run-a_host1").mkdir()
+    (tmp_path / "trace_zzz_host0").mkdir()
+    (tmp_path / "unrelated").mkdir()
+    found = scan_trace_dirs(str(tmp_path))
+    assert [(d["run"], d["host"]) for d in found] == [
+        ("run-a", 0), ("run-a", 1), ("zzz", 0)]
+    # legacy layout: the profiler wrote into trace_dir itself
+    legacy = tmp_path / "old"
+    (legacy / "plugins" / "profile" / "x").mkdir(parents=True)
+    found = scan_trace_dirs(str(legacy))
+    assert [(d["run"], d["host"]) for d in found] == [("", 0)]
+    assert found[0]["path"] == str(legacy)
+    assert scan_trace_dirs(str(tmp_path / "missing")) == []
+
+
+# ------------------------------------------------------------- HLO catalog
+HLO_TEXT = """\
+HloModule jit_step, is_scheduled=true
+
+ENTRY main {
+  p0 = f32[8]{0} parameter(0)
+  mul = f32[8]{0} multiply(p0, p0), metadata={op_name="jit(step)/jit(main)/ds_fwd_bwd/mul"}
+  rs = f32[4]{0} reduce-scatter(mul), replica_groups={{0,1},{2,3}}, dimensions={0}, to_apply=add, metadata={op_name="jit(step)/jit(main)/ds_grad_bucket1/reduce-scatter"}
+  ar = f32[4]{0} all-reduce(rs), replica_groups={{0,2},{1,3}}, to_apply=add, metadata={op_name="jit(step)/jit(main)/ds_grad_bucket1/all-reduce"}
+  ROOT out = f32[4]{0} add(ar, ar)
+}
+"""
+
+
+def test_program_profile_info_parses_scopes_and_levels():
+    """The compile-time catalog: op_name metadata -> named scopes, replica
+    groups against the slice factorization -> ICI vs DCN, bucket tags from
+    the scope path."""
+    info = program_profile_info(HLO_TEXT,
+                                slice_sets=[{0, 1}, {2, 3}])
+    assert info["module"] == "jit_step"
+    assert info["scopes"]["mul"] == "ds_fwd_bwd"
+    assert info["scopes"]["rs"] == "ds_grad_bucket1"
+    # {{0,1},{2,3}} stays within the slices -> ICI; {{0,2},{1,3}} crosses
+    assert info["collectives"]["rs"]["level"] == "ici"
+    assert info["collectives"]["ar"]["level"] == "dcn"
+    assert info["collectives"]["rs"]["bucket"] == 1
+    # single-slice factorization: everything is ICI
+    flat = program_profile_info(HLO_TEXT, slice_sets=None)
+    assert flat["collectives"]["ar"]["level"] == "ici"
+
+
+# ----------------------------------------------------------- merged timeline
+def test_merged_timeline_tracks():
+    """pid 0 = predicted schedule pinned above pid 1 = measured classes, and
+    every measured slice lands on its class thread re-based to t0=0."""
+    predicted = [{
+        "name": "loss_and_grad",
+        "roofline": {"compute_floor_s": 100e-6, "hbm_floor_s": 50e-6,
+                     "mfu_ceiling": 0.5},
+        "collectives": [
+            {"op": "reduce-scatter", "level": "dcn", "instruction": "rs",
+             "bytes": 1024, "async": True, "zero_overlap": False,
+             "bucket": 0, "comm_s": 90e-6, "overlap_s": 40e-6,
+             "exposed_s": 50e-6}],
+    }]
+    trace = to_profile_trace_events(_slices(), catalog=CATALOG,
+                                    predicted_reports=predicted)
+    evs = trace["traceEvents"]
+    sort = {e["pid"]: e["args"]["sort_index"] for e in evs
+            if e.get("name") == "process_sort_index"}
+    assert sort == {0: 0, 1: 1}
+    names = {e["pid"]: e["args"]["name"] for e in evs
+             if e.get("name") == "process_name"}
+    assert names[0] == "predicted schedule"
+    assert names[1] == "measured trace"
+    measured = [e for e in evs if e.get("ph") == "X" and e["pid"] == 1]
+    assert len(measured) == 5
+    assert min(e["ts"] for e in measured) == 0.0      # re-based to the window
+    by_cat = {e["name"]: e["cat"] for e in measured}
+    assert by_cat["reduce-scatter.6"] == "collective-dcn"
+    assert by_cat["all-gather.3"] == "collective-ici"
+    assert by_cat["fusion.1"] == "compute"
+    predicted_evs = [e for e in evs if e.get("ph") == "X" and e["pid"] == 0]
+    assert {e["cat"] for e in predicted_evs} == {"roofline", "exposed-comm"}

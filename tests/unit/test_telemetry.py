@@ -126,12 +126,18 @@ def test_default_telemetry_blocks_are_only_the_loss_fetch(tmp_path):
 
 
 # --------------------------------------------------------------- pillar 2:
-# trace windows around the configured step range
-def test_trace_window_artifacts(tmp_path):
+# trace windows around the configured step range — one window shared with the
+# profile-observatory readback assertions (docs/profile.md): trace start/stop
+# late in a long pytest process is expensive, so the artifact-layout checks
+# and the Profile/* ingest checks ride the SAME traced run
+def test_trace_window_artifacts_and_profile_readback(tmp_path):
     trace_dir = os.path.join(str(tmp_path), "trace")
     eng = _build(telemetry={"enabled": True, "trace_steps": [1, 2],
-                            "trace_dir": trace_dir,
-                            "output_path": str(tmp_path)})
+                            "trace_dir": trace_dir, "peak_tflops": 1e-6,
+                            "profile": {"enabled": True},
+                            "output_path": str(tmp_path), "job_name": "prof"})
+    assert eng.telemetry.profile_enabled
+    assert eng.telemetry.watchdog.profile_scopes
     xs, ys = _batch()
     # step 0: before the window — the trace dir must not even exist yet
     loss = eng(xs, ys); eng.backward(loss); eng.step()
@@ -145,8 +151,82 @@ def test_trace_window_artifacts(tmp_path):
     # step 2: past the window — must already be stopped and written
     loss = eng(xs, ys); eng.backward(loss); eng.step()
     assert eng.telemetry._trace_done and not eng.telemetry._trace_active
-    artifacts = glob.glob(os.path.join(trace_dir, "plugins", "profile", "*", "*"))
-    assert artifacts, f"no profiler artifacts under {trace_dir}"
+    # the profiler session lands in the run/host-namespaced subdir
+    from deepspeed_tpu.utils.profile_ingest import (find_trace_files,
+                                                    scan_trace_dirs)
+    runs = scan_trace_dirs(trace_dir)
+    assert [(d["run"], d["host"]) for d in runs] == \
+        [(eng.telemetry.run_id, eng.telemetry.host_id)]
+    assert runs[0]["path"] == eng.telemetry.trace_output_dir
+    assert find_trace_files(runs[0]["path"]), \
+        f"no profiler artifacts under {runs[0]['path']}"
+    # profile observatory: the window was read back at close
+    prof = eng.telemetry.last_profile
+    assert prof is not None, "window closed but no profile was ingested"
+    assert prof["total_slices"] > 0
+    assert prof["classes"]["compute"]["busy_us"] > 0
+    # the compile-time catalog joined: the step program is attributed (the
+    # module name varies by engine path — jit_loss_and_grad vs the ZeRO
+    # jit_local_loss_and_grad — so key on the joined watchdog program)
+    joined = {v.get("program") for v in prof["programs"].values()}
+    assert "loss_and_grad" in joined and "apply_update" in joined
+    eng.telemetry.close()
+    scalars = [json.loads(l) for l in
+               open(os.path.join(str(tmp_path), "prof", "scalars.jsonl"))]
+    tags = {s["tag"] for s in scalars}
+    for tag in ("Profile/compute_ms", "Profile/collective_ici_ms",
+                "Profile/collective_dcn_ms", "Profile/host_gap_ms",
+                "Profile/step_wall_ms", "Profile/exposed_ici_ms",
+                "Profile/exposed_dcn_ms"):
+        assert tag in tags, f"missing {tag}"
+    # summary carries the condensed per-step decomposition
+    summary = eng.telemetry.summary()
+    assert summary["profile"] is not None
+    assert summary["profile"]["step_wall_ms"] > 0
+    assert summary["trace"]["done"] is True
+    # and the flight-recorder embedding sees the same report
+    snap = eng.telemetry.profile_snapshot()
+    assert snap["report"] is prof and snap["trace_failed"] is False
+
+
+def test_trace_dir_namespacing_and_legacy_layout(tmp_path):
+    """Two sessions sharing one trace_dir get distinct trace_<run>_host<h>/
+    subdirs (the PR-14 flight-recorder naming); run_id=\"\" opts back into the
+    legacy layout where the profiler writes into trace_dir itself."""
+    shared = str(tmp_path / "shared")
+    s1 = TelemetrySession(trace_dir=shared, trace_steps=[0, 1],
+                          run_id="run-a", host_id=0, output_path=str(tmp_path))
+    s2 = TelemetrySession(trace_dir=shared, trace_steps=[0, 1],
+                          run_id="run-b", host_id=1, output_path=str(tmp_path))
+    assert s1.trace_output_dir == os.path.join(shared, "trace_run-a_host0")
+    assert s2.trace_output_dir == os.path.join(shared, "trace_run-b_host1")
+    assert s1.trace_output_dir != s2.trace_output_dir
+    legacy = TelemetrySession(trace_dir=shared, trace_steps=[0, 1],
+                              run_id="", output_path=str(tmp_path))
+    assert legacy.trace_output_dir == shared
+    for s in (s1, s2, legacy):
+        s.close()
+
+
+def test_trace_failure_latched_into_summary(tmp_path, capture):
+    """A profiler that cannot start warns ONCE, latches _trace_failed, stops
+    all window bookkeeping, and surfaces the flag in summary()['trace'] so a
+    bench run can't silently lose its measurement."""
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("a file where the trace dir must go")
+    session = TelemetrySession(trace_dir=str(blocker), trace_steps=[0, 2],
+                               run_id="", output_path=str(tmp_path))
+    session.on_step_begin(0)
+    assert session._trace_failed and not session._trace_active
+    assert capture.text.count("profiler trace unavailable") == 1
+    # subsequent steps must not retry or warn again
+    session.on_step_begin(1)
+    session.end_step(1, 8)
+    assert capture.text.count("profiler trace unavailable") == 1
+    summary = session.summary()
+    assert summary["trace"]["failed"] is True
+    assert summary["trace"]["done"] is False
+    session.close()
 
 
 def test_trace_steps_validation():
@@ -268,3 +348,26 @@ def test_session_uses_engine_monitor_when_tensorboard_enabled(tmp_path):
     # engine training scalars and telemetry scalars share the sink
     assert "Train/Samples/train_loss" in tags
     assert "Telemetry/Samples/step_time_ms" in tags
+
+
+# --------------------------------------------------------------- profile
+# observatory (docs/profile.md): the ingest/scalars assertions ride the
+# trace window in test_trace_window_artifacts_and_profile_readback above;
+# here: the zero-instruction guarantee every observatory pins
+def test_profile_enabled_is_hlo_identical(tmp_path):
+    """telemetry.profile reads trace files back on the host — the lowered
+    step program must be instruction-identical with the block on or off."""
+    eng_off = _build(telemetry={"enabled": True,
+                                "output_path": str(tmp_path)})
+    eng_on = _build(telemetry={"enabled": True, "trace_steps": [1, 2],
+                               "trace_dir": os.path.join(str(tmp_path), "tr"),
+                               "profile": {"enabled": True},
+                               "output_path": str(tmp_path)})
+    xs, ys = _batch()
+    hlos = []
+    for eng in (eng_off, eng_on):
+        hlos.append(optimized_hlo(eng._jit_loss_and_grad, eng.params,
+                                  eng.scaler_state.cur_scale, xs, ys))
+    assert instruction_count(hlos[0]) > 0
+    assert instruction_count(hlos[0]) == instruction_count(hlos[1])
+    assert collective_counts(hlos[0]) == collective_counts(hlos[1])
