@@ -129,28 +129,69 @@ REGRESSION_KEYS = (
     "extra.goodput.badput_checkpoint_pct",
 )
 
-# keys where LOWER is better (latency): a regression is a RISE past the
-# threshold, so their delta sign is inverted before the flag check
-LOWER_IS_BETTER_KEYS = frozenset(
-    k for k in REGRESSION_KEYS
-    if k.endswith("_ms_p50") or k.endswith("_ms_p95")) | frozenset({
-        "extra.resilience.checkpoint_stall_ms",
-        "extra.resilience.restore_warm_vs_cold_ttft",
-        "extra.goodput.badput_checkpoint_pct",
-        "extra.serving_speculative.target_steps_per_token",
-        "extra.serving_1p5b_spec.target_steps_per_token",
-        "extra.serving_fleet.fleet_p99_ttft_ms",
-        "extra.serving_fleet.shed_rate",
-        "extra.serving_fleet.shed_rate_2x_saturation",
-        "extra.hbm.peak_by_class.params",
-        "extra.hbm.peak_by_class.grads",
-        "extra.hbm.peak_by_class.master",
-        "extra.hbm.peak_by_class.optimizer",
-        "extra.hbm.peak_by_class.compiled_temp_peak",
-        "extra.profile.exposed_ici_ms",
-        "extra.profile.exposed_dcn_ms",
-        "extra.profile.host_gap_ms",
-    })
+# Every regression key maps to its declared metric in the MetricCatalog
+# (deepspeed_tpu/utils/metrics.py) — the catalog's direction decides which
+# way is worse, so bench keeps NO private lower-is-better list. A key whose
+# metric resolves neutral (or not at all) is a declaration bug:
+# tests/unit/test_metrics_catalog.py pins full coverage.
+REGRESSION_KEY_METRICS = {
+    "value": "Telemetry/Samples/samples_per_sec",
+    "extra.gpt2_420m_tokens_per_sec_per_chip":
+        "Telemetry/Samples/samples_per_sec",
+    "extra.gpt2_1p5b_engine_tokens_per_sec":
+        "Telemetry/Samples/samples_per_sec",
+    "extra.decode_420m.greedy_tok_s": "Serving/tok_s",
+    "extra.serving_420m.tok_s": "Serving/tok_s",
+    "extra.serving_420m.goodput_tok_s": "Serving/goodput_tok_s",
+    "extra.serving_420m.ttft_ms_p50": "Serving/Latency/ttft_ms_p50",
+    "extra.serving_420m.ttft_ms_p95": "Serving/Latency/ttft_ms_p95",
+    "extra.serving_420m_prefix_cache.prefix_cache_hit_rate":
+        "Serving/PrefixCache/hit_rate",
+    "extra.serving_420m_prefix_cache.ttft_ms_p50":
+        "Serving/Latency/ttft_ms_p50",
+    "extra.serving_420m_sharded.tok_s": "Serving/tok_s",
+    "extra.serving_speculative.spec_acceptance_rate":
+        "Serving/Spec/acceptance_rate",
+    "extra.serving_speculative.target_steps_per_token":
+        "Serving/Spec/target_steps_per_token",
+    "extra.serving_1p5b_spec.spec_acceptance_rate":
+        "Serving/Spec/acceptance_rate",
+    "extra.serving_1p5b_spec.target_steps_per_token":
+        "Serving/Spec/target_steps_per_token",
+    "extra.serving_fleet.fleet_p99_ttft_ms":
+        "Serving/Fleet/Latency/ttft_ms_p99",
+    "extra.serving_fleet.shed_rate": "Serving/Fleet/shed",
+    "extra.serving_fleet.shed_rate_2x_saturation": "Serving/Fleet/shed",
+    "extra.serving_fleet.goodput_fleet_fraction":
+        "Serving/Fleet/Goodput/fraction",
+    "extra.hbm.peak_by_class.params": "Memory/params_bytes",
+    "extra.hbm.peak_by_class.grads": "Memory/grads_bytes",
+    "extra.hbm.peak_by_class.master": "Memory/master_bytes",
+    "extra.hbm.peak_by_class.optimizer": "Memory/optimizer_bytes",
+    "extra.hbm.peak_by_class.compiled_temp_peak":
+        "Memory/compiled_temp_peak_bytes",
+    "extra.profile.exposed_ici_ms": "Profile/exposed_ici_ms",
+    "extra.profile.exposed_dcn_ms": "Profile/exposed_dcn_ms",
+    "extra.profile.host_gap_ms": "Profile/host_gap_ms",
+    "extra.profile.measured_mfu": "Profile/mfu",
+    "extra.resilience.checkpoint_stall_ms":
+        "Run/Goodput/checkpoint_stall_seconds",
+    "extra.resilience.restore_warm_vs_cold_ttft": "Serving/ttft_ms",
+    "extra.goodput.goodput_fraction": "Run/Goodput/goodput_fraction",
+    "extra.goodput.badput_checkpoint_pct":
+        "Run/Goodput/checkpoint_stall_seconds",
+}
+
+
+def lower_is_better_keys():
+    """Regression keys whose metric the catalog declares lower-is-better —
+    their delta sign is inverted before the flag check (a regression is a
+    RISE). Lazy import: the catalog costs nothing but bench's module import
+    must stay dependency-light."""
+    from deepspeed_tpu.utils.metrics import default_catalog
+    catalog = default_catalog()
+    return frozenset(k for k, metric in REGRESSION_KEY_METRICS.items()
+                     if catalog.direction(metric) == "lower_is_better")
 
 
 def regression_vs_previous_round(current, threshold_pct=5.0):
@@ -167,13 +208,14 @@ def regression_vs_previous_round(current, threshold_pct=5.0):
                 f"({prev.get('metric')} -> {current.get('metric')}); skipped"}
     out = {"baseline_round": rnd, "threshold_pct": threshold_pct,
            "metrics": {}, "regressed": []}
+    lower = lower_is_better_keys()
     for key in REGRESSION_KEYS:
         was, now = _dig(prev, key), _dig(current, key)
         if was is None or now is None or was <= 0:
             continue
         delta = 100.0 * (now - was) / was
         row = {"prev": was, "cur": now, "delta_pct": round(delta, 2)}
-        worse = -delta if key in LOWER_IS_BETTER_KEYS else delta
+        worse = -delta if key in lower else delta
         if worse < -threshold_pct:
             row["regressed"] = True
             out["regressed"].append(key)

@@ -27,6 +27,11 @@ class SummaryMonitor:
         self._tb = None
         self._jsonl = None
         self._events = None
+        # MetricStore hook (utils/metrics.py), set by
+        # TelemetrySession.configure_metrics. Lives on EVERY rank and is fed
+        # before the rank-0 early return so each host's metric ring is
+        # populated even though only process 0 writes files.
+        self.metrics = None
         # log_dir is part of the public surface on EVERY rank (rank-agnostic
         # callers read it), so it must be set before the disabled early-return.
         output_path = output_path or os.path.join(os.environ.get("DLWS_JOB_ID", "."),
@@ -36,7 +41,10 @@ class SummaryMonitor:
         if not self.enabled:
             return
         os.makedirs(self.log_dir, exist_ok=True)
-        self._jsonl = open(os.path.join(self.log_dir, "scalars.jsonl"), "a", buffering=1)
+        # block-buffered: one write syscall per flush() (telemetry flushes at
+        # every end_step), not one per scalar. The flight recorder flushes
+        # this stream before dumping so a crash loses nothing (numerics.py).
+        self._jsonl = open(os.path.join(self.log_dir, "scalars.jsonl"), "a")
         atexit.register(self.close)  # flush TB events on normal interpreter exit
         try:
             from torch.utils.tensorboard import SummaryWriter
@@ -46,6 +54,12 @@ class SummaryMonitor:
                         f"scalars go to {self.log_dir}/scalars.jsonl only")
 
     def add_scalar(self, name: str, value, global_step: int):
+        if self.metrics is not None:
+            # catalog routing + ring recording happens on every rank and for
+            # every emitter (engine, serving, router, cluster, numerics all
+            # share this monitor object) — strict mode may raise here, which
+            # is the drift guard doing its job.
+            self.metrics.observe(name, value, global_step)
         if not self.enabled:
             return
         value = float(value)
@@ -61,12 +75,16 @@ class SummaryMonitor:
         if not self.enabled:
             return
         if self._events is None:
-            self._events = open(os.path.join(self.log_dir, "events.jsonl"), "a", buffering=1)
+            self._events = open(os.path.join(self.log_dir, "events.jsonl"), "a")
         self._events.write(json.dumps(
             {"event": name, "step": None if step is None else int(step),
              "payload": payload, "time": time.time()}, default=repr) + "\n")
 
     def flush(self):
+        if self._jsonl is not None:
+            self._jsonl.flush()
+        if self._events is not None:
+            self._events.flush()
         if self._tb is not None:
             self._tb.flush()
 

@@ -330,6 +330,17 @@ class FlightRecorder:
                     prof = None
                 if prof is not None:
                     out["profile"] = prof
+            # alert plane: rules/fired/active state + the full metric ring
+            # (utils/alerts.py) — a page-severity alert triggers this dump,
+            # so the bundle must carry the evidence it fired on
+            alert_snapper = getattr(self.telemetry, "alerts_snapshot", None)
+            if alert_snapper is not None:
+                try:
+                    alerts = alert_snapper()
+                except Exception:
+                    alerts = None
+                if alerts is not None:
+                    out["alerts"] = alerts
         return out
 
     def _span(self):
@@ -361,6 +372,16 @@ class FlightRecorder:
 
     # -- triggering --------------------------------------------------------
     def trigger(self, reason, detail=None, quiet=False):
+        # the SummaryMonitor's JSONL streams are block-buffered; a crash
+        # post-mortem is exactly when the last pre-crash scalars/events
+        # matter, so force them to disk before (and regardless of) the dump
+        mon = getattr(self.telemetry, "monitor", None) \
+            if self.telemetry is not None else None
+        if mon is not None:
+            try:
+                mon.flush()
+            except Exception:  # dump/flush failure must never kill the job
+                pass
         if not self.dump_dir:
             return None
         try:

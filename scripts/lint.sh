@@ -146,6 +146,17 @@ timeout -k 10 300 "$REPO/bin/ds-tpu" profile --reconcile --json \
 && cmp "$REPO/tests/unit/golden/profile_reconcile.json" \
        /tmp/_profile_golden.json
 profile_rc=$?
+# alert-sim: alert attribution harness — four injected ground-truth
+# regressions (MFU drop via step-wall inflation, fleet shed spike via
+# Poisson arrivals at 2x capacity, loss-scale stuck streak via forced
+# overflow, heartbeat dispatch skew), each asserted to fire exactly its own
+# default-ruleset rule and nothing else, plus the two-host fleet merge
+# naming the first-firing host+rule; transcript is byte-compared against
+# the committed golden so any rule/threshold drift fails CI
+timeout -k 10 120 "$REPO/bin/ds-tpu" alert-sim --json /tmp/_alert_sim.json \
+&& cmp "$REPO/tests/unit/golden/alert_attribution.json" \
+       /tmp/_alert_sim.json
+alert_rc=$?
 # fleet gate: seeded 3-replica shared-prefix fleet with two mid-flight kills —
 # affinity routing must emit byte-identical tokens to round-robin while doing
 # STRICTLY fewer prefill chunks and a strictly better fleet p50 TTFT, warm
@@ -178,4 +189,5 @@ fleet_rc=$?
 [ "$goodput_rc" -ne 0 ] && exit "$goodput_rc"
 [ "$hang_rc" -ne 0 ] && exit "$hang_rc"
 [ "$profile_rc" -ne 0 ] && exit "$profile_rc"
+[ "$alert_rc" -ne 0 ] && exit "$alert_rc"
 exit "$fleet_rc"

@@ -1,0 +1,495 @@
+"""Alert engine tests (docs/alerts.md).
+
+The alert plane (utils/alerts.py) evaluates deterministic host-side rules
+over the per-host metric ring on the end_step boundary — zero new device
+syncs, and the compiled step programs are HLO-instruction-identical with the
+plane on or off (pinned below for every train path AND the serving decode
+programs). Covers: rule validation, the four rule kinds (threshold / delta /
+stuck / slo_burn incl. burn-rate hysteresis), the fire/clear protocol through
+SummaryMonitor + FlightRecorder (page severity dumps carry the full ring),
+the fleet merge + assemble_cluster_report's alerts_fleet block, the CLI state
+loaders, and the attribution harness against its committed golden.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.utils.alerts import (AlertEngine, default_rules,
+                                        merge_fleet_alerts,
+                                        run_alert_attribution, validate_rules,
+                                        _load_alert_state)
+from deepspeed_tpu.utils.metrics import MetricStore, default_catalog
+from deepspeed_tpu.utils.monitor import SummaryMonitor
+from simple_model import SimpleModel, random_dataset, simple_config
+
+HIDDEN = 16
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden",
+                      "alert_attribution.json")
+
+
+# -------------------------------------------------------------- validation
+
+
+def test_validate_rejects_malformed_rules():
+    cat = default_catalog()
+    with pytest.raises(ValueError, match="must be a list"):
+        validate_rules("not-a-list")
+    with pytest.raises(ValueError, match="kind must be one of"):
+        validate_rules([{"name": "x", "kind": "gradient",
+                         "metric": "Telemetry/Samples/mfu"}])
+    with pytest.raises(ValueError, match="duplicate rule name"):
+        validate_rules([{"name": "x", "kind": "threshold",
+                         "metric": "Telemetry/Samples/mfu", "above": 1},
+                        {"name": "x", "kind": "threshold",
+                         "metric": "Telemetry/Samples/mfu", "above": 2}])
+    with pytest.raises(ValueError, match="unknown key"):
+        validate_rules([{"name": "x", "kind": "threshold",
+                         "metric": "Telemetry/Samples/mfu", "above": 1,
+                         "window": 4}])  # 'window' belongs to delta
+    with pytest.raises(ValueError, match="needs 'above' and/or 'below'"):
+        validate_rules([{"name": "x", "kind": "threshold",
+                         "metric": "Telemetry/Samples/mfu"}])
+    with pytest.raises(ValueError, match="budget"):
+        validate_rules([{"name": "x", "kind": "slo_burn",
+                         "metric": "Serving/Fleet/shed", "mode": "counter"}])
+    with pytest.raises(ValueError, match="not declared"):
+        validate_rules([{"name": "x", "kind": "threshold",
+                         "metric": "Bogus/metric", "above": 1}], cat)
+    # delta needs a direction to know which way is a regression
+    with pytest.raises(ValueError, match="neutral"):
+        validate_rules([{"name": "x", "kind": "delta",
+                         "metric": "Train/Samples/lr"}], cat)
+
+
+def test_validate_normalizes_defaults():
+    rules = validate_rules([{"name": "d", "kind": "delta",
+                             "metric": "Telemetry/Samples/mfu"}],
+                           default_catalog())
+    assert rules[0] == {"name": "d", "kind": "delta",
+                        "metric": "Telemetry/Samples/mfu", "severity": "warn",
+                        "window": 8, "baseline": 16, "drop_pct": 20.0}
+
+
+def test_default_rules_cover_all_four_kinds():
+    rules = default_rules()
+    assert [r["kind"] for r in rules] == ["delta", "slo_burn", "stuck",
+                                          "threshold"]
+    assert {r["severity"] for r in rules} == {"warn", "page"}
+    # every shipped rule targets a declared metric (validate enforces it,
+    # but pin explicitly: the defaults ARE the PERF.md round-7 ruleset)
+    cat = default_catalog()
+    for r in rules:
+        assert cat.resolve(r["metric"]) is not None, r["name"]
+
+
+# ---------------------------------------------------------------- rule kinds
+
+
+def _engine(rules, ring_len=64, monitor=None, recorder=None):
+    store = MetricStore(ring_len=ring_len)
+    eng = AlertEngine(rules=rules, store=store, monitor=monitor,
+                      recorder=recorder)
+    return eng, store
+
+
+def test_threshold_for_steps_consecutive():
+    eng, store = _engine([{"name": "hot", "kind": "threshold",
+                           "metric": "Cluster/step_skew", "above": 3.0,
+                           "for_steps": 2}])
+    store.observe("Cluster/step_skew", 5.0, 0)
+    assert eng.evaluate(0) == []          # one violating step is not enough
+    store.observe("Cluster/step_skew", 1.0, 1)
+    assert eng.evaluate(1) == []          # streak broken
+    store.observe("Cluster/step_skew", 4.0, 2)
+    eng.evaluate(2)
+    store.observe("Cluster/step_skew", 4.5, 3)
+    fired = eng.evaluate(3)               # two consecutive: fires
+    assert [r["rule"] for r in fired] == ["hot"]
+    assert fired[0]["detail"]["for_steps"] == 2
+    assert eng.active() == ["hot"]
+
+
+def test_delta_direction_comes_from_catalog():
+    # higher-is-better metric: a DROP fires
+    eng, store = _engine([{"name": "mfu", "kind": "delta",
+                           "metric": "Telemetry/Samples/mfu",
+                           "window": 2, "baseline": 2, "drop_pct": 20.0}])
+    for step, v in enumerate((0.4, 0.4, 0.4, 0.4)):
+        store.observe("Telemetry/Samples/mfu", v, step)
+        assert eng.evaluate(step) == []
+    store.observe("Telemetry/Samples/mfu", 0.28, 4)
+    eng.evaluate(4)
+    store.observe("Telemetry/Samples/mfu", 0.28, 5)
+    fired = eng.evaluate(5)               # 30% below the baseline window
+    assert [r["rule"] for r in fired] == ["mfu"]
+    assert fired[0]["detail"]["regression_pct"] == pytest.approx(30.0)
+
+    # lower-is-better metric: a RISE fires (same rule shape, inverted sign)
+    eng2, store2 = _engine([{"name": "ttft", "kind": "delta",
+                             "metric": "Serving/Latency/ttft_ms_p50",
+                             "window": 2, "baseline": 2, "drop_pct": 20.0}])
+    for step, v in enumerate((10.0, 10.0, 14.0, 14.0)):
+        store2.observe("Serving/Latency/ttft_ms_p50", v, step)
+        eng2.evaluate(step)
+    assert [r["rule"] for r in eng2.fired] == ["ttft"]  # +40% latency
+
+
+def test_stuck_pinned_at_value():
+    eng, store = _engine([{"name": "ls", "kind": "stuck",
+                           "metric": "Train/Samples/loss_scale",
+                           "steps": 3, "at": 1.0}])
+    # unchanged at a HEALTHY value: the pin means no fire
+    for step in range(4):
+        store.observe("Train/Samples/loss_scale", 256.0, step)
+        assert eng.evaluate(step) == []
+    # pinned to the min-scale floor for 3 steps: fires once
+    for step in range(4, 8):
+        store.observe("Train/Samples/loss_scale", 1.0, step)
+        eng.evaluate(step)
+    assert [r["rule"] for r in eng.fired] == ["ls"]
+    assert eng.fired[0]["detail"]["mode"] == "unchanged"
+
+
+def test_stuck_absent_mode_only_without_pin():
+    # un-pinned rule: silence after an observation IS the failure
+    eng, store = _engine([{"name": "hb", "kind": "stuck",
+                           "metric": "Cluster/step_skew", "steps": 3}])
+    store.observe("Cluster/step_skew", 1.1, 0)
+    assert eng.evaluate(0) == []
+    assert eng.evaluate(1) == []
+    fired = eng.evaluate(3)               # 3 silent steps since step 0
+    assert [r["rule"] for r in fired] == ["hb"]
+    assert fired[0]["detail"]["mode"] == "absent"
+    # pinned rule: absence never fires (it watches for a value, not silence)
+    eng2, store2 = _engine([{"name": "ls", "kind": "stuck",
+                             "metric": "Train/Samples/loss_scale",
+                             "steps": 3, "at": 1.0}])
+    store2.observe("Train/Samples/loss_scale", 256.0, 0)
+    for step in range(12):
+        assert eng2.evaluate(step) == []
+
+
+def test_slo_burn_fraction_with_good_inversion():
+    eng, store = _engine([{"name": "gp", "kind": "slo_burn",
+                           "metric": "Serving/Fleet/Goodput/fraction",
+                           "budget": 0.1, "good": True,
+                           "fast_window": 2, "slow_window": 4,
+                           "fast_burn": 3.0, "slow_burn": 2.0}])
+    name = "Serving/Fleet/Goodput/fraction"
+    for step in range(4):
+        store.observe(name, 1.0, step)    # perfect goodput: zero burn
+        assert eng.evaluate(step) == []
+    for step in range(4, 8):
+        store.observe(name, 0.6, step)    # bad fraction 0.4 = 4x budget
+        eng.evaluate(step)
+    assert [r["rule"] for r in eng.fired] == ["gp"]
+    assert eng.fired[0]["detail"]["burn_fast"] == pytest.approx(4.0)
+
+
+def test_slo_burn_hysteresis_no_flap():
+    """Once firing, a burn alert clears only when BOTH windows are back
+    within budget (burn < 1) — dipping just below the fire threshold on a
+    bursty stream must NOT clear-and-refire."""
+    rule = {"name": "shed", "kind": "slo_burn", "metric": "Serving/Fleet/shed",
+            "mode": "counter", "budget": 1.0, "fast_window": 2,
+            "slow_window": 4, "fast_burn": 3.0, "slow_burn": 2.0}
+    eng, store = _engine([rule])
+    total = 0.0
+    deltas = [0, 0, 0, 0,            # healthy
+              4, 4, 4, 4,            # burst: burn 4x budget -> fires once
+              2, 2, 2, 2,            # still over budget, below fire bar:
+                                     # hysteresis holds it ACTIVE (no flap)
+              0, 0, 0, 0]            # back within budget: clears
+    for step, d in enumerate(deltas):
+        total += d
+        store.observe("Serving/Fleet/shed", total, step)
+        eng.evaluate(step)
+    assert [r["rule"] for r in eng.fired] == ["shed"]     # exactly ONE firing
+    state = eng._state["shed"]
+    assert not state["active"] and state["fired"] == 1    # and it cleared
+
+
+def test_slo_burn_counter_reset_clamps():
+    """A counter reset (restart) steps the cumulative value DOWN — the
+    per-step diff clamps at zero instead of registering negative burn."""
+    eng, store = _engine([{"name": "shed", "kind": "slo_burn",
+                           "metric": "Serving/Fleet/shed", "mode": "counter",
+                           "budget": 1.0, "fast_window": 2, "slow_window": 4,
+                           "fast_burn": 3.0, "slow_burn": 2.0}])
+    for step, total in enumerate((100.0, 100.0, 100.0, 100.0, 0.0, 0.0)):
+        store.observe("Serving/Fleet/shed", total, step)
+        assert eng.evaluate(step) == []   # the reset is not an event storm
+
+
+# ----------------------------------------------------------- fire protocol
+
+
+def test_fire_once_then_clear_through_monitor(tmp_path):
+    mon = SummaryMonitor(str(tmp_path), "al")
+    eng, store = _engine([{"name": "hot", "kind": "threshold",
+                           "metric": "Cluster/step_skew", "above": 3.0}],
+                         monitor=mon)
+    values = (5.0, 5.0, 5.0, 1.0)         # sustained violation, then healthy
+    for step, v in enumerate(values):
+        store.observe("Cluster/step_skew", v, step)
+        eng.evaluate(step)
+    mon.close()
+    assert len(eng.fired) == 1            # one record, not one per step
+    scalars = [json.loads(l) for l in
+               open(os.path.join(str(tmp_path), "al", "scalars.jsonl"))]
+    alert_scalars = [(s["step"], s["value"]) for s in scalars
+                     if s["tag"] == "Alerts/hot"]
+    assert alert_scalars == [(0, 1.0), (3, 0.0)]  # fire edge + clear edge
+    events = [json.loads(l) for l in
+              open(os.path.join(str(tmp_path), "al", "events.jsonl"))]
+    kinds = [e["event"] for e in events]
+    assert kinds == ["alert", "alert_clear"]
+    assert events[0]["payload"]["rule"] == "hot"
+    # snapshot is deterministic state, no wall clocks
+    snap = eng.snapshot()
+    assert snap["active"] == [] and len(snap["fired"]) == 1
+    assert "time" not in json.dumps(snap)
+
+
+def test_page_severity_dumps_the_ring(tmp_path):
+    """page alerts trigger the flight recorder AFTER recording the firing,
+    so the post-mortem bundle carries both the alert and the full ring."""
+    from types import SimpleNamespace
+    from deepspeed_tpu.utils.numerics import FlightRecorder
+    store = MetricStore(ring_len=32)
+    eng = AlertEngine(rules=[{"name": "hot", "kind": "threshold",
+                              "metric": "Cluster/step_skew", "above": 3.0,
+                              "severity": "page"}], store=store)
+    tel = SimpleNamespace(monitor=None, watchdog=None,
+                          alerts_snapshot=lambda: dict(eng.snapshot(),
+                                                       ring=store.to_dict()))
+    rec = FlightRecorder(capacity=16, dump_dir=str(tmp_path), telemetry=tel)
+    eng.recorder = rec
+    store.observe("Cluster/step_skew", 9.0, 5)
+    eng.evaluate(5)
+    dumps = glob.glob(os.path.join(str(tmp_path), "*.json"))
+    assert len(dumps) == 1
+    bundle = json.load(open(dumps[0]))
+    blk = bundle["alerts"]
+    assert [r["rule"] for r in blk["fired"]] == ["hot"]
+    assert blk["active"] == ["hot"]
+    ring = blk["ring"]["series"]["Cluster/step_skew"]
+    assert ring == [[5, 9.0]]
+    # the CLI state loader reads the same dump
+    state = _load_alert_state(dumps[0])
+    assert [r["rule"] for r in state["fired"]] == ["hot"]
+
+
+# ------------------------------------------------------- engine integration
+
+
+def _build(**overrides):
+    import jax
+    model = SimpleModel(HIDDEN)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+        config_params=simple_config(**overrides))
+    return eng
+
+
+def _batch(n=8, seed=0):
+    data = random_dataset(n, HIDDEN, seed=seed)
+    return (np.stack([d[0] for d in data]), np.stack([d[1] for d in data]))
+
+
+def test_alerts_ride_end_step_through_the_real_engine(tmp_path):
+    """Full wiring: telemetry.alerts config -> AlertEngine on the telemetry
+    monitor + numerics flight recorder; a rule that must fire on step 1
+    (step_time_ms above 0) emits the Alerts/* scalar, the alert event, and a
+    page dump whose bundle embeds the alert state + metric ring."""
+    rule = {"name": "any_step", "kind": "threshold",
+            "metric": "Telemetry/Samples/step_time_ms", "above": 0.0,
+            "severity": "page"}
+    eng = _build(telemetry={"enabled": True, "output_path": str(tmp_path),
+                            "job_name": "al",
+                            "alerts": {"enabled": True, "rules": [rule]}},
+                 numerics={"enabled": True, "dump_dir": str(tmp_path / "d")})
+    assert eng.telemetry.alert_engine is not None
+    assert eng.telemetry.alert_engine.recorder is not None
+    xs, ys = _batch()
+    for _ in range(2):
+        loss = eng(xs, ys)
+        eng.backward(loss)
+        eng.step()
+    eng.telemetry.close()
+    fired = eng.telemetry.alert_engine.fired
+    assert [r["rule"] for r in fired] == ["any_step"]
+    scalars = open(os.path.join(str(tmp_path), "al", "scalars.jsonl")).read()
+    assert "Alerts/any_step" in scalars
+    state = _load_alert_state(os.path.join(str(tmp_path), "al",
+                                           "events.jsonl"))
+    assert [r["rule"] for r in state["fired"]] == ["any_step"]
+    dumps = glob.glob(os.path.join(str(tmp_path), "d", "*.json"))
+    assert dumps, "page alert produced no flight-recorder dump"
+    bundle = json.load(open(dumps[0]))
+    assert bundle["alerts"]["fired"][0]["rule"] == "any_step"
+    assert "Telemetry/Samples/step_time_ms" in \
+        bundle["alerts"]["ring"]["series"]
+
+
+def test_alerts_require_telemetry():
+    with pytest.raises(ValueError, match="telemetry.alerts.enabled requires"):
+        _build(telemetry={"alerts": {"enabled": True}})
+
+
+def test_bad_rule_fails_config_validation():
+    with pytest.raises(ValueError, match="telemetry.alerts.rules"):
+        _build(telemetry={"enabled": True,
+                          "alerts": {"enabled": True,
+                                     "rules": [{"name": "x",
+                                                "kind": "gradient"}]}})
+
+
+# ------------------------------------------------------------- fleet plane
+
+
+def _host_snapshot(host, fire_step):
+    store = MetricStore(ring_len=16, host=host)
+    eng = AlertEngine(rules=[{"name": "hot", "kind": "threshold",
+                              "metric": "Cluster/step_skew", "above": 3.0}],
+                      store=store)
+    for step in range(fire_step + 1):
+        store.observe("Cluster/step_skew", 9.0 if step >= fire_step else 1.0,
+                      step)
+        eng.evaluate(step)
+    return eng.snapshot()
+
+
+def test_merge_fleet_alerts_names_first_firing_host():
+    by_host = {1: {"alerts": _host_snapshot(1, 7)},
+               0: {"alerts": _host_snapshot(0, 3)},
+               2: {"alerts": None}}       # host with no alert plane: skipped
+    merged = merge_fleet_alerts(by_host)
+    assert merged["hosts"] == [0, 1, 2]
+    assert merged["fired_total"] == 2
+    assert merged["first_firing"] == {"host": 0, "rule": "hot", "step": 3,
+                                      "severity": "warn"}
+    assert merged["active"] == {"hot": [0, 1]}
+    # deterministic regardless of dict insertion order
+    assert merge_fleet_alerts(dict(sorted(by_host.items()))) == merged
+
+
+def test_cluster_report_carries_alerts_fleet():
+    from deepspeed_tpu.utils.cluster import assemble_cluster_report
+    by_host = {0: {"alerts": _host_snapshot(0, 3)},
+               1: {"alerts": _host_snapshot(1, 7)}}
+    report = assemble_cluster_report(by_host, run_key="al")
+    blk = report["alerts_fleet"]
+    assert blk["first_firing"]["host"] == 0
+    assert blk["fired_rules"] == ["hot"]
+    # hosts without alert blocks -> no alerts_fleet (older dumps still merge)
+    report2 = assemble_cluster_report({0: {}, 1: {}}, run_key="al")
+    assert report2["alerts_fleet"] is None
+
+
+# ------------------------------------------------------------- HLO identity
+
+
+def test_train_step_paths_hlo_identical_with_alerts_on(tmp_path):
+    """THE non-perturbation gate: the alert plane is host-side bookkeeping —
+    every registered train program compiles to instruction-identical HLO
+    with telemetry.alerts (and the metric catalog router) on."""
+    import jax
+    from deepspeed_tpu.utils.hlo import instruction_count, optimized_hlo
+    model = SimpleModel(HIDDEN)
+    engines = []
+    for tel in (None, {"enabled": True, "output_path": str(tmp_path),
+                       "metrics": {"enabled": True},
+                       "alerts": {"enabled": True}}):
+        over = dict(zero_optimization={"stage": 2})
+        if tel:
+            over["telemetry"] = tel
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=model.init(jax.random.PRNGKey(0)),
+            config_params=simple_config(**over))
+        engines.append(eng)
+    eng_off, eng_on = engines
+    batch = _batch()
+    progs_off = {n: (j, a) for n, j, a, _m in eng_off.lint_programs(batch)}
+    progs_on = {n: (j, a) for n, j, a, _m in eng_on.lint_programs(batch)}
+    assert sorted(progs_off) == sorted(progs_on)
+    for name in sorted(progs_off):
+        h_off = optimized_hlo(*progs_off[name][0:1], *progs_off[name][1])
+        h_on = optimized_hlo(*progs_on[name][0:1], *progs_on[name][1])
+        assert instruction_count(h_off) > 0, name
+        assert instruction_count(h_off) == instruction_count(h_on), name
+
+
+def test_serving_decode_hlo_identical_with_alerts_on(tmp_path):
+    """Same gate for the serving side: decode/prefill/beam programs of an
+    engine whose telemetry session runs the alert plane are instruction-
+    identical to one with no telemetry at all."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.serve.engine import InferenceEngine
+    from deepspeed_tpu.utils.hlo import instruction_count, optimized_hlo
+    from deepspeed_tpu.utils.telemetry import TelemetrySession
+    ML = 32
+    cfg = GPT2Config(vocab_size=64, n_positions=ML, n_embd=16, n_layer=2,
+                     n_head=2, compute_dtype=jnp.float32, loss_chunk=0)
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    session = TelemetrySession(output_path=str(tmp_path), job_name="al")
+    session.configure_metrics()
+    session.configure_alerts()
+    kw = dict(num_slots=4, block_size=4, num_blocks=33, max_model_len=ML,
+              prefill_chunk=8)
+    eng_off = InferenceEngine(model, params, **kw)
+    eng_on = InferenceEngine(model, params, telemetry=session, **kw)
+    S, MB, C = eng_off.num_slots, eng_off.max_blocks, eng_off.prefill_chunk
+    zs = jnp.zeros(S, jnp.int32)
+    decode_args = (params, zs, zs, jnp.zeros((S, MB), jnp.int32),
+                   jnp.zeros(S, bool), eng_off.k_pool, eng_off.v_pool)
+    prefill_args = (params, jnp.zeros((1, C), jnp.int32), jnp.int32(0),
+                    jnp.int32(1), jnp.zeros(MB, jnp.int32),
+                    eng_off.k_pool, eng_off.v_pool)
+    for name, a_fn, b_fn, fargs in (
+            ("decode", eng_off._raw["decode_step"],
+             eng_on._raw["decode_step"], decode_args),
+            ("prefill", eng_off._raw["prefill_chunk"],
+             eng_on._raw["prefill_chunk"], prefill_args)):
+        h_off = optimized_hlo(a_fn, *fargs)
+        h_on = optimized_hlo(b_fn, *fargs)
+        assert instruction_count(h_off) > 0
+        assert instruction_count(h_off) == instruction_count(h_on), name
+    beam_off = eng_off._raw["beam_init"](4, -1)
+    beam_on = eng_on._raw["beam_init"](4, -1)
+    logits = jnp.zeros((1, model.config.vocab_size), jnp.float32)
+    assert (instruction_count(optimized_hlo(beam_off, logits))
+            == instruction_count(optimized_hlo(beam_on, logits))), "beam"
+    session.close()
+
+
+# ------------------------------------------------------ attribution harness
+
+
+def test_attribution_harness_matches_golden(tmp_path):
+    """The in-process harness must reproduce the committed golden exactly —
+    the same transcript `ds-tpu alert-sim` golden-pins in lint.sh."""
+    transcript = run_alert_attribution(dump_dir=str(tmp_path))
+    golden = json.load(open(GOLDEN))
+    assert transcript == golden
+    assert transcript["ok"]
+    # each scenario fired exactly its own rule; page scenarios dumped
+    for s in transcript["scenarios"]:
+        assert s["ok"], s["name"]
+        assert [r["rule"] for r in s["fired"]] == [s["expected_rule"]]
+    dumps = {s["name"]: s["dumps"] for s in transcript["scenarios"]}
+    assert dumps["mfu_step_wall_inflation"] == 1        # page
+    assert dumps["fleet_shed_poisson_2x"] == 1          # page
+    assert dumps["loss_scale_forced_nan"] == 0          # warn: no dump
+    assert dumps["heartbeat_dispatch_skew"] == 0        # warn: no dump
+    # fleet attribution: host 0 (earlier injection) is named first-firing
+    assert transcript["fleet"]["first_firing"]["host"] == 0
+    assert transcript["fleet"]["first_firing"]["rule"] == "fleet_shed_burn"

@@ -95,3 +95,59 @@ def test_engine_emits_scalars(tmp_path):
     assert all(np.isfinite(s["value"]) for s in losses)
     # samples axis = step * global batch
     assert losses[0]["step"] == engine.train_batch_size()
+
+
+def _kill_mid_step_script(log_root, trigger):
+    """Child process: write a few scalars into a block-buffered monitor,
+    optionally trigger the flight recorder, then die hard (os._exit skips
+    every atexit/flush hook — the SIGKILL shape of a crashing host)."""
+    return f"""
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from types import SimpleNamespace
+from deepspeed_tpu.utils.monitor import SummaryMonitor
+from deepspeed_tpu.utils.numerics import FlightRecorder
+mon = SummaryMonitor({log_root!r}, "kill")
+for step in range(4):
+    mon.add_scalar("Train/Samples/train_loss", 1.0 + step, step)
+tel = SimpleNamespace(monitor=mon, watchdog=None)
+rec = FlightRecorder(capacity=8, dump_dir={log_root!r}, telemetry=tel)
+if {trigger!r} == "trigger":
+    rec.trigger("test_kill", {{}})
+os._exit(1)
+"""
+
+
+def _run_kill_child(tmp_path, trigger):
+    import subprocess
+    import sys
+    root = str(tmp_path / trigger)
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c",
+                           _kill_mid_step_script(root, trigger)],
+                          env=env, capture_output=True, text=True)
+    assert proc.returncode == 1, proc.stderr
+    path = os.path.join(root, "kill", "scalars.jsonl")
+    return open(path).read() if os.path.exists(path) else ""
+
+
+def test_flight_recorder_dump_flushes_scalars_before_kill(tmp_path):
+    """Regression (buffering fix): scalars.jsonl is block-buffered — a few
+    small records sit in userspace until flush(). The flight recorder MUST
+    flush the monitor before dumping, so a post-mortem box sees the scalars
+    that led up to the crash even when the process dies without atexit."""
+    text = _run_kill_child(tmp_path, "trigger")
+    lines = [json.loads(l) for l in text.splitlines()]
+    assert len(lines) == 4, "dump path lost buffered scalars"
+    assert [l["step"] for l in lines] == [0, 1, 2, 3]
+
+
+def test_kill_without_dump_proves_the_buffer(tmp_path):
+    """Companion control: with NO flight-recorder trigger the same child
+    loses its buffered tail on os._exit — proving the first test exercises
+    the flush-inside-dump path, not line buffering."""
+    text = _run_kill_child(tmp_path, "none")
+    assert text == "", "scalars survived without a flush: buffering changed?"
